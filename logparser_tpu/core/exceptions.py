@@ -1,0 +1,27 @@
+"""Engine exceptions.
+
+Reference behavior: parser-core/.../core/exceptions/*.java — DissectionFailure is
+the recoverable per-line failure; the others are configuration/API errors raised
+during parser assembly.
+"""
+from __future__ import annotations
+
+
+class DissectionFailure(Exception):
+    """A single line could not be dissected (recoverable; callers skip/count)."""
+
+
+class MissingDissectorsException(Exception):
+    """Requested fields cannot be produced by any dissector chain."""
+
+
+class InvalidDissectorException(Exception):
+    """A dissector is malformed (no input type, no outputs, ...)."""
+
+
+class InvalidFieldMethodSignature(Exception):
+    """A parse-target callable has an unsupported signature."""
+
+
+class FatalErrorDuringCallOfSetterMethod(Exception):
+    """A record setter raised, or no setter accepted a stored value."""

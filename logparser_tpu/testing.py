@@ -1,0 +1,321 @@
+"""Declarative test fixtures: DissectorTester + canonical dummy dissectors.
+
+Rebuild of the reference's highest-leverage test asset
+(parser-core/src/test/java/nl/basjes/parse/core/test/DissectorTester.java):
+a fluent harness ``DissectorTester.create().with_dissector(d).with_input(s)
+.expect("TYPE:name", value).check_expectations()``.  Every check also proves
+serializability by pickling + unpickling the assembled parser first
+(DissectorTester.java:257-264 does the same with SerializationUtils.clone).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from .core import (
+    Dissector,
+    Parser,
+    SimpleDissector,
+    STRING_ONLY,
+    STRING_OR_DOUBLE,
+    STRING_OR_LONG,
+    STRING_OR_LONG_OR_DOUBLE,
+)
+from .core.fields import ParsedField
+from .core.parsable import Parsable
+
+
+class TestRecord:
+    """Record that captures every delivered value keyed by full field id."""
+
+    __test__ = False  # not a pytest test class
+
+    def __init__(self) -> None:
+        self.string_values: Dict[str, Optional[str]] = {}
+        self.long_values: Dict[str, Optional[int]] = {}
+        self.double_values: Dict[str, Optional[float]] = {}
+
+    def set_string_value(self, name: str, value: str) -> None:
+        self.string_values[name] = value
+
+    def set_long_value(self, name: str, value: int) -> None:
+        self.long_values[name] = value
+
+    def set_double_value(self, name: str, value: float) -> None:
+        self.double_values[name] = value
+
+
+class UltimateDummyDissector(SimpleDissector):
+    """Canonical fake dissector covering every output type family.
+
+    Reference: parser-core/src/test/.../UltimateDummyDissector.java:30-46.
+    """
+
+    def __init__(self, input_type: str = "INPUT"):
+        super().__init__(
+            input_type,
+            {
+                "ANY:any": STRING_OR_LONG_OR_DOUBLE,
+                "STRING:string": STRING_ONLY,
+                "INT:int": STRING_OR_LONG,
+                "LONG:long": STRING_OR_LONG,
+                "FLOAT:float": STRING_OR_DOUBLE,
+                "DOUBLE:double": STRING_OR_DOUBLE,
+            },
+        )
+
+    def initialize_from_settings_parameter(self, settings: str) -> bool:
+        self.set_input_type(settings)
+        return True
+
+
+class NormalValuesDissector(UltimateDummyDissector):
+    def dissect_field(self, parsable: Parsable, input_name: str, pf: ParsedField) -> None:
+        parsable.add_dissection(input_name, "ANY", "any", "42")
+        parsable.add_dissection(input_name, "STRING", "string", "FortyTwo")
+        parsable.add_dissection(input_name, "INT", "int", 42)
+        parsable.add_dissection(input_name, "LONG", "long", 42)
+        parsable.add_dissection(input_name, "FLOAT", "float", 42.0)
+        parsable.add_dissection(input_name, "DOUBLE", "double", 42.0)
+
+
+class EmptyValuesDissector(UltimateDummyDissector):
+    def dissect_field(self, parsable: Parsable, input_name: str, pf: ParsedField) -> None:
+        for ftype, name in [
+            ("ANY", "any"),
+            ("STRING", "string"),
+            ("INT", "int"),
+            ("LONG", "long"),
+            ("FLOAT", "float"),
+            ("DOUBLE", "double"),
+        ]:
+            parsable.add_dissection(input_name, ftype, name, "")
+
+
+class NullValuesDissector(UltimateDummyDissector):
+    def dissect_field(self, parsable: Parsable, input_name: str, pf: ParsedField) -> None:
+        for ftype, name in [
+            ("ANY", "any"),
+            ("STRING", "string"),
+            ("INT", "int"),
+            ("LONG", "long"),
+            ("FLOAT", "float"),
+            ("DOUBLE", "double"),
+        ]:
+            parsable.add_dissection(input_name, ftype, name, None)
+
+
+class _PrefixRootDissector(Dissector):
+    """Re-emits the root input under a dotted prefix so dissectors whose input
+    sits below the root (e.g. wildcard producers) can be tested in isolation.
+
+    Reference: DissectorTester's DummyDissector root wrapper
+    (DissectorTester.java:76-86) working around the wildcard-at-root limitation.
+    """
+
+    def __init__(self, root_type: str = "ROOTINPUT", prefix: str = "prefix",
+                 target_type: str = "INPUT"):
+        self.root_type = root_type
+        self.prefix = prefix
+        self.target_type = target_type
+
+    def get_input_type(self) -> str:
+        return self.root_type
+
+    def get_possible_output(self) -> List[str]:
+        return [f"{self.target_type}:{self.prefix}"]
+
+    def get_new_instance(self) -> "Dissector":
+        return _PrefixRootDissector(self.root_type, self.prefix, self.target_type)
+
+    def dissect(self, parsable: Parsable, input_name: str) -> None:
+        pf = parsable.get_parsable_field(self.root_type, input_name)
+        if pf is not None:
+            parsable.add_dissection(input_name, self.target_type, self.prefix, pf.value)
+
+
+Expectation = Tuple[str, str, Any]  # (kind, field, expected)
+
+
+class DissectorTester:
+    """Fluent declarative dissector test harness."""
+
+    def __init__(self) -> None:
+        self.inputs: List[str] = []
+        self.dissectors: List[Dissector] = []
+        self.expectations: List[Expectation] = []
+        self.possible_expectations: List[str] = []
+        self.absent_possible: List[str] = []
+        self.path_prefix: Optional[str] = None
+        self._verbose = False
+
+    @classmethod
+    def create(cls) -> "DissectorTester":
+        return cls()
+
+    def with_dissector(self, dissector: Dissector) -> "DissectorTester":
+        self.dissectors.append(dissector)
+        return self
+
+    def with_input(self, input_value: str) -> "DissectorTester":
+        self.inputs.append(input_value)
+        return self
+
+    def with_path_prefix(self, prefix: str) -> "DissectorTester":
+        self.path_prefix = prefix
+        return self
+
+    def verbose(self) -> "DissectorTester":
+        self._verbose = True
+        return self
+
+    # expectations ------------------------------------------------------
+
+    def expect(self, fieldname: str, value: Union[str, int, float]) -> "DissectorTester":
+        if isinstance(value, bool):
+            raise TypeError("bool expectation is invalid")
+        if isinstance(value, str):
+            return self.expect_string(fieldname, value)
+        if isinstance(value, int):
+            return self.expect_long(fieldname, value)
+        return self.expect_double(fieldname, value)
+
+    def expect_string(self, fieldname: str, value: Optional[str]) -> "DissectorTester":
+        self.expectations.append(("string", fieldname, value))
+        return self
+
+    def expect_long(self, fieldname: str, value: Optional[int]) -> "DissectorTester":
+        self.expectations.append(("long", fieldname, value))
+        return self
+
+    def expect_double(self, fieldname: str, value: Optional[float]) -> "DissectorTester":
+        self.expectations.append(("double", fieldname, value))
+        return self
+
+    def expect_null(self, fieldname: str) -> "DissectorTester":
+        self.expectations.append(("string", fieldname, None))
+        return self
+
+    def expect_absent_string(self, fieldname: str) -> "DissectorTester":
+        self.expectations.append(("absent_string", fieldname, None))
+        return self
+
+    def expect_absent_long(self, fieldname: str) -> "DissectorTester":
+        self.expectations.append(("absent_long", fieldname, None))
+        return self
+
+    def expect_absent_double(self, fieldname: str) -> "DissectorTester":
+        self.expectations.append(("absent_double", fieldname, None))
+        return self
+
+    def expect_possible(self, fieldname: str) -> "DissectorTester":
+        self.possible_expectations.append(fieldname)
+        return self
+
+    def expect_absent_possible(self, fieldname: str) -> "DissectorTester":
+        self.absent_possible.append(fieldname)
+        return self
+
+    # execution ---------------------------------------------------------
+
+    def _build_parser(self) -> Parser:
+        if not self.dissectors:
+            raise AssertionError("No dissectors were specified")
+        parser = Parser(TestRecord)
+        root_type = self.dissectors[0].get_input_type()
+        if self.path_prefix is not None:
+            wrapper = _PrefixRootDissector(
+                "ROOTINPUT", self.path_prefix, root_type
+            )
+            parser.add_dissector(wrapper)
+            parser.set_root_type("ROOTINPUT")
+        else:
+            parser.set_root_type(root_type)
+        for d in self.dissectors:
+            parser.add_dissector(d)
+
+        kinds_for_field: Dict[str, set] = {}
+        for kind, fieldname, _ in self.expectations:
+            kinds_for_field.setdefault(fieldname, set()).add(kind.replace("absent_", ""))
+        for fieldname, kinds in kinds_for_field.items():
+            if "string" in kinds:
+                parser.add_parse_target("set_string_value", fieldname)
+            if "long" in kinds:
+                parser.add_parse_target("set_long_value", fieldname)
+            if "double" in kinds:
+                parser.add_parse_target("set_double_value", fieldname)
+        return parser
+
+    def check_expectations(self) -> "DissectorTester":
+        if not self.expectations and not self.possible_expectations and not self.absent_possible:
+            raise AssertionError("No expectations were specified")
+
+        parser = self._build_parser()
+
+        if self.possible_expectations or self.absent_possible:
+            paths = parser.get_possible_paths()
+            for fieldname in self.possible_expectations:
+                assert fieldname in paths, (
+                    f"Expected possible path {fieldname!r}; got:\n  " + "\n  ".join(paths)
+                )
+            for fieldname in self.absent_possible:
+                assert fieldname not in paths, (
+                    f"Path {fieldname!r} should NOT be possible"
+                )
+
+        if not self.expectations:
+            return self
+        if not self.inputs:
+            raise AssertionError("No inputs were specified")
+
+        # Serialization round-trip: every test also proves picklability
+        # (reference clones via Java serialization, DissectorTester.java:257-264).
+        parser.assemble_dissectors()
+        parser = pickle.loads(pickle.dumps(parser))
+
+        from .core.fields import cleanup_field_value
+
+        for input_value in self.inputs:
+            record: TestRecord = parser.parse(input_value)
+            failures: List[str] = []
+            for kind, fieldname, expected in self.expectations:
+                key = cleanup_field_value(fieldname)
+                if kind == "string":
+                    actual = record.string_values.get(key, "<<<ABSENT>>>")
+                elif kind == "long":
+                    actual = record.long_values.get(key, "<<<ABSENT>>>")
+                elif kind == "double":
+                    actual = record.double_values.get(key, "<<<ABSENT>>>")
+                elif kind == "absent_string":
+                    if key in record.string_values:
+                        failures.append(
+                            f"{fieldname}: expected ABSENT string, got "
+                            f"{record.string_values[key]!r}"
+                        )
+                    continue
+                elif kind == "absent_long":
+                    if key in record.long_values:
+                        failures.append(
+                            f"{fieldname}: expected ABSENT long, got "
+                            f"{record.long_values[key]!r}"
+                        )
+                    continue
+                elif kind == "absent_double":
+                    if key in record.double_values:
+                        failures.append(
+                            f"{fieldname}: expected ABSENT double, got "
+                            f"{record.double_values[key]!r}"
+                        )
+                    continue
+                else:  # pragma: no cover
+                    raise AssertionError(kind)
+                if actual != expected:
+                    failures.append(
+                        f"{fieldname} ({kind}): expected {expected!r}, got {actual!r}"
+                    )
+            if failures:
+                raise AssertionError(
+                    f"Input {input_value!r} failed expectations:\n  "
+                    + "\n  ".join(failures)
+                )
+        return self

@@ -1,0 +1,322 @@
+"""Core engine semantics tests.
+
+Models the reference's parser-core test suite
+(parser-core/src/test/java/nl/basjes/parse/core/): normal flow, casts, setter
+policies, wildcards, type remapping, loop guard, missing dissectors,
+serialization.
+"""
+import pickle
+
+import pytest
+
+from logparser_tpu.core import (
+    Cast,
+    DissectionFailure,
+    Dissector,
+    InvalidFieldMethodSignature,
+    MissingDissectorsException,
+    Parser,
+    SetterPolicy,
+    STRING_ONLY,
+    STRING_OR_LONG,
+    field,
+)
+from logparser_tpu.testing import (
+    DissectorTester,
+    EmptyValuesDissector,
+    NormalValuesDissector,
+    NullValuesDissector,
+    TestRecord,
+    UltimateDummyDissector,
+)
+
+
+class TestNormalFlow:
+    def test_all_types_delivered(self):
+        (
+            DissectorTester.create()
+            .with_dissector(NormalValuesDissector())
+            .with_input("whatever")
+            .expect_string("ANY:any", "42")
+            .expect_long("ANY:any", 42)
+            .expect_double("ANY:any", 42.0)
+            .expect_string("STRING:string", "FortyTwo")
+            .expect_long("INT:int", 42)
+            .expect_long("LONG:long", 42)
+            .expect_double("FLOAT:float", 42.0)
+            .expect_double("DOUBLE:double", 42.0)
+            .check_expectations()
+        )
+
+    def test_empty_values(self):
+        (
+            DissectorTester.create()
+            .with_dissector(EmptyValuesDissector())
+            .with_input("whatever")
+            .expect_string("STRING:string", "")
+            .expect_long("LONG:long", None)  # "" does not parse as long
+            .expect_double("DOUBLE:double", None)
+            .check_expectations()
+        )
+
+    def test_null_values(self):
+        (
+            DissectorTester.create()
+            .with_dissector(NullValuesDissector())
+            .with_input("whatever")
+            .expect_null("STRING:string")
+            .expect_long("LONG:long", None)
+            .check_expectations()
+        )
+
+    def test_possible_paths(self):
+        (
+            DissectorTester.create()
+            .with_dissector(NormalValuesDissector())
+            .expect_possible("ANY:any")
+            .expect_possible("STRING:string")
+            .expect_possible("DOUBLE:double")
+            .expect_absent_possible("NOPE:nope")
+            .check_expectations()
+        )
+
+
+class TestSetterPolicies:
+    def _parser(self, policy):
+        class Rec(TestRecord):
+            calls = None
+
+            def __init__(self):
+                super().__init__()
+                self.calls = []
+
+            @field("STRING:string", setter_policy=policy)
+            def set_it(self, name: str, value: str):
+                self.calls.append((name, value))
+
+        p = Parser(Rec)
+        p.set_root_type("INPUT")
+        return p, Rec
+
+    def test_always_gets_null(self):
+        p, _ = self._parser(SetterPolicy.ALWAYS)
+        p.add_dissector(NullValuesDissector())
+        rec = p.parse("x")
+        assert rec.calls == [("STRING:string", None)]
+
+    def test_not_null_skips_null(self):
+        p, _ = self._parser(SetterPolicy.NOT_NULL)
+        p.add_dissector(NullValuesDissector())
+        rec = p.parse("x")
+        assert rec.calls == []
+
+    def test_not_empty_skips_empty(self):
+        p, _ = self._parser(SetterPolicy.NOT_EMPTY)
+        p.add_dissector(EmptyValuesDissector())
+        rec = p.parse("x")
+        assert rec.calls == []
+
+    def test_not_empty_gets_value(self):
+        p, _ = self._parser(SetterPolicy.NOT_EMPTY)
+        p.add_dissector(NormalValuesDissector())
+        rec = p.parse("x")
+        assert rec.calls == [("STRING:string", "FortyTwo")]
+
+
+class ChainedDissector(Dissector):
+    """FOO -> BAR single-step dissector for chain tests (models the reference's
+    FooDissector/BarDissector chain, parser-core test reference/ package)."""
+
+    def __init__(self, input_type="FOO", output_type="BAR", name="bar"):
+        self.input_type = input_type
+        self.output_type = output_type
+        self.name = name
+
+    def get_input_type(self):
+        return self.input_type
+
+    def get_possible_output(self):
+        return [f"{self.output_type}:{self.name}"]
+
+    def get_new_instance(self):
+        return type(self)(self.input_type, self.output_type, self.name)
+
+    def prepare_for_dissect(self, input_name, output_name):
+        return STRING_OR_LONG
+
+    def dissect(self, parsable, input_name):
+        pf = parsable.get_parsable_field(self.input_type, input_name)
+        parsable.add_dissection(
+            input_name, self.output_type, self.name, pf.value.get_string() + "!"
+        )
+
+
+class TestChaining:
+    def test_two_level_chain(self):
+        class Rec(TestRecord):
+            pass
+
+        p = Parser(Rec)
+        p.set_root_type("FOO")
+        p.add_dissector(ChainedDissector("FOO", "BAR", "bar"))
+        p.add_dissector(ChainedDissector("BAR", "BAZ", "baz"))
+        p.add_parse_target("set_string_value", "BAZ:bar.baz")
+        rec = p.parse("v")
+        assert rec.string_values == {"BAZ:bar.baz": "v!!"}
+
+    def test_demand_driven_pruning(self):
+        """Dissectors that cannot reach a requested field are never compiled."""
+        ran = []
+
+        class Spy(ChainedDissector):
+            def dissect(self, parsable, input_name):
+                ran.append(self.output_type)
+                super().dissect(parsable, input_name)
+
+        p = Parser(TestRecord)
+        p.set_root_type("FOO")
+        p.add_dissector(Spy("FOO", "BAR", "bar"))
+        p.add_dissector(Spy("FOO", "QUX", "qux"))
+        p.add_parse_target("set_string_value", "BAR:bar")
+        p.parse("v")
+        assert ran == ["BAR"]
+
+
+class SelfLoopDissector(Dissector):
+    """A dissector whose output type equals its input type; the engine must not
+    loop forever (reference: ParserInfiniteLoopTest.java:50-68)."""
+
+    def get_input_type(self):
+        return "LOOP"
+
+    def get_possible_output(self):
+        return ["LOOP:loop"]
+
+    def get_new_instance(self):
+        return SelfLoopDissector()
+
+    def dissect(self, parsable, input_name):
+        pass
+
+
+class TestGuards:
+    def test_infinite_loop_guard(self):
+        p = Parser(TestRecord)
+        p.set_root_type("LOOP")
+        p.add_dissector(SelfLoopDissector())
+        p.add_parse_target("set_string_value", "LOOP:loop")
+        p.parse("x")  # must terminate
+
+    def test_missing_dissector_raises(self):
+        p = Parser(TestRecord)
+        p.set_root_type("INPUT")
+        p.add_dissector(NormalValuesDissector())
+        p.add_parse_target("set_string_value", "NOPE:nope")
+        with pytest.raises(MissingDissectorsException):
+            p.parse("x")
+
+    def test_ignore_missing_dissectors(self):
+        p = Parser(TestRecord)
+        p.set_root_type("INPUT")
+        p.add_dissector(NormalValuesDissector())
+        p.add_parse_target("set_string_value", "STRING:string")
+        p.add_parse_target("set_string_value", "NOPE:nope")
+        p.ignore_missing_dissectors()
+        rec = p.parse("x")
+        assert rec.string_values["STRING:string"] == "FortyTwo"
+
+    def test_bad_setter_signature(self):
+        class Rec:
+            def bad(self, a, b, c):
+                pass
+
+        p = Parser(Rec)
+        with pytest.raises(InvalidFieldMethodSignature):
+            p.add_parse_target("bad", "STRING:string")
+
+
+class WildcardDissector(Dissector):
+    """Emits STRING:* wildcard outputs (like the query-string dissector)."""
+
+    def get_input_type(self):
+        return "QS"
+
+    def get_possible_output(self):
+        return ["STRING:*"]
+
+    def get_new_instance(self):
+        return WildcardDissector()
+
+    def dissect(self, parsable, input_name):
+        pf = parsable.get_parsable_field("QS", input_name)
+        for kv in pf.value.get_string().split("&"):
+            k, _, v = kv.partition("=")
+            parsable.add_dissection(input_name, "STRING", k, v)
+
+
+class TestWildcards:
+    def _parser(self):
+        p = Parser(TestRecord)
+        p.set_root_type("ROOT")
+        p.add_dissector(ChainedDissector("ROOT", "QS", "qs"))
+        p.add_dissector(WildcardDissector())
+        return p
+
+    def test_exact_field_under_wildcard(self):
+        p = self._parser()
+        p.add_parse_target("set_string_value", "STRING:qs.a")
+        # ChainedDissector appends '!' to the line before the split
+        rec = p.parse("a=1&b=2")
+        assert rec.string_values == {"STRING:qs.a": "1"}
+
+    def test_wildcard_target(self):
+        p = self._parser()
+        p.add_parse_target("set_string_value", "STRING:qs.*")
+        rec = p.parse("a=1&b=2")
+        assert rec.string_values == {"STRING:qs.a": "1", "STRING:qs.b": "2!"}
+
+
+class TestTypeRemapping:
+    def test_remap_allows_further_dissection(self):
+        """Retyping a produced path re-enters the dissector search
+        (reference: Parser.java:639-677, Parsable.java:164-176)."""
+        p = Parser(TestRecord)
+        p.set_root_type("FOO")
+        p.add_dissector(ChainedDissector("FOO", "BAR", "bar"))
+        p.add_dissector(ChainedDissector("SPECIAL", "EXTRA", "extra"))
+        p.add_type_remapping("bar", "SPECIAL")
+        p.add_parse_target("set_string_value", "EXTRA:bar.extra")
+        rec = p.parse("v")
+        assert rec.string_values == {"EXTRA:bar.extra": "v!!"}
+
+    def test_remap_to_same_type_fails(self):
+        p = Parser(TestRecord)
+        p.set_root_type("FOO")
+        p.add_dissector(ChainedDissector("FOO", "BAR", "bar"))
+        p.add_type_remapping("bar", "BAR")
+        p.add_parse_target("set_string_value", "BAR:bar")
+        with pytest.raises(DissectionFailure):
+            p.parse("v")
+
+
+class TestSerialization:
+    def test_parser_pickle_roundtrip(self):
+        p = Parser(TestRecord)
+        p.set_root_type("INPUT")
+        p.add_dissector(NormalValuesDissector())
+        p.add_parse_target("set_string_value", "STRING:string")
+        p.parse("x")  # assemble before pickling
+        p2 = pickle.loads(pickle.dumps(p))
+        rec = p2.parse("x")
+        assert rec.string_values["STRING:string"] == "FortyTwo"
+
+
+class TestCasts:
+    def test_get_casts(self):
+        p = Parser(TestRecord)
+        p.set_root_type("INPUT")
+        p.add_dissector(NormalValuesDissector())
+        p.add_parse_target("set_string_value", "STRING:string")
+        p.add_parse_target("set_long_value", "LONG:long")
+        assert p.get_casts("STRING:string") == STRING_ONLY
+        assert p.get_casts("LONG:long") == STRING_OR_LONG
