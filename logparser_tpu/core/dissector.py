@@ -26,6 +26,17 @@ if TYPE_CHECKING:  # pragma: no cover
     from .parser import Parser
 
 
+def extract_field_name(input_name: str, output_name: str) -> str:
+    """The relative output name below the input name
+    (Dissector.extractFieldName, Dissector.java:147-157): equal names yield
+    the empty relative name (used by empty-named outputs)."""
+    if input_name == output_name:
+        return ""
+    if input_name and output_name.startswith(input_name + "."):
+        return output_name[len(input_name) + 1 :]
+    return output_name
+
+
 class Dissector:
     """Abstract dissector. Subclasses declare input type + possible outputs and
     implement ``dissect``."""

@@ -1,0 +1,38 @@
+"""Sub-dissector library: tokenformat compiler, time, URI, query, cookies, etc."""
+from .cookies import (
+    RequestCookieListDissector,
+    ResponseSetCookieDissector,
+    ResponseSetCookieListDissector,
+)
+from .firstline import HttpFirstLineDissector, HttpFirstLineProtocolDissector
+from .mod_unique_id import ModUniqueIdDissector
+from .query import QueryStringFieldDissector
+from .screenres import ScreenResolutionDissector
+from .strftime_stamp import LocalizedTimeDissector, StrfTimeStampDissector
+from .timestamp import TimeStampDissector
+from .translate import (
+    ConvertCLFIntoNumber,
+    ConvertMillisecondsIntoMicroseconds,
+    ConvertNumberIntoCLF,
+    ConvertSecondsWithMillisStringDissector,
+)
+from .uri import HttpUriDissector
+
+__all__ = [
+    "RequestCookieListDissector",
+    "ResponseSetCookieDissector",
+    "ResponseSetCookieListDissector",
+    "HttpFirstLineDissector",
+    "HttpFirstLineProtocolDissector",
+    "ModUniqueIdDissector",
+    "QueryStringFieldDissector",
+    "ScreenResolutionDissector",
+    "StrfTimeStampDissector",
+    "LocalizedTimeDissector",
+    "TimeStampDissector",
+    "ConvertCLFIntoNumber",
+    "ConvertMillisecondsIntoMicroseconds",
+    "ConvertNumberIntoCLF",
+    "ConvertSecondsWithMillisStringDissector",
+    "HttpUriDissector",
+]

@@ -1,0 +1,220 @@
+"""Cookie dissection.
+
+Rebuilds of:
+- RequestCookieListDissector.java: ``HTTP.COOKIES`` -> ``HTTP.COOKIE:*``; split
+  on ``"; "``, names trimmed + lowercased, values url-decoded (:77-111).
+- ResponseSetCookieListDissector.java: ``HTTP.SETCOOKIES`` -> ``HTTP.SETCOOKIE:*``;
+  split on ``", "`` with special handling for commas inside ``expires=``
+  (:78-115).
+- ResponseSetCookieDissector.java: one Set-Cookie value -> value/expires
+  (STRING seconds + TIME.EPOCH millis)/path/domain/comment (:63-105).
+  Divergence from the reference: its parseExpire only catches
+  IllegalArgumentException, so a non-first-format expires date crashes the Java
+  parse with an uncaught DateTimeParseException; we try all three formats and
+  fall back to 0 (the reference's intended behavior).
+"""
+from __future__ import annotations
+
+from typing import FrozenSet, List, Optional, Set
+
+from ..core.casts import Cast, STRING_ONLY, STRING_OR_LONG
+from ..core.dissector import Dissector, extract_field_name
+from ..core.exceptions import DissectionFailure
+from .timelayout import TimeLayout, TimestampParseError, compile_java_pattern
+from .utils import resilient_url_decode
+
+
+class RequestCookieListDissector(Dissector):
+    INPUT_TYPE = "HTTP.COOKIES"
+
+    def __init__(self):
+        self.requested: Set[str] = set()
+        self.want_all = False
+
+    def get_input_type(self) -> str:
+        return self.INPUT_TYPE
+
+    def get_possible_output(self) -> List[str]:
+        return ["HTTP.COOKIE:*"]
+
+    def prepare_for_dissect(self, input_name: str, output_name: str) -> FrozenSet[Cast]:
+        self.requested.add(extract_field_name(input_name, output_name))
+        return STRING_ONLY
+
+    def prepare_for_run(self) -> None:
+        self.want_all = "*" in self.requested
+
+    def get_new_instance(self) -> "Dissector":
+        return RequestCookieListDissector()
+
+    def dissect(self, parsable, input_name: str) -> None:
+        field = parsable.get_parsable_field(self.INPUT_TYPE, input_name)
+        value = field.value.get_string()
+        if value is None or value == "":
+            return
+
+        for part in value.split("; "):
+            equal_pos = part.find("=")
+            if equal_pos == -1:
+                if part != "":
+                    name = part.strip().lower()  # just a name, no value
+                    if self.want_all or name in self.requested:
+                        parsable.add_dissection(input_name, "HTTP.COOKIE", name, "")
+            else:
+                name = part[:equal_pos].strip().lower()
+                if self.want_all or name in self.requested:
+                    the_value = part[equal_pos + 1 :].strip()
+                    try:
+                        parsable.add_dissection(
+                            input_name,
+                            "HTTP.COOKIE",
+                            name,
+                            resilient_url_decode(the_value),
+                        )
+                    except ValueError as e:
+                        raise DissectionFailure(str(e)) from e
+
+
+_SPLIT_BY = ", "
+_MINIMAL_EXPIRES_LENGTH = len("expires=XXXXXXX")
+
+
+def _http_cookie_names(header_value: str) -> List[str]:
+    """Minimal java.net.HttpCookie.parse equivalent: the cookie name(s) in one
+    Set-Cookie header value (the reference only uses the parsed name)."""
+    value = header_value
+    if value.lower().startswith("set-cookie2:"):
+        value = value[len("set-cookie2:") :]
+    elif value.lower().startswith("set-cookie:"):
+        value = value[len("set-cookie:") :]
+    first = value.split(";", 1)[0].strip()
+    name = first.split("=", 1)[0].strip()
+    if not name:
+        raise ValueError("Empty cookie header string")
+    return [name]
+
+
+class ResponseSetCookieListDissector(Dissector):
+    INPUT_TYPE = "HTTP.SETCOOKIES"
+
+    def __init__(self):
+        self.requested: Set[str] = set()
+        self.want_all = False
+
+    def get_input_type(self) -> str:
+        return self.INPUT_TYPE
+
+    def get_possible_output(self) -> List[str]:
+        return ["HTTP.SETCOOKIE:*"]
+
+    def prepare_for_dissect(self, input_name: str, output_name: str) -> FrozenSet[Cast]:
+        self.requested.add(extract_field_name(input_name, output_name))
+        return STRING_ONLY
+
+    def prepare_for_run(self) -> None:
+        self.want_all = "*" in self.requested
+
+    def get_new_instance(self) -> "Dissector":
+        return ResponseSetCookieListDissector()
+
+    def dissect(self, parsable, input_name: str) -> None:
+        field = parsable.get_parsable_field(self.INPUT_TYPE, input_name)
+        value = field.value.get_string()
+        if value is None or value == "":
+            return
+
+        # A ', '-separated list, except the expires attribute may itself
+        # contain ', ' — rejoin a part that ends inside expires=.
+        parts = value.split(_SPLIT_BY)
+        previous = ""
+        for part in parts:
+            expires_index = part.lower().find("expires=")
+            if expires_index != -1 and len(part) - _MINIMAL_EXPIRES_LENGTH < expires_index:
+                previous = part
+                continue
+            if previous:
+                part = previous + _SPLIT_BY + part
+                previous = ""
+            try:
+                names = _http_cookie_names(part)
+            except ValueError:
+                continue
+            for cookie_name in names:
+                name = cookie_name.lower()
+                if self.want_all or name in self.requested:
+                    parsable.add_dissection(input_name, "HTTP.SETCOOKIE", name, part)
+
+
+class ResponseSetCookieDissector(Dissector):
+    INPUT_TYPE = "HTTP.SETCOOKIE"
+
+    _DATE_LAYOUTS: Optional[List[TimeLayout]] = None
+
+    def __init__(self):
+        self.requested: Set[str] = set()
+
+    @classmethod
+    def _date_layouts(cls) -> List[TimeLayout]:
+        if cls._DATE_LAYOUTS is None:
+            cls._DATE_LAYOUTS = [
+                compile_java_pattern("EEE',' dd-MMM-yyyy HH:mm:ss z", "UTC"),
+                compile_java_pattern("EEE',' dd MMM yyyy HH:mm:ss z", "UTC"),
+                compile_java_pattern("EEE MMM dd yyyy HH:mm:ss 'GMT'Z", "UTC"),
+            ]
+        return cls._DATE_LAYOUTS
+
+    def get_input_type(self) -> str:
+        return self.INPUT_TYPE
+
+    def get_possible_output(self) -> List[str]:
+        return [
+            "STRING:value",
+            "STRING:expires",
+            "TIME.EPOCH:expires",
+            "STRING:path",
+            "STRING:domain",
+            "STRING:comment",
+        ]
+
+    def prepare_for_dissect(self, input_name: str, output_name: str) -> FrozenSet[Cast]:
+        name = extract_field_name(input_name, output_name)
+        self.requested.add(name)
+        if name == "expires":
+            return STRING_OR_LONG
+        return STRING_ONLY
+
+    def get_new_instance(self) -> "Dissector":
+        return ResponseSetCookieDissector()
+
+    def dissect(self, parsable, input_name: str) -> None:
+        field = parsable.get_parsable_field(self.INPUT_TYPE, input_name)
+        value = field.value.get_string()
+        if value is None or value == "":
+            return
+
+        for i, raw_part in enumerate(value.split(";")):
+            part = raw_part.strip()
+            kv = part.split("=", 1)
+            key = kv[0].strip()
+            part_value = kv[1].strip() if len(kv) == 2 else ""
+
+            if i == 0:
+                parsable.add_dissection(input_name, "STRING", "value", part_value)
+            elif key == "expires":
+                expires = self._parse_expire(part_value)
+                # Backwards compatibility: STRING version is in seconds.
+                parsable.add_dissection(
+                    input_name, "STRING", "expires", expires // 1000
+                )
+                parsable.add_dissection(input_name, "TIME.EPOCH", "expires", expires)
+            elif key in ("domain", "comment", "path"):
+                parsable.add_dissection(input_name, "STRING", key, part_value)
+            # Anything else (incl. max-age) is ignored.
+
+    def _parse_expire(self, expire_string: str) -> int:
+        for layout in self._date_layouts():
+            try:
+                return layout.parse(expire_string).epoch_millis
+            except (TimestampParseError, ValueError):
+                continue
+        return 0

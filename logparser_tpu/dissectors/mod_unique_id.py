@@ -1,0 +1,104 @@
+"""mod_unique_id token decoding: 24 chars -> epoch/ip/processid/counter/threadindex.
+
+Rebuild of httpdlog/httpdlog-parser/.../dissectors/ModUniqueIdDissector.java:
+the encoding is base64 with a different alphabet tail; the reference maps the
+``+``/``/`` characters to ``@`` and reuses a standard base64 decoder
+(:117-150).  Layout of the 18 decoded bytes: 32-bit timestamp (seconds),
+32-bit IPv4, 32-bit pid, 16-bit counter, 32-bit thread index.
+"""
+from __future__ import annotations
+
+import base64
+from typing import FrozenSet, List, Optional, Set
+
+from ..core.casts import Cast, NO_CASTS, STRING_OR_LONG
+from ..core.dissector import Dissector, extract_field_name
+
+
+def _decode_to_bytes(unique_id: str) -> Optional[bytes]:
+    if len(unique_id) != 24:
+        return None
+    # The mod_unique_id alphabet is [A-Za-z0-9@-]; '@' and '-' replace base64's
+    # '+' and '/'.  The reference maps '+' and '/' inputs to '@' and feeds a
+    # lenient base64 decoder; commons-codec decodeBase64 simply skips
+    # non-alphabet characters.  Translate '@' -> '+' and keep '-' -> '/'... the
+    # reference's decoder treats '-' via its url-safe table.
+    translated = unique_id.replace("+", "@").replace("/", "@")
+    # commons-codec decodeBase64 supports BOTH standard and url-safe alphabets
+    # and SKIPS illegal characters ('@' is illegal and is dropped).
+    std = []
+    for c in translated:
+        if c.isalnum() or c in "+/=":
+            std.append(c)
+        elif c == "-":
+            std.append("+")
+        elif c == "_":
+            std.append("/")
+        # '@' and anything else: skipped
+    data = "".join(std)
+    data += "=" * (-len(data) % 4)
+    try:
+        return base64.b64decode(data)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class ModUniqueIdDissector(Dissector):
+    INPUT_TYPE = "MOD_UNIQUE_ID"
+
+    def __init__(self):
+        self.wanted: Set[str] = set()
+
+    def get_input_type(self) -> str:
+        return self.INPUT_TYPE
+
+    def get_possible_output(self) -> List[str]:
+        return [
+            "TIME.EPOCH:epoch",
+            "IP:ip",
+            "PROCESSID:processid",
+            "COUNTER:counter",
+            "THREAD_INDEX:threadindex",
+        ]
+
+    def prepare_for_dissect(self, input_name: str, output_name: str) -> FrozenSet[Cast]:
+        name = extract_field_name(input_name, output_name)
+        if name in ("epoch", "ip", "processid", "counter", "threadindex"):
+            self.wanted.add(name)
+            return STRING_OR_LONG
+        return NO_CASTS
+
+    def get_new_instance(self) -> "Dissector":
+        return ModUniqueIdDissector()
+
+    def dissect(self, parsable, input_name: str) -> None:
+        field = parsable.get_parsable_field(self.INPUT_TYPE, input_name)
+        value = field.value.get_string()
+        if value is None or value == "":
+            return
+
+        raw = _decode_to_bytes(value)
+        if raw is None or len(raw) != 18:
+            return
+
+        if "epoch" in self.wanted:
+            timestamp = int.from_bytes(raw[0:4], "big") * 1000
+            parsable.add_dissection(input_name, "TIME.EPOCH", "epoch", timestamp)
+        if "ip" in self.wanted:
+            ip_str = ".".join(str(b) for b in raw[4:8])
+            parsable.add_dissection(input_name, "IP", "ip", ip_str)
+        if "processid" in self.wanted:
+            parsable.add_dissection(
+                input_name, "PROCESSID", "processid", int.from_bytes(raw[8:12], "big")
+            )
+        if "counter" in self.wanted:
+            parsable.add_dissection(
+                input_name, "COUNTER", "counter", int.from_bytes(raw[12:14], "big")
+            )
+        if "threadindex" in self.wanted:
+            parsable.add_dissection(
+                input_name,
+                "THREAD_INDEX",
+                "threadindex",
+                int.from_bytes(raw[14:18], "big"),
+            )

@@ -1,0 +1,259 @@
+"""strftime timestamp handling: ``%{strfformat}t`` tokens.
+
+Rebuild of httpdlog/httpdlog-parser/.../dissectors/StrfTimeStampDissector.java
+(wraps a TimeStampDissector with a converted layout, :40-68; registers a
+LocalizedTimeDissector fallback that re-emits the raw value as
+``TIME.LOCALIZEDSTRING``, :104-157) and StrfTimeToDateTimeFormatter.java
+(strftime -> formatter mapping; unsupported fields raise; a format without a
+zone assumes the default zone, :97-105).  The ANTLR grammar is replaced by a
+direct scanner over ``%X`` directives.
+"""
+from __future__ import annotations
+
+from typing import FrozenSet, List, Optional
+
+from ..core.casts import Cast, STRING_ONLY
+from ..core.dissector import Dissector
+from ..core.fields import ParsedField
+from .timelayout import Item, TimeLayout
+from .timestamp import TimeStampDissector
+
+DEFAULT_ZONE = "UTC"
+
+
+class UnsupportedStrfField(ValueError):
+    def __init__(self, field: str):
+        super().__init__(
+            f"The field '{field}' cannot be converted towards a timestamp layout field."
+        )
+
+
+def compile_strftime(
+    strfformat: str, default_zone: str = DEFAULT_ZONE
+) -> Optional[TimeLayout]:
+    """strftime(3) format -> TimeLayout.  Returns None on syntax errors,
+    raises UnsupportedStrfField on unconvertible directives (mirrors
+    StrfTimeToDateTimeFormatter.convert)."""
+    items: List[Item] = []
+    has_zone = False
+    i = 0
+    n = len(strfformat)
+    while i < n:
+        # Apache-specific fraction tokens match with or without a leading '%'
+        # and beat all other tokenization (StrfTime.g4 lexer order).
+        rest = strfformat[i:]
+        matched_frac = False
+        for frac, field, width in (("msec_frac", "milli", 3), ("usec_frac", "micro", 6)):
+            if rest.startswith(frac) or rest.startswith("%" + frac):
+                items.append(("num", field, width, width, False))
+                i += len(frac) + (1 if rest.startswith("%") else 0)
+                matched_frac = True
+                break
+        if matched_frac:
+            continue
+        c = strfformat[i]
+        if c != "%":
+            items.append(("lit", c))
+            i += 1
+            continue
+        if i + 1 >= n:
+            return None  # dangling % = syntax error
+        d = strfformat[i + 1]
+        i += 2
+        if d in ("E", "O") and i < n:
+            # E/O alternative-format modifiers are ignored (StrfTime.g4:40).
+            d = strfformat[i]
+            i += 1
+        if d == "%":
+            items.append(("lit", "%"))
+        elif d == "n":
+            items.append(("lit", "\n"))
+        elif d == "t":
+            items.append(("lit", "\t"))
+        elif d == "a":
+            items.append(("text", "dayname", "short"))
+        elif d == "A":
+            items.append(("text", "dayname", "full"))
+        elif d in ("b", "h"):
+            items.append(("text", "monthname", "short"))
+        elif d == "B":
+            items.append(("text", "monthname", "full"))
+        elif d == "d":
+            items.append(("num", "day", 2, 2, False))
+        elif d == "D":  # %m/%d/%y
+            items.append(("num", "month", 2, 2, False))
+            items.append(("lit", "/"))
+            items.append(("num", "day", 2, 2, False))
+            items.append(("lit", "/"))
+            items.append(("num", "year2", 2, 2, False))
+        elif d == "e":
+            items.append(("num", "day", 1, 2, True))
+        elif d == "F":  # %Y-%m-%d
+            items.append(("num", "year", 4, 4, False))
+            items.append(("lit", "-"))
+            items.append(("num", "month", 2, 2, False))
+            items.append(("lit", "-"))
+            items.append(("num", "day", 2, 2, False))
+        elif d == "G":
+            items.append(("num", "wby", 4, 4, False))
+        elif d == "g":
+            items.append(("num", "wby2", 2, 2, False))
+        elif d == "H":
+            # Reference maps %H to CLOCK_HOUR_OF_DAY (1-24); see
+            # StrfTimeToDateTimeFormatter enterPH.
+            items.append(("num", "clock_hour", 2, 2, False))
+        elif d == "I":
+            items.append(("num", "hour12", 2, 2, False))
+        elif d == "j":
+            items.append(("num", "doy", 3, 3, False))
+        elif d == "k":
+            items.append(("num", "hour", 1, 2, True))
+        elif d == "l":
+            items.append(("num", "hour12", 1, 2, True))
+        elif d == "m":
+            items.append(("num", "month", 2, 2, False))
+        elif d == "M":
+            items.append(("num", "minute", 2, 2, False))
+        elif d == "p":
+            items.append(("text", "ampm", "upper"))
+        elif d == "P":
+            items.append(("text", "ampm", "lower"))
+        elif d == "r":  # %I:%M:%S %p
+            items.append(("num", "hour12", 2, 2, False))
+            items.append(("lit", ":"))
+            items.append(("num", "minute", 2, 2, False))
+            items.append(("lit", ":"))
+            items.append(("num", "second", 2, 2, False))
+            items.append(("lit", " "))
+            items.append(("text", "ampm", "upper"))
+        elif d == "R":  # %H:%M
+            items.append(("num", "hour", 2, 2, False))
+            items.append(("lit", ":"))
+            items.append(("num", "minute", 2, 2, False))
+        elif d == "s":
+            items.append(("num", "epoch", 1, 19, False))
+        elif d == "S":
+            items.append(("num", "second", 2, 2, False))
+        elif d == "T":  # %H:%M:%S
+            items.append(("num", "hour", 2, 2, False))
+            items.append(("lit", ":"))
+            items.append(("num", "minute", 2, 2, False))
+            items.append(("lit", ":"))
+            items.append(("num", "second", 2, 2, False))
+        elif d == "u":
+            items.append(("num", "isodow", 1, 1, False))
+        elif d == "V":
+            items.append(("num", "isoweek", 1, 2, False))
+        elif d == "W":
+            items.append(("num", "isoweek", 2, 2, False))
+        elif d == "y":
+            items.append(("num", "year2", 2, 2, False))
+        elif d == "Y":
+            items.append(("num", "year", 4, 4, False))
+        elif d == "z":
+            items.append(("offset",))
+            has_zone = True
+        elif d == "Z":
+            items.append(("zonetext",))
+            has_zone = True
+        elif d in ("c", "C", "U", "w", "x", "X", "+"):
+            raise UnsupportedStrfField("%" + d)
+        else:
+            return None  # unknown directive = lexer/syntax error
+
+    merged: List[Item] = []
+    for it in items:
+        if it[0] == "lit" and merged and merged[-1][0] == "lit":
+            merged[-1] = ("lit", merged[-1][1] + it[1])
+        else:
+            merged.append(it)
+    return TimeLayout(merged, None if has_zone else default_zone)
+
+
+class StrfTimeStampDissector(Dissector):
+    """Handles ``%{strfformat}t``: converts the strftime pattern to a layout
+    and delegates to an embedded TimeStampDissector."""
+
+    def __init__(self):
+        self.timestamp_dissector = TimeStampDissector()
+        self.strf_pattern: Optional[str] = None
+        self._input_type = "TIME.?????"
+
+    def set_date_time_pattern(self, pattern: Optional[str]) -> None:
+        if pattern is None:
+            self.timestamp_dissector.set_date_time_pattern("")
+            return
+        if pattern == self.strf_pattern:
+            return
+        self.strf_pattern = pattern
+        layout = compile_strftime(pattern)
+        if layout is None:
+            raise UnsupportedStrfField(pattern)
+        self.timestamp_dissector.set_layout(layout)
+
+    def initialize_from_settings_parameter(self, settings: str) -> bool:
+        self.set_date_time_pattern(settings)
+        return True
+
+    def dissect(self, parsable, input_name: str) -> None:
+        field: ParsedField = parsable.get_parsable_field(self._input_type, input_name)
+        self.timestamp_dissector.dissect_field(parsable, input_name, field)
+
+    def get_input_type(self) -> str:
+        return self._input_type
+
+    def set_input_type(self, new_input_type: str) -> None:
+        self._input_type = new_input_type
+
+    def get_possible_output(self) -> List[str]:
+        return self.timestamp_dissector.get_possible_output()
+
+    def prepare_for_dissect(self, input_name: str, output_name: str) -> FrozenSet[Cast]:
+        return self.timestamp_dissector.prepare_for_dissect(input_name, output_name)
+
+    def prepare_for_run(self) -> None:
+        self.timestamp_dissector.prepare_for_run()
+
+    def get_new_instance(self) -> "Dissector":
+        new = StrfTimeStampDissector()
+        self.initialize_new_instance(new)
+        return new
+
+    def initialize_new_instance(self, new_instance: "Dissector") -> None:
+        new_instance.set_input_type(self._input_type)
+        if self.strf_pattern is not None:
+            new_instance.set_date_time_pattern(self.strf_pattern)
+
+    def create_additional_dissectors(self, parser) -> None:
+        parser.add_dissector(LocalizedTimeDissector(self._input_type))
+
+
+class LocalizedTimeDissector(Dissector):
+    """Fallback that re-emits the raw strftime timestamp value as
+    ``TIME.LOCALIZEDSTRING`` (StrfTimeStampDissector.java:104-157)."""
+
+    def __init__(self, input_type: Optional[str] = None):
+        self._input_type = input_type
+
+    def set_input_type(self, new_input_type: str) -> None:
+        self._input_type = new_input_type
+
+    def initialize_from_settings_parameter(self, settings: str) -> bool:
+        self.set_input_type(settings)
+        return True
+
+    def dissect(self, parsable, input_name: str) -> None:
+        field = parsable.get_parsable_field(self._input_type, input_name)
+        parsable.add_dissection(input_name, "TIME.LOCALIZEDSTRING", "", field.value)
+
+    def get_input_type(self) -> str:
+        return self._input_type
+
+    def get_possible_output(self) -> List[str]:
+        return ["TIME.LOCALIZEDSTRING:"]
+
+    def prepare_for_dissect(self, input_name: str, output_name: str) -> FrozenSet[Cast]:
+        return STRING_ONLY
+
+    def get_new_instance(self) -> "Dissector":
+        return LocalizedTimeDissector(self._input_type)

@@ -1,0 +1,425 @@
+"""Compiled timestamp layouts: a small, serializable parse program for
+fixed-layout timestamps.
+
+This replaces the reference's java.time ``DateTimeFormatter`` machinery
+(TimeStampDissector.java:404-424 builds a formatter from a Java pattern;
+StrfTimeToDateTimeFormatter.java maps strftime).  A layout is a flat list of
+items, each matching a fixed or narrow-variable slice of the input — exactly
+the property that makes timestamp parsing vectorizable on TPU (every item
+becomes a fixed gather + arithmetic once the layout is known).
+
+Two front-ends compile to this representation:
+- :func:`compile_java_pattern` — the subset of java.time pattern letters the
+  reference uses (dd/MMM/yyyy:HH:mm:ss ZZ and friends).
+- ``logparser_tpu.dissectors.strftime_stamp.compile_strftime`` — strftime.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import re
+from typing import List, Optional, Tuple
+
+MONTHS_SHORT = ["Jan", "Feb", "Mar", "Apr", "May", "Jun",
+                "Jul", "Aug", "Sep", "Oct", "Nov", "Dec"]
+MONTHS_FULL = ["January", "February", "March", "April", "May", "June",
+               "July", "August", "September", "October", "November", "December"]
+DAYS_SHORT = ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
+DAYS_FULL = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+             "Saturday", "Sunday"]
+
+# Curated zone-abbreviation table for %Z-style zone text (Java resolves these
+# through its locale zone-name tables; we map to tzdata zones/fixed offsets).
+_ZONE_ABBREVIATIONS = {
+    "UTC": "UTC", "GMT": "UTC", "Z": "UTC", "UT": "UTC",
+    "CET": "CET", "CEST": "CET", "MET": "MET", "MEST": "MET",
+    "WET": "WET", "WEST": "WET", "EET": "EET", "EEST": "EET",
+    "EST": "EST5EDT", "EDT": "EST5EDT",
+    "CST": "CST6CDT", "CDT": "CST6CDT",
+    "MST": "MST7MDT", "MDT": "MST7MDT",
+    "PST": "PST8PDT", "PDT": "PST8PDT",
+}
+
+_ZONE_FULL_NAMES = {
+    "UTC": "Coordinated Universal Time",
+    "CET": "Central European Time",
+    "MET": "Middle Europe Time",
+    "WET": "Western European Time",
+    "EET": "Eastern European Time",
+    "EST5EDT": "Eastern Time",
+    "CST6CDT": "Central Time",
+    "MST7MDT": "Mountain Time",
+    "PST8PDT": "Pacific Time",
+}
+
+
+class TimestampParseError(ValueError):
+    """Raised when an input does not match the compiled layout."""
+
+
+# A layout item is a tuple whose first element is the kind:
+#   ("lit", text)
+#   ("num", field, min_width, max_width, space_padded: bool)
+#   ("text", field, style)          field: monthname|dayname|ampm
+#   ("offset",)                     +HHMM / -HHMM  (+0000 for zero)
+#   ("offset_colon",)               +HH:MM, 'Z' accepted for zero (pattern XXX)
+#   ("zonetext",)                   zone abbreviation or region id
+Item = Tuple
+
+
+class ParsedTimestamp:
+    """Resolved timestamp: local wall-clock fields + zone + epoch."""
+
+    __slots__ = (
+        "year", "month", "day", "hour", "minute", "second", "nano",
+        "offset_seconds", "zone_name", "epoch_millis", "_dt_local",
+    )
+
+    def __init__(self, year, month, day, hour, minute, second, nano,
+                 offset_seconds, zone_name, epoch_millis):
+        self.year = year
+        self.month = month
+        self.day = day
+        self.hour = hour
+        self.minute = minute
+        self.second = second
+        self.nano = nano
+        self.offset_seconds = offset_seconds
+        self.zone_name = zone_name  # tzdata id when parsed from zone text
+        self.epoch_millis = epoch_millis
+        self._dt_local = _dt.date(year, month, day)
+
+    # -- derived fields used by TimeStampDissector ----------------------
+
+    def iso_week(self) -> int:
+        return self._dt_local.isocalendar()[1]
+
+    def iso_weekyear(self) -> int:
+        return self._dt_local.isocalendar()[0]
+
+    def monthname(self) -> str:
+        return MONTHS_FULL[self.month - 1]
+
+    def date_str(self) -> str:
+        return f"{self.year:04d}-{self.month:02d}-{self.day:02d}"
+
+    def time_str(self) -> str:
+        return f"{self.hour:02d}:{self.minute:02d}:{self.second:02d}"
+
+    def zone_display_name(self) -> str:
+        """Java ZonedDateTime.getZone().getDisplayName(FULL, locale)."""
+        if self.zone_name is not None:
+            return _ZONE_FULL_NAMES.get(self.zone_name, self.zone_name)
+        total = self.offset_seconds
+        if total == 0:
+            return "Z"
+        sign = "+" if total >= 0 else "-"
+        total = abs(total)
+        h, rem = divmod(total, 3600)
+        m, s = divmod(rem, 60)
+        if s:
+            return f"{sign}{h:02d}:{m:02d}:{s:02d}"
+        return f"{sign}{h:02d}:{m:02d}"
+
+    def as_utc(self) -> "_dt.datetime":
+        return _dt.datetime.fromtimestamp(
+            self.epoch_millis / 1000.0, tz=_dt.timezone.utc
+        ).replace(microsecond=0) + _dt.timedelta(
+            microseconds=(self.epoch_millis % 1000) * 1000
+        )
+
+    def utc_fields(self) -> "ParsedTimestamp":
+        """The same instant re-expressed in UTC."""
+        epoch_s, milli = divmod(self.epoch_millis, 1000)
+        u = _dt.datetime.fromtimestamp(epoch_s, tz=_dt.timezone.utc)
+        sub_nano = self.nano % 1_000_000  # keep micro/nano precision
+        return ParsedTimestamp(
+            u.year, u.month, u.day, u.hour, u.minute, u.second,
+            milli * 1_000_000 + sub_nano,
+            0, None, self.epoch_millis,
+        )
+
+
+class TimeLayout:
+    """A compiled, serializable timestamp layout."""
+
+    def __init__(self, items: List[Item], default_zone: Optional[str] = None):
+        self.items = items
+        # tzdata id applied when the layout itself carries no zone
+        # (StrfTimeToDateTimeFormatter.java:97-105 defaults likewise).
+        self.default_zone = default_zone
+
+    def has_zone(self) -> bool:
+        return any(it[0] in ("offset", "offset_colon", "zonetext") for it in self.items)
+
+    # -- parsing ---------------------------------------------------------
+
+    def parse(self, s: str) -> ParsedTimestamp:
+        fields = {}
+        pos = 0
+        n = len(s)
+        for it in self.items:
+            kind = it[0]
+            if kind == "lit":
+                lit = it[1]
+                if s[pos : pos + len(lit)].lower() != lit.lower():
+                    raise TimestampParseError(
+                        f"Text '{s}' could not be parsed at index {pos}"
+                    )
+                pos += len(lit)
+            elif kind == "num":
+                _, field, minw, maxw, space_pad = it
+                start = pos
+                if space_pad:
+                    while pos < n and s[pos] == " " and pos - start < maxw - 1:
+                        pos += 1
+                digits_start = pos
+                sign = 1
+                if field == "epoch" and pos < n and s[pos] in "+-":
+                    sign = -1 if s[pos] == "-" else 1
+                    pos += 1
+                while pos < n and s[pos].isdigit() and (pos - digits_start) < maxw:
+                    pos += 1
+                ndig = pos - digits_start - (0 if sign == 1 else 1)
+                if ndig < minw and not space_pad:
+                    raise TimestampParseError(
+                        f"Text '{s}' could not be parsed at index {start}"
+                    )
+                if pos == digits_start:
+                    raise TimestampParseError(
+                        f"Text '{s}' could not be parsed at index {start}"
+                    )
+                fields[field] = sign * int(s[digits_start:pos])
+            elif kind == "text":
+                _, field, style = it
+                pos = self._parse_text(s, pos, field, style, fields)
+            elif kind == "offset":
+                pos = self._parse_offset(s, pos, fields, colon=False)
+            elif kind == "offset_colon":
+                pos = self._parse_offset(s, pos, fields, colon=True)
+            elif kind == "zonetext":
+                pos = self._parse_zonetext(s, pos, fields)
+            else:  # pragma: no cover
+                raise AssertionError(kind)
+        if pos != n:
+            raise TimestampParseError(
+                f"Text '{s}' could not be parsed, unparsed text found at index {pos}"
+            )
+        return self._resolve(fields, s)
+
+    def _parse_text(self, s, pos, field, style, fields) -> int:
+        if field == "monthname":
+            table = MONTHS_FULL if style == "full" else MONTHS_SHORT
+            key = "month"
+        elif field == "dayname":
+            table = DAYS_FULL if style == "full" else DAYS_SHORT
+            key = "dayofweek"
+        else:  # ampm
+            table = ["AM", "PM"] if style == "upper" else ["am", "pm"]
+            key = "ampm"
+        low = s[pos:].lower()
+        for idx, name in enumerate(table):
+            if low.startswith(name.lower()):
+                fields[key] = idx + 1 if key == "month" else idx
+                return pos + len(name)
+        raise TimestampParseError(f"Text '{s}' could not be parsed at index {pos}")
+
+    def _parse_offset(self, s, pos, fields, colon: bool) -> int:
+        if colon and pos < len(s) and s[pos] in "zZ":
+            fields["offset"] = 0
+            return pos + 1
+        m = re.match(r"([+-])([0-9]{2}):?([0-9]{2})", s[pos:])
+        if not m:
+            raise TimestampParseError(f"Text '{s}' could not be parsed at index {pos}")
+        sign = -1 if m.group(1) == "-" else 1
+        fields["offset"] = sign * (int(m.group(2)) * 3600 + int(m.group(3)) * 60)
+        return pos + m.end()
+
+    def _parse_zonetext(self, s, pos, fields) -> int:
+        m = re.match(r"[A-Za-z_/+\-0-9]+", s[pos:])
+        if not m:
+            raise TimestampParseError(f"Text '{s}' could not be parsed at index {pos}")
+        name = m.group(0)
+        zone = _ZONE_ABBREVIATIONS.get(name.upper(), name)
+        try:
+            from zoneinfo import ZoneInfo
+
+            ZoneInfo(zone)
+        except Exception:
+            raise TimestampParseError(
+                f"Text '{s}' could not be parsed: unknown zone '{name}'"
+            ) from None
+        fields["zone"] = zone
+        return pos + m.end()
+
+    # -- resolution ------------------------------------------------------
+
+    def _resolve(self, fields: dict, original: str) -> ParsedTimestamp:
+        zone_name = fields.get("zone")
+        offset = fields.get("offset")
+        if zone_name is None and offset is None and self.default_zone is not None:
+            zone_name = self.default_zone
+
+        if "epoch" in fields:
+            epoch_s = fields["epoch"]
+            epoch_millis = epoch_s * 1000
+            off = offset if offset is not None else 0
+            tz = _dt.timezone(_dt.timedelta(seconds=off))
+            local = _dt.datetime.fromtimestamp(epoch_s, tz=tz)
+            return ParsedTimestamp(
+                local.year, local.month, local.day, local.hour, local.minute,
+                local.second, 0, off, zone_name if offset is None else None,
+                epoch_millis,
+            )
+
+        year = fields.get("year")
+        if year is None and "year2" in fields:
+            year = 2000 + fields["year2"]
+        if year is None and "wby" in fields and "isoweek" in fields:
+            # Week-based date (%G/%V/%u)
+            wby = fields["wby"]
+            week = fields["isoweek"]
+            dow = fields.get("isodow", 1)
+            d = _dt.date.fromisocalendar(wby, week, dow)
+            year, month, day = d.year, d.month, d.day
+        else:
+            month = fields.get("month")
+            day = fields.get("day")
+            if year is not None and month is None and "doy" in fields:
+                d = _dt.date(year, 1, 1) + _dt.timedelta(days=fields["doy"] - 1)
+                month, day = d.month, d.day
+
+        if year is None or month is None or day is None:
+            raise TimestampParseError(
+                f"Unable to obtain a complete date from '{original}'"
+            )
+
+        hour = fields.get("hour")
+        if hour is None and "clock_hour" in fields:
+            ch = fields["clock_hour"]
+            if ch == 24:
+                hour = 0
+            elif ch == 0:
+                # Java CLOCK_HOUR_OF_DAY range is 1-24 (SMART resolver maps
+                # only 24 -> 0); 0 is invalid.
+                raise TimestampParseError(
+                    f"Invalid value for ClockHourOfDay: 0 in '{original}'"
+                )
+            else:
+                hour = ch
+        if hour is None and "hour12" in fields:
+            h12 = fields["hour12"]
+            ampm = fields.get("ampm", 0)
+            hour = (h12 % 12) + (12 if ampm == 1 else 0)
+        if hour is None:
+            hour = 0
+        minute = fields.get("minute", 0)
+        second = fields.get("second", 0)
+        nano = fields.get("milli", 0) * 1_000_000 + fields.get("micro", 0) * 1_000
+
+        if second == 60:  # leap second: java.time SMART clamps
+            second = 59
+
+        local = _dt.datetime(year, month, day, hour, minute, second,
+                             microsecond=nano // 1000)
+        if zone_name is not None and offset is None:
+            from zoneinfo import ZoneInfo
+
+            tz = ZoneInfo(zone_name)
+            aware = local.replace(tzinfo=tz, fold=0)
+            epoch_millis = int(aware.timestamp() * 1000)
+            real_offset = int(aware.utcoffset().total_seconds())
+            return ParsedTimestamp(year, month, day, hour, minute, second, nano,
+                                   real_offset, zone_name, epoch_millis)
+        off = offset if offset is not None else 0
+        tz = _dt.timezone(_dt.timedelta(seconds=off))
+        aware = local.replace(tzinfo=tz)
+        epoch_millis = int(aware.timestamp() * 1000)
+        return ParsedTimestamp(year, month, day, hour, minute, second, nano,
+                               off, None, epoch_millis)
+
+
+# ---------------------------------------------------------------------------
+# java.time pattern front-end (the subset the reference uses)
+# ---------------------------------------------------------------------------
+
+def compile_java_pattern(pattern: str, default_zone: Optional[str] = None) -> TimeLayout:
+    """Compile the java.time pattern subset used by the reference:
+    d/dd, M/MM/MMM/MMMM, y/yy/yyyy, H/HH, m/mm, s/ss, S/SSS, E/EEE/EEEE,
+    Z/ZZ/ZZZ (+HHMM), X/XX/XXX (+HH:MM, Z), z (zone text), quoted literals.
+    """
+    items: List[Item] = []
+    i = 0
+    n = len(pattern)
+    while i < n:
+        c = pattern[i]
+        if c.isalpha():
+            j = i
+            while j < n and pattern[j] == c:
+                j += 1
+            count = j - i
+            if c == "d":
+                items.append(("num", "day", count, 2, False))
+            elif c == "M":
+                if count >= 4:
+                    items.append(("text", "monthname", "full"))
+                elif count == 3:
+                    items.append(("text", "monthname", "short"))
+                else:
+                    items.append(("num", "month", count, 2, False))
+            elif c == "y":
+                if count == 2:
+                    items.append(("num", "year2", 2, 2, False))
+                else:
+                    items.append(("num", "year", count, 4, False))
+            elif c == "H":
+                items.append(("num", "hour", count, 2, False))
+            elif c == "h":
+                items.append(("num", "hour12", count, 2, False))
+            elif c == "m":
+                items.append(("num", "minute", count, 2, False))
+            elif c == "s":
+                items.append(("num", "second", count, 2, False))
+            elif c == "S":
+                items.append(("num", "milli", count, count, False))
+            elif c == "E":
+                items.append(("text", "dayname", "full" if count >= 4 else "short"))
+            elif c == "a":
+                items.append(("text", "ampm", "upper"))
+            elif c == "Z":
+                items.append(("offset",))
+            elif c == "X":
+                items.append(("offset_colon",))
+            elif c == "z":
+                items.append(("zonetext",))
+            elif c == "T":  # bare T appears unquoted in some patterns
+                items.append(("lit", "T"))
+            else:
+                raise ValueError(f"Unsupported pattern letter '{c}' in {pattern!r}")
+            i = j
+        elif c == "'":
+            j = i + 1
+            lit = []
+            while j < n:
+                if pattern[j] == "'":
+                    if j + 1 < n and pattern[j + 1] == "'":
+                        lit.append("'")
+                        j += 2
+                        continue
+                    break
+                lit.append(pattern[j])
+                j += 1
+            items.append(("lit", "".join(lit) if lit else "'"))
+            i = j + 1
+        else:
+            items.append(("lit", c))
+            i += 1
+
+    # Merge adjacent literals for faster parsing.
+    merged: List[Item] = []
+    for it in items:
+        if it[0] == "lit" and merged and merged[-1][0] == "lit":
+            merged[-1] = ("lit", merged[-1][1] + it[1])
+        else:
+            merged.append(list(it) if it[0] == "lit" else it)
+    merged = [tuple(it) if isinstance(it, list) else it for it in merged]
+    return TimeLayout(merged, default_zone)

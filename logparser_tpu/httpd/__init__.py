@@ -1,0 +1,16 @@
+"""Format dialects: Apache HTTPD %-tokens and NGINX $-variables, plus the
+user-facing HttpdLoglineParser facade."""
+from .apache import ApacheHttpdLogFormatDissector, looks_like_apache_format
+from .format_dissector import INPUT_TYPE, HttpdLogFormatDissector
+from .nginx import NginxHttpdLogFormatDissector, looks_like_nginx_format
+from .parser import HttpdLoglineParser
+
+__all__ = [
+    "ApacheHttpdLogFormatDissector",
+    "NginxHttpdLogFormatDissector",
+    "HttpdLogFormatDissector",
+    "HttpdLoglineParser",
+    "looks_like_apache_format",
+    "looks_like_nginx_format",
+    "INPUT_TYPE",
+]

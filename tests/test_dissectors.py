@@ -1,0 +1,332 @@
+"""Per-dissector parity tests; expectations ported from the reference's
+per-dissector test suite (httpdlog-parser/src/test/.../dissectors/)."""
+import pytest
+
+from logparser_tpu.dissectors.firstline import HttpFirstLineDissector
+from logparser_tpu.dissectors.mod_unique_id import ModUniqueIdDissector
+from logparser_tpu.dissectors.query import QueryStringFieldDissector
+from logparser_tpu.dissectors.timestamp import TimeStampDissector
+from logparser_tpu.dissectors.uri import HttpUriDissector
+from logparser_tpu.dissectors.utils import (
+    decode_apache_httpd_log_value,
+    hex_chars_to_byte,
+    resilient_url_decode,
+)
+from logparser_tpu.testing import DissectorTester
+
+
+class TestTimeStampDissector:
+    def test_default_apache_timestamp(self):
+        (
+            DissectorTester.create()
+            .with_dissector(TimeStampDissector())
+            .with_input("31/Dec/2012:23:00:44 -0700")
+            .expect("TIME.EPOCH:epoch", "1357020044000")
+            .expect("TIME.EPOCH:epoch", 1357020044000)
+            .expect("TIME.YEAR:year", "2012")
+            .expect("TIME.MONTH:month", 12)
+            .expect("TIME.MONTHNAME:monthname", "December")
+            .expect("TIME.DAY:day", 31)
+            .expect("TIME.HOUR:hour", 23)
+            .expect("TIME.MINUTE:minute", 0)
+            .expect("TIME.SECOND:second", 44)
+            .expect("TIME.DATE:date", "2012-12-31")
+            .expect("TIME.TIME:time", "23:00:44")
+            .expect("TIME.YEAR:year_utc", 2013)
+            .expect("TIME.MONTH:month_utc", 1)
+            .expect("TIME.MONTHNAME:monthname_utc", "January")
+            .expect("TIME.DAY:day_utc", 1)
+            .expect("TIME.HOUR:hour_utc", 6)
+            .expect("TIME.MINUTE:minute_utc", 0)
+            .expect("TIME.SECOND:second_utc", 44)
+            .expect("TIME.DATE:date_utc", "2013-01-01")
+            .expect("TIME.TIME:time_utc", "06:00:44")
+            .check_expectations()
+        )
+
+    def test_timezone_field_absent(self):
+        """The reference's TIME.ZONE/TIME.TIMEZONE type mismatch makes the
+        timezone field never deliverable (TestTimeStampDissector.java:258)."""
+        (
+            DissectorTester.create()
+            .with_dissector(TimeStampDissector())
+            .with_input("31/Dec/2012:23:00:44 -0700")
+            .expect_absent_string("TIME.ZONE:timezone")
+            .check_expectations()
+        )
+
+    def test_possible_outputs(self):
+        t = DissectorTester.create().with_dissector(TimeStampDissector())
+        for p in [
+            "TIME.EPOCH:epoch", "TIME.YEAR:year", "TIME.MONTH:month",
+            "TIME.MONTHNAME:monthname", "TIME.DAY:day", "TIME.HOUR:hour",
+            "TIME.MINUTE:minute", "TIME.SECOND:second", "TIME.DATE:date",
+            "TIME.TIME:time", "TIME.YEAR:year_utc", "TIME.DATE:date_utc",
+        ]:
+            t.expect_possible(p)
+        t.check_expectations()
+
+
+class TestHttpUri:
+    def _tester(self):
+        return DissectorTester.create().with_dissector(HttpUriDissector())
+
+    def test_full_url_1(self):
+        (
+            self._tester()
+            .with_input("http://www.example.com/some/thing/else/index.html?foofoo=bar%20bar")
+            .expect("HTTP.PROTOCOL:protocol", "http")
+            .expect_null("HTTP.USERINFO:userinfo")
+            .expect("HTTP.HOST:host", "www.example.com")
+            .expect_absent_string("HTTP.PORT:port")
+            .expect("HTTP.PATH:path", "/some/thing/else/index.html")
+            .expect("HTTP.QUERYSTRING:query", "&foofoo=bar%20bar")
+            .expect_null("HTTP.REF:ref")
+            .check_expectations()
+        )
+
+    def test_full_url_2(self):
+        (
+            self._tester()
+            .with_input("http://www.example.com/some/thing/else/index.html&aap=noot?foofoo=barbar&")
+            .expect("HTTP.PATH:path", "/some/thing/else/index.html")
+            .expect("HTTP.QUERYSTRING:query", "&aap=noot&foofoo=barbar&")
+            .check_expectations()
+        )
+
+    def test_full_url_3_port_and_ref(self):
+        (
+            self._tester()
+            .with_input(
+                "http://www.example.com:8080/some/thing/else/index.html&aap=noot?foofoo=barbar&#blabla"
+            )
+            .expect("HTTP.HOST:host", "www.example.com")
+            .expect("HTTP.PORT:port", "8080")
+            .expect("HTTP.PATH:path", "/some/thing/else/index.html")
+            .expect("HTTP.QUERYSTRING:query", "&aap=noot&foofoo=barbar&")
+            .expect("HTTP.REF:ref", "blabla")
+            .check_expectations()
+        )
+
+    def test_relative_url(self):
+        (
+            self._tester()
+            .with_input("/some/thing/else/index.html?foofoo=barbar#blabla")
+            .expect_absent_string("HTTP.PROTOCOL:protocol")
+            .expect_absent_string("HTTP.HOST:host")
+            .expect("HTTP.PATH:path", "/some/thing/else/index.html")
+            .expect("HTTP.QUERYSTRING:query", "&foofoo=barbar")
+            .expect("HTTP.REF:ref", "blabla")
+            .check_expectations()
+        )
+
+    def test_escaped_ref(self):
+        (
+            self._tester()
+            .with_input("/some/thing/else/index.html&aap=noot?foofoo=bar%20bar&#bla%20bla")
+            .expect("HTTP.PATH:path", "/some/thing/else/index.html")
+            .expect("HTTP.QUERYSTRING:query", "&aap=noot&foofoo=bar%20bar&")
+            .expect("HTTP.REF:ref", "bla bla")
+            .check_expectations()
+        )
+
+    def test_android_app(self):
+        (
+            self._tester()
+            .with_input("android-app://com.google.android.googlequicksearchbox")
+            .expect("HTTP.PROTOCOL:protocol", "android-app")
+            .expect("HTTP.HOST:host", "com.google.android.googlequicksearchbox")
+            .expect("HTTP.PATH:path", "")
+            .expect("HTTP.QUERYSTRING:query", "")
+            .expect_null("HTTP.REF:ref")
+            .check_expectations()
+        )
+
+    def test_bad_uri_bracket_and_spaces(self):
+        (
+            self._tester()
+            .with_input("/some/thing/else/[index.html&aap=noot?foofoo=bar%20bar #bla%20bla ")
+            .expect("HTTP.PATH:path", "/some/thing/else/[index.html")
+            .expect("HTTP.QUERYSTRING:query", "&aap=noot&foofoo=bar%20bar%20")
+            .expect("HTTP.REF:ref", "bla bla ")
+            .check_expectations()
+        )
+
+    def test_bad_percent_encoding(self):
+        (
+            self._tester()
+            .with_input(
+                "/index.html&promo=Give-50%-discount&promo=And-do-%Another-Wrong&last=also bad %#bla%20bla "
+            )
+            .expect("HTTP.PATH:path", "/index.html")
+            .expect(
+                "HTTP.QUERYSTRING:query",
+                "&promo=Give-50%25-discount&promo=And-do-%25Another-Wrong&last=also%20bad%20%25",
+            )
+            .expect("HTTP.REF:ref", "bla bla ")
+            .check_expectations()
+        )
+
+    def test_multi_percent_encoding_with_query(self):
+        (
+            self._tester()
+            .with_dissector(QueryStringFieldDissector())
+            .with_input("/index.html?Linkid=%%%3dv(%40Foo)%3d%%%&emcid=B%ar")
+            .expect("HTTP.PATH:path", "/index.html")
+            .expect(
+                "HTTP.QUERYSTRING:query",
+                "&Linkid=%25%25%3dv(%40Foo)%3d%25%25%25&emcid=B%25ar",
+            )
+            .expect("STRING:query.linkid", "%%=v(@Foo)=%%%")
+            .expect_null("HTTP.REF:ref")
+            .check_expectations()
+        )
+
+    @pytest.mark.parametrize(
+        "uri",
+        [
+            "https://www.basjes.nl/#foo#bar#bazz#bla#bla#",
+            "https://www.basjes.nl/path/?s2a=&Referrer=ADV1234#product_title&f=API&subid=?s2a=#product_title&name=12341234",
+            "https://www.basjes.nl/path/?Referrer=ADV1234#&f=API&subid=#&name=12341234",
+            "https://www.basjes.nl/path?sort&#x3D;price&filter&#x3D;new&sortOrder&#x3D;asc",
+            "https://www.basjes.nl/login.html?redirectUrl=https%3A%2F%2Fwww.basjes.nl%2Faccount%2Findex.html"
+            "&_requestid=1234#x3D;12341234&Referrer&#x3D;ENTblablabla",
+        ],
+    )
+    def test_double_hashes(self, uri):
+        (
+            self._tester()
+            .with_input(uri)
+            .expect("HTTP.HOST:host", "www.basjes.nl")
+            .check_expectations()
+        )
+
+
+class TestQueryString:
+    def test_split_cases(self):
+        (
+            DissectorTester.create()
+            .with_dissector(HttpUriDissector())
+            .with_dissector(QueryStringFieldDissector())
+            .with_input("/some/thing/else/index.html&aap=1&noot=&mies&")
+            .expect("HTTP.PATH:path", "/some/thing/else/index.html")
+            .expect("HTTP.QUERYSTRING:query", "&aap=1&noot=&mies&")
+            .expect("STRING:query.aap", "1")
+            .expect("STRING:query.noot", "")
+            .expect("STRING:query.mies", "")
+            .check_expectations()
+        )
+
+
+class TestFirstLine:
+    def test_normal(self):
+        (
+            DissectorTester.create()
+            .with_dissector(HttpFirstLineDissector())
+            .with_input("GET /index.html HTTP/1.1")
+            .expect("HTTP.METHOD:method", "GET")
+            .expect("HTTP.URI:uri", "/index.html")
+            .expect("HTTP.PROTOCOL_VERSION:protocol", "HTTP/1.1")
+            .check_expectations()
+        )
+
+    def test_chopped(self):
+        (
+            DissectorTester.create()
+            .with_dissector(HttpFirstLineDissector())
+            .with_input("GET /veryverylonguri")
+            .expect("HTTP.METHOD:method", "GET")
+            .expect("HTTP.URI:uri", "/veryverylonguri")
+            .expect_null("HTTP.PROTOCOL_VERSION:protocol")
+            .check_expectations()
+        )
+
+    def test_garbage(self):
+        (
+            DissectorTester.create()
+            .with_dissector(HttpFirstLineDissector())
+            .with_input("\\x16\\x03\\x01")
+            .expect_absent_string("HTTP.METHOD:method")
+            .check_expectations()
+        )
+
+
+class TestModUniqueId:
+    def test_decode_1(self):
+        (
+            DissectorTester.create()
+            .with_dissector(ModUniqueIdDissector())
+            .with_input("VaGTKApid0AAALpaNo0AAAAC")
+            .expect("TIME.EPOCH:epoch", "1436652328000")
+            .expect("IP:ip", "10.98.119.64")
+            .expect("PROCESSID:processid", "47706")
+            .expect("COUNTER:counter", "13965")
+            .expect("THREAD_INDEX:threadindex", "2")
+            .check_expectations()
+        )
+
+    def test_decode_2(self):
+        (
+            DissectorTester.create()
+            .with_dissector(ModUniqueIdDissector())
+            .with_input("Ucdv38CoEJwAAEusp6EAAADz")
+            .expect("TIME.EPOCH:epoch", "1372024799000")
+            .expect("IP:ip", "192.168.16.156")
+            .expect("PROCESSID:processid", "19372")
+            .expect("COUNTER:counter", "42913")
+            .expect("THREAD_INDEX:threadindex", "243")
+            .check_expectations()
+        )
+
+    @pytest.mark.parametrize(
+        "bad", ["Ucdv38CoEJwAAEusp6EAAAD", "Ucdv38CoEJwAAEusp6EAAAD!"]
+    )
+    def test_bad_input(self, bad):
+        (
+            DissectorTester.create()
+            .with_dissector(ModUniqueIdDissector())
+            .with_input(bad)
+            .expect_absent_string("TIME.EPOCH:epoch")
+            .expect_absent_string("IP:ip")
+            .check_expectations()
+        )
+
+
+class TestUtils:
+    def test_resilient_url_decode(self):
+        # UtilsTest.java:25-48
+        assert resilient_url_decode("  ") == "  "
+        assert resilient_url_decode(" %20") == "  "
+        assert resilient_url_decode("%20 ") == "  "
+        assert resilient_url_decode("%20%20") == "  "
+        assert resilient_url_decode("%u0020%u0020") == "  "
+        assert resilient_url_decode("%20%u0020") == "  "
+        assert resilient_url_decode("%u0020%20") == "  "
+        assert resilient_url_decode("x %2") == "x "
+        assert resilient_url_decode("x%20%2") == "x "
+        assert resilient_url_decode("x%u202") == "x"
+        assert resilient_url_decode("x%u20") == "x"
+        assert resilient_url_decode("x%u2") == "x"
+        assert resilient_url_decode("x%u") == "x"
+        assert resilient_url_decode("x%") == "x"
+        assert resilient_url_decode("%20 %20%u0020%20 %20%2") == "       "
+
+    def test_hex_chars_to_byte(self):
+        assert hex_chars_to_byte("1", "1") == 0x11
+        assert hex_chars_to_byte("f", "f") == 0xFF
+        assert hex_chars_to_byte("A", "A") == 0xAA
+        with pytest.raises(ValueError):
+            hex_chars_to_byte("X", "0")
+        with pytest.raises(ValueError):
+            hex_chars_to_byte("0", "X")
+
+    def test_decode_apache_log_value(self):
+        # UtilsTest.java:90-99
+        assert decode_apache_httpd_log_value("bla bla bla") == "bla bla bla"
+        assert decode_apache_httpd_log_value("bla\\x20bla bla") == "bla bla bla"
+        assert decode_apache_httpd_log_value("bla\\bbla\\nbla\\tbla") == "bla\bbla\nbla\tbla"
+        assert decode_apache_httpd_log_value('bla\\"bla\\nbla\\tbla') == 'bla"bla\nbla\tbla'
+        assert decode_apache_httpd_log_value("\\v") == "\x0b"
+        assert decode_apache_httpd_log_value("\\q") == "\\q"
+        assert decode_apache_httpd_log_value("") == ""
+        assert decode_apache_httpd_log_value(None) is None

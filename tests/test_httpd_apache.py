@@ -1,0 +1,252 @@
+"""Format-level integration tests for the Apache dialect.
+
+Parity data (input lines and expected field values) ported from the reference
+suite: httpdlog-parser/src/test/.../ApacheHttpdLogParserTest.java fullTest1/2,
+EdgeCasesTest, and the per-dissector tests.  The assertions here are the
+bit-exactness contract for the host (oracle) path.
+"""
+import pytest
+
+from logparser_tpu.core import Parser, field
+from logparser_tpu.dissectors.screenres import ScreenResolutionDissector
+from logparser_tpu.httpd import HttpdLoglineParser
+
+
+class MapRecord:
+    def __init__(self):
+        self.results = {}
+
+    def set_value(self, name: str, value: str):
+        self.results[name] = value
+
+
+FULL_FIELDS = [
+    "STRING:request.firstline.uri.query.*",
+    "STRING:request.querystring.aap",
+    "IP:connection.client.ip",
+    "NUMBER:connection.client.logname",
+    "STRING:connection.client.user",
+    "TIME.STAMP:request.receive.time",
+    "TIME.SECOND:request.receive.time.second",
+    "HTTP.URI:request.firstline.uri",
+    "STRING:request.status.last",
+    "BYTESCLF:response.body.bytes",
+    "HTTP.URI:request.referer",
+    "STRING:request.referer.query.mies",
+    "STRING:request.referer.query.wim",
+    "HTTP.USERAGENT:request.user-agent",
+    "TIME.DAY:request.receive.time.day",
+    "TIME.HOUR:request.receive.time.hour",
+    "TIME.MONTHNAME:request.receive.time.monthname",
+    "TIME.EPOCH:request.receive.time.epoch",
+    "TIME.WEEK:request.receive.time.weekofweekyear",
+    "TIME.YEAR:request.receive.time.weekyear",
+    "TIME.YEAR:request.receive.time.year",
+    "HTTP.COOKIES:request.cookies",
+    "HTTP.SETCOOKIES:response.cookies",
+    "HTTP.COOKIE:request.cookies.jquery-ui-theme",
+    "HTTP.SETCOOKIE:response.cookies.apache",
+    "STRING:response.cookies.apache.domain",
+    "MICROSECONDS:response.server.processing.time",
+    "HTTP.HEADER:response.header.etag",
+]
+
+# "fullcombined" with modifiers that must be stripped
+LOG_FORMAT = (
+    '%%%h %a %A %l %u %t "%r" %>s %b %p "%q" "%!200,304,302{Referer}i" %D '
+    '"%200{User-agent}i" "%{Cookie}i" "%{Set-Cookie}o" "%{If-None-Match}i" "%{Etag}o"'
+)
+
+
+def make_full_parser():
+    parser = HttpdLoglineParser(MapRecord, LOG_FORMAT)
+    parser.add_parse_target("set_value", FULL_FIELDS)
+    return parser
+
+
+class TestFullFormat:
+    def test_full_1(self):
+        line = (
+            "%127.0.0.1 127.0.0.1 127.0.0.1 - - [31/Dec/2012:23:49:40 +0100] "
+            '"GET /icons/powered_by_rh.png?aap=noot&res=1024x768 HTTP/1.1" 200 1213 '
+            '80 "" "http://localhost/index.php?mies=wim" 351 '
+            '"Mozilla/5.0 (X11; Linux i686 on x86_64; rv:11.0) Gecko/20100101 Firefox/11.0" '
+            '"jquery-ui-theme=Eggplant" "Apache=127.0.0.1.1344635380111339; path=/; domain=.basjes.nl" "-" '
+            '"\\"3780ff-4bd-4c1ce3df91380\\""'
+        )
+        parser = make_full_parser()
+        parser.add_dissector(ScreenResolutionDissector())
+        parser.add_type_remapping("request.firstline.uri.query.res", "SCREENRESOLUTION")
+        parser.add_parse_target(
+            "set_value",
+            [
+                "SCREENWIDTH:request.firstline.uri.query.res.width",
+                "SCREENHEIGHT:request.firstline.uri.query.res.height",
+            ],
+        )
+        record = parser.parse(line, MapRecord())
+        r = record.results
+
+        assert r["STRING:request.firstline.uri.query.aap"] == "noot"
+        assert "STRING:request.firstline.uri.query.foo" not in r
+        assert r.get("STRING:request.querystring.aap") is None
+        assert r["SCREENWIDTH:request.firstline.uri.query.res.width"] == "1024"
+        assert r["SCREENHEIGHT:request.firstline.uri.query.res.height"] == "768"
+
+        assert r["IP:connection.client.ip"] == "127.0.0.1"
+        assert r["NUMBER:connection.client.logname"] is None
+        assert r["STRING:connection.client.user"] is None
+        assert r["TIME.STAMP:request.receive.time"] == "31/Dec/2012:23:49:40 +0100"
+        assert r["TIME.EPOCH:request.receive.time.epoch"] == "1356994180000"
+        assert r["TIME.WEEK:request.receive.time.weekofweekyear"] == "1"
+        assert r["TIME.YEAR:request.receive.time.weekyear"] == "2013"
+        assert r["TIME.YEAR:request.receive.time.year"] == "2012"
+        assert r["TIME.SECOND:request.receive.time.second"] == "40"
+        assert (
+            r["HTTP.URI:request.firstline.uri"]
+            == "/icons/powered_by_rh.png?aap=noot&res=1024x768"
+        )
+        assert r["STRING:request.status.last"] == "200"
+        assert r["BYTESCLF:response.body.bytes"] == "1213"
+        assert r["HTTP.URI:request.referer"] == "http://localhost/index.php?mies=wim"
+        assert r["STRING:request.referer.query.mies"] == "wim"
+        assert r["HTTP.USERAGENT:request.user-agent"] == (
+            "Mozilla/5.0 (X11; Linux i686 on x86_64; rv:11.0) Gecko/20100101 Firefox/11.0"
+        )
+        assert r["TIME.DAY:request.receive.time.day"] == "31"
+        assert r["TIME.HOUR:request.receive.time.hour"] == "23"
+        assert r["TIME.MONTHNAME:request.receive.time.monthname"] == "December"
+        assert r["MICROSECONDS:response.server.processing.time"] == "351"
+        assert r["HTTP.SETCOOKIES:response.cookies"] == (
+            "Apache=127.0.0.1.1344635380111339; path=/; domain=.basjes.nl"
+        )
+        assert r["HTTP.COOKIES:request.cookies"] == "jquery-ui-theme=Eggplant"
+        assert r["HTTP.HEADER:response.header.etag"] == '\\"3780ff-4bd-4c1ce3df91380\\"'
+        assert r["HTTP.COOKIE:request.cookies.jquery-ui-theme"] == "Eggplant"
+        assert r["HTTP.SETCOOKIE:response.cookies.apache"] == (
+            "Apache=127.0.0.1.1344635380111339; path=/; domain=.basjes.nl"
+        )
+        assert r["STRING:response.cookies.apache.domain"] == ".basjes.nl"
+
+    def test_full_2(self):
+        line = (
+            "%127.0.0.1 127.0.0.1 127.0.0.1 - - [10/Aug/2012:23:55:11 +0200] "
+            '"GET /icons/powered_by_rh.png HTTP/1.1" 200 1213 80'
+            ' "" "http://localhost/" 1306 "Mozilla/5.0 (X11; Linux i686 on x86_64; rv:11.0) Gecko/20100101 Firefox/11.0"'
+            ' "jquery-ui-theme=Eggplant; Apache=127.0.0.1.1344635667182858" "-" "-" "\\"3780ff-4bd-4c1ce3df91380\\""'
+        )
+        parser = make_full_parser()
+        record = parser.parse(line, MapRecord())
+        r = record.results
+
+        assert r["IP:connection.client.ip"] == "127.0.0.1"
+        assert r["NUMBER:connection.client.logname"] is None
+        assert r["STRING:connection.client.user"] is None
+        assert r["TIME.STAMP:request.receive.time"] == "10/Aug/2012:23:55:11 +0200"
+        assert r["TIME.SECOND:request.receive.time.second"] == "11"
+        assert r["HTTP.URI:request.firstline.uri"] == "/icons/powered_by_rh.png"
+        assert r["STRING:request.status.last"] == "200"
+        assert r["BYTESCLF:response.body.bytes"] == "1213"
+        assert r["HTTP.URI:request.referer"] == "http://localhost/"
+        assert r["TIME.DAY:request.receive.time.day"] == "10"
+        assert r["TIME.HOUR:request.receive.time.hour"] == "23"
+        assert r["TIME.MONTHNAME:request.receive.time.monthname"] == "August"
+        assert r["MICROSECONDS:response.server.processing.time"] == "1306"
+        assert r.get("HTTP.SETCOOKIES:response.cookies") is None
+        assert r["HTTP.COOKIES:request.cookies"] == (
+            "jquery-ui-theme=Eggplant; Apache=127.0.0.1.1344635667182858"
+        )
+        assert r["HTTP.HEADER:response.header.etag"] == '\\"3780ff-4bd-4c1ce3df91380\\"'
+
+
+class TestNamedFormats:
+    @pytest.mark.parametrize("name", ["common", "combined", "combinedio"])
+    def test_named_formats_resolve(self, name):
+        class Rec:
+            def __init__(self):
+                self.ip = None
+
+            @field("IP:connection.client.host")
+            def set_ip(self, value: str):
+                self.ip = value
+
+        suffix = {
+            "common": "",
+            "combined": ' "http://ref/" "UA"',
+            "combinedio": ' "http://ref/" "UA" 100 200',
+        }[name]
+        line = (
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET / HTTP/1.1" 200 5' + suffix
+        )
+        rec = HttpdLoglineParser(Rec, name).parse(line)
+        assert rec.ip == "1.2.3.4"
+
+
+class TestEdgeCases:
+    def test_garbage_firstline_not_decoded(self):
+        """EdgeCasesTest.java:28-51 — the \\xhh content of %r stays UNDECODED
+        (faithful replication of the reference's value-vs-name condition)."""
+        line = (
+            '1.2.3.4 - - [03/Apr/2017:03:27:28 -0600] "\\x16\\x03\\x01" 404 419 '
+            '"-" "-" - 115052 5.6.7.8'
+        )
+        log_format = '%h %l %u %t "%r" %>s %b "%{Referer}i" "%{User-Agent}i" %L %I %a'
+
+        class Rec(MapRecord):
+            pass
+
+        p = HttpdLoglineParser(Rec, log_format)
+        p.add_parse_target("set_value", ["HTTP.FIRSTLINE:request.firstline"])
+        rec = p.parse(line, Rec())
+        assert rec.results["HTTP.FIRSTLINE:request.firstline"] == "\\x16\\x03\\x01"
+
+    def test_dash_becomes_null(self):
+        class Rec(MapRecord):
+            pass
+
+        p = HttpdLoglineParser(Rec, "combined")
+        p.add_parse_target("set_value", ["BYTESCLF:response.body.bytes"])
+        rec = p.parse(
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET / HTTP/1.1" 200 - "-" "-"',
+            Rec(),
+        )
+        assert rec.results["BYTESCLF:response.body.bytes"] is None
+
+    def test_multiline_formats_switch(self):
+        """Two formats registered; lines of either shape parse."""
+
+        class Rec(MapRecord):
+            pass
+
+        p = HttpdLoglineParser(Rec, "common\ncombined")
+        p.add_parse_target("set_value", ["STRING:request.status.last"])
+        r1 = p.parse(
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET / HTTP/1.1" 200 5', Rec()
+        )
+        r2 = p.parse(
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET / HTTP/1.1" 302 5 "r" "ua"',
+            Rec(),
+        )
+        assert r1.results["STRING:request.status.last"] == "200"
+        assert r2.results["STRING:request.status.last"] == "302"
+
+
+class TestDiscovery:
+    def test_possible_paths_cover_combined(self):
+        p = HttpdLoglineParser(MapRecord, "combined")
+        paths = p.get_possible_paths()
+        for expected in [
+            "IP:connection.client.host",
+            "TIME.STAMP:request.receive.time",
+            "TIME.EPOCH:request.receive.time.epoch",
+            "HTTP.FIRSTLINE:request.firstline",
+            "HTTP.METHOD:request.firstline.method",
+            "HTTP.URI:request.firstline.uri",
+            "HTTP.QUERYSTRING:request.firstline.uri.query",
+            "STRING:request.firstline.uri.query.*",
+            "HTTP.USERAGENT:request.user-agent",
+            "HTTP.URI:request.referer",
+            "BYTESCLF:response.body.bytes",
+            "BYTES:response.body.bytes",
+        ]:
+            assert expected in paths, f"missing {expected}"
