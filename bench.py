@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Benchmark: Apache `combined` log dissection throughput on one chip.
+
+Metric of record (BASELINE.md): loglines/sec/chip on Apache `combined` and
+p99 parse latency @ batch=64k.  The reference publishes no numbers
+(BASELINE.json "published": {}), so vs_baseline is measured against this
+repo's own host oracle (the per-line engine that is parity-tested against the
+reference's semantics) on the same machine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+BATCH = 65536
+WARMUP_ITERS = 2
+ITERS = 10
+ORACLE_SAMPLE = 2000
+
+FIELDS = [
+    "IP:connection.client.host",
+    "STRING:connection.client.user",
+    "TIME.EPOCH:request.receive.time.epoch",
+    "HTTP.METHOD:request.firstline.method",
+    "HTTP.URI:request.firstline.uri",
+    "STRING:request.status.last",
+    "BYTES:response.body.bytes",
+    "HTTP.URI:request.referer",
+    "HTTP.USERAGENT:request.user-agent",
+]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from logparser_tpu.tools.demolog import generate_combined_lines
+    from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+    from logparser_tpu.tpu.runtime import encode_batch
+
+    device = jax.devices()[0]
+
+    lines = generate_combined_lines(BATCH, seed=42)
+    parser = TpuBatchParser("combined", FIELDS)
+    buf, lengths, _ = encode_batch(lines)
+
+    fn = parser._jitted
+    jbuf = jnp.asarray(buf)
+    jlengths = jnp.asarray(lengths)
+
+    # Warmup / compile.
+    for _ in range(WARMUP_ITERS):
+        out = fn(jbuf, jlengths)
+        jax.block_until_ready(out)
+
+    # Throughput: fused device program (skeleton split + numeric + epoch +
+    # firstline post-stages) including H2D transfer of the byte buffer.
+    latencies = []
+    t_total0 = time.perf_counter()
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        out = fn(jnp.asarray(buf), jnp.asarray(lengths))
+        jax.block_until_ready(out)
+        latencies.append(time.perf_counter() - t0)
+    t_total = time.perf_counter() - t_total0
+
+    lines_per_sec = BATCH * ITERS / t_total
+    p99_ms = float(np.percentile(np.array(latencies), 99) * 1000)
+
+    # Host oracle baseline (per-line engine) on a sample.
+    oracle = parser.oracle
+    sample = lines[:ORACLE_SAMPLE]
+    t0 = time.perf_counter()
+    for line in sample:
+        oracle.parse(line, _CollectingRecord())
+    oracle_secs = time.perf_counter() - t0
+    oracle_lines_per_sec = ORACLE_SAMPLE / oracle_secs
+
+    print(json.dumps({
+        "metric": "loglines/sec/chip (Apache combined)",
+        "value": round(lines_per_sec, 1),
+        "unit": "lines/sec",
+        "vs_baseline": round(lines_per_sec / oracle_lines_per_sec, 2),
+        "p99_batch_latency_ms": round(p99_ms, 2),
+        "batch": BATCH,
+        "fields": len(FIELDS),
+        "device": str(device),
+        "host_oracle_lines_per_sec": round(oracle_lines_per_sec, 1),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Device-mesh parallel execution (DP over batch, SP over line length)."""
+from .mesh import (
+    aggregate_counters,
+    data_parallel_runner,
+    make_mesh,
+    sequence_parallel_runner,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_parallel_runner",
+    "sequence_parallel_runner",
+    "aggregate_counters",
+]

@@ -1,0 +1,15 @@
+"""TPU batch execution path: device split programs, vectorized post-stages,
+and the columnar batch API."""
+from .batch import BatchResult, TpuBatchParser
+from .program import DeviceProgram, UnsupportedFormatError, compile_device_program
+from .runtime import encode_batch, run_program
+
+__all__ = [
+    "BatchResult",
+    "TpuBatchParser",
+    "DeviceProgram",
+    "UnsupportedFormatError",
+    "compile_device_program",
+    "encode_batch",
+    "run_program",
+]

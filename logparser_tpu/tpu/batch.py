@@ -1,0 +1,350 @@
+"""The batch parsing API: ``TpuBatchParser.parse_batch(lines) -> BatchResult``.
+
+This is the product hot path (SURVEY §7: "compile the LogFormat to a static
+field-extraction program, execute it over [B, L] uint8 batches on TPU").
+Strings never leave the device as Python strings: string-typed fields are
+(offset, length) span columns into the input buffer; numeric/epoch fields are
+int32-limb columns decoded on device and combined to int64 on the host.
+
+The split program AND all requested post-stages (numeric parse, timestamp ->
+epoch, first-line split) trace into ONE jitted function per parser — a single
+fused XLA computation per (B, L) shape bucket; batch and line length are both
+padded to power-of-two buckets so recompilation is bounded.
+
+The host oracle (the exact per-line engine in logparser_tpu.core/httpd)
+handles lines the optimistic device split rejects (including multi-format
+switching) and requested fields outside the device-resolvable set (wildcards,
+URI repair, cookies, ...), so the combined result is bit-exact with the
+reference semantics at batch throughput for the common case.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.exceptions import DissectionFailure
+from ..core.fields import cleanup_field_value
+from ..httpd.parser import HttpdLoglineParser
+from .program import (
+    CS_CLF_DIGITS,
+    CS_DIGITS,
+    DeviceProgram,
+    UnsupportedFormatError,
+    compile_device_program,
+)
+from .runtime import _run_program_impl, encode_batch
+from . import postproc
+
+_NUMERIC_KINDS = {"long", "long_clf_null", "long_clf_zero", "epoch"}
+
+
+@dataclass
+class _FieldPlan:
+    field_id: str                 # cleaned "TYPE:path"
+    kind: str                     # span | long | long_clf_null | long_clf_zero
+    #                             | epoch | fl_method | fl_uri | fl_protocol | host
+    token_index: int = -1
+
+
+class _CollectingRecord:
+    """Host-fallback record capturing every delivered value by field id."""
+
+    def __init__(self) -> None:
+        self.values: Dict[str, Any] = {}
+
+    def set_value(self, name: str, value) -> None:
+        self.values[name] = value
+
+
+class BatchResult:
+    """Columnar parse result over one batch."""
+
+    def __init__(self, lines, buf, lengths, valid, columns, overrides, good, bad):
+        self._lines = lines
+        self.buf = buf                  # np [B, L] uint8
+        self.lengths = lengths
+        self.valid = valid              # np [B] bool: overall line validity
+        self._columns = columns         # field_id -> dict of arrays (per kind)
+        self._overrides = overrides     # field_id -> {row: python value}
+        self.lines_read = len(lines)
+        self.good_lines = good
+        self.bad_lines = bad
+
+    def field_ids(self) -> List[str]:
+        return list(self._columns.keys())
+
+    def column(self, field_id: str) -> Dict[str, np.ndarray]:
+        """Raw column arrays: spans have starts/ends; numerics have values +
+        null mask."""
+        return self._columns[cleanup_field_value(field_id)]
+
+    def to_pylist(self, field_id: str) -> List[Any]:
+        """Materialize one column as Python values (strings/ints/None)."""
+        field_id = cleanup_field_value(field_id)
+        col = self._columns[field_id]
+        overrides = self._overrides.get(field_id, {})
+        out: List[Any] = []
+        kind = col["kind"]
+        for i in range(self.lines_read):
+            if i in overrides:
+                out.append(overrides[i])
+                continue
+            if not self.valid[i] or not col["ok"][i]:
+                out.append(None)
+                continue
+            if kind in _NUMERIC_KINDS:
+                if col["null"][i]:
+                    out.append(0 if kind == "long_clf_zero" else None)
+                else:
+                    out.append(int(col["values"][i]))
+            else:
+                start, end = int(col["starts"][i]), int(col["ends"][i])
+                raw = bytes(self.buf[i, start:end])
+                if raw == b"-":
+                    out.append(None)  # decode_extracted_value: '-' -> null
+                else:
+                    out.append(raw.decode("utf-8", errors="replace"))
+        return out
+
+    def to_dict(self) -> Dict[str, List[Any]]:
+        return {fid: self.to_pylist(fid) for fid in self._columns}
+
+
+def _bucket_batch(b: int, minimum: int = 64) -> int:
+    size = minimum
+    while size < b:
+        size *= 2
+    return size
+
+
+class TpuBatchParser:
+    """Compiles one LogFormat + requested fields into a fused device function
+    and a host-fallback parser."""
+
+    def __init__(
+        self,
+        log_format: str,
+        fields: Sequence[str],
+        timestamp_format: Optional[str] = None,
+    ):
+        self.log_format = log_format
+        self.requested = [cleanup_field_value(f) for f in fields]
+
+        # Host oracle parser (also the metadata source).
+        self.oracle = HttpdLoglineParser(_CollectingRecord, log_format, timestamp_format)
+        self.oracle.add_parse_target("set_value", list(self.requested))
+        self.oracle.assemble_dissectors()
+
+        # Device program for the FIRST registered format; other formats are
+        # host-fallback territory (multi-format batches run the switch logic
+        # per invalid line).
+        fmt = self.oracle.all_dissectors[0]
+        dissectors = getattr(fmt, "dissectors", [fmt])
+        self.program: Optional[DeviceProgram]
+        try:
+            self.program = compile_device_program(dissectors[0])
+        except UnsupportedFormatError:
+            self.program = None
+
+        self.plans: List[_FieldPlan] = [self._resolve(fid) for fid in self.requested]
+        self.plan_by_id = {p.field_id: p for p in self.plans}
+        self.host_fields = [p.field_id for p in self.plans if p.kind == "host"]
+        self._jitted = (
+            jax.jit(self._device_fn) if self.program is not None else None
+        )
+
+    # ------------------------------------------------------------------
+
+    def _resolve(self, field_id: str) -> _FieldPlan:
+        if self.program is None:
+            return _FieldPlan(field_id, "host")
+        ftype, _, path = field_id.partition(":")
+        for tok in self.program.tokens:
+            for out_type, out_name in tok.outputs:
+                if out_name == path:
+                    if out_type == ftype:
+                        if tok.charset == CS_DIGITS:
+                            return _FieldPlan(field_id, "long", tok.index)
+                        if tok.charset == CS_CLF_DIGITS:
+                            return _FieldPlan(field_id, "long_clf_null", tok.index)
+                        return _FieldPlan(field_id, "span", tok.index)
+                    # CLF -> number translator edge (BYTESCLF token, BYTES asked)
+                    if out_type == "BYTESCLF" and ftype == "BYTES":
+                        return _FieldPlan(field_id, "long_clf_zero", tok.index)
+                elif path.startswith(out_name + "."):
+                    suffix = path[len(out_name) + 1 :]
+                    if out_type == "TIME.STAMP" and ftype == "TIME.EPOCH" and suffix == "epoch":
+                        return _FieldPlan(field_id, "epoch", tok.index)
+                    if out_type == "HTTP.FIRSTLINE":
+                        if ftype == "HTTP.METHOD" and suffix == "method":
+                            return _FieldPlan(field_id, "fl_method", tok.index)
+                        if ftype == "HTTP.URI" and suffix == "uri":
+                            return _FieldPlan(field_id, "fl_uri", tok.index)
+                        if ftype == "HTTP.PROTOCOL_VERSION" and suffix == "protocol":
+                            return _FieldPlan(field_id, "fl_protocol", tok.index)
+        return _FieldPlan(field_id, "host")
+
+    # ------------------------------------------------------------------
+    # The fused device computation (traced once per input shape).
+    # ------------------------------------------------------------------
+
+    def _device_fn(self, buf: jnp.ndarray, lengths: jnp.ndarray):
+        res = _run_program_impl(self.program, buf, lengths)
+        starts, ends, valid = res["starts"], res["ends"], res["valid"]
+        out: Dict[str, Any] = {"valid": valid, "starts": starts, "ends": ends}
+
+        fl_cache: Dict[int, Dict[str, jnp.ndarray]] = {}
+        cols: Dict[str, Any] = {}
+        for plan in self.plans:
+            if plan.kind in ("host", "span"):
+                continue
+            t_start = starts[plan.token_index]
+            t_end = ends[plan.token_index]
+            if plan.kind in ("long", "long_clf_null", "long_clf_zero"):
+                limbs, is_null, ok = postproc.parse_long_spans(
+                    buf, t_start, t_end, clf=plan.kind != "long"
+                )
+                cols[plan.field_id] = (limbs, is_null, ok)
+            elif plan.kind == "epoch":
+                parts, ok = postproc.parse_apache_timestamp(buf, t_start, t_end)
+                cols[plan.field_id] = (parts, ok)
+            elif plan.kind in ("fl_method", "fl_uri", "fl_protocol"):
+                if plan.token_index not in fl_cache:
+                    fl_cache[plan.token_index] = postproc.split_firstline(
+                        buf, lengths, t_start, t_end
+                    )
+                fl = fl_cache[plan.token_index]
+                part = plan.kind[3:]
+                if part == "protocol":
+                    ok = fl["ok"] & fl["has_protocol"]
+                    s, e = fl["proto_start"], fl["proto_end"]
+                else:
+                    ok = fl["ok"]
+                    s, e = fl[f"{part}_start"], fl[f"{part}_end"]
+                cols[plan.field_id] = (s, e, ok)
+        out["cols"] = cols
+        return out
+
+    # ------------------------------------------------------------------
+
+    def parse_batch(self, lines: Sequence[Union[bytes, str]]) -> BatchResult:
+        B = len(lines)
+        buf, lengths, overflow = encode_batch(lines)
+        # Pad the batch dimension to a bucket so jit recompiles stay bounded.
+        padded_b = _bucket_batch(B)
+        if padded_b != B:
+            buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
+            lengths = np.pad(lengths, (0, padded_b - B))
+
+        columns: Dict[str, Dict[str, np.ndarray]] = {}
+        ones = np.ones(B, dtype=bool)
+        zeros_null = np.zeros(B, dtype=bool)
+
+        if self._jitted is not None:
+            dev = self._jitted(jnp.asarray(buf), jnp.asarray(lengths))
+            dev = jax.device_get(dev)
+            valid = np.array(dev["valid"][:B])
+            starts = dev["starts"][:, :B]
+            ends = dev["ends"][:, :B]
+            dev_cols = dev["cols"]
+        else:
+            valid = np.zeros(B, dtype=bool)
+            starts = ends = np.zeros((1, B), dtype=np.int32)
+            dev_cols = {}
+        for i in overflow:
+            valid[i] = False
+
+        for plan in self.plans:
+            if plan.kind == "host":
+                columns[plan.field_id] = {
+                    "kind": "span",
+                    "starts": np.zeros(B, dtype=np.int32),
+                    "ends": np.zeros(B, dtype=np.int32),
+                    "ok": np.zeros(B, dtype=bool),
+                    "null": zeros_null,
+                }
+            elif plan.kind == "span":
+                columns[plan.field_id] = {
+                    "kind": "span",
+                    "starts": starts[plan.token_index],
+                    "ends": ends[plan.token_index],
+                    "ok": ones,
+                    "null": zeros_null,
+                }
+            else:
+                packed = dev_cols[plan.field_id]
+                if plan.kind in ("long", "long_clf_null", "long_clf_zero"):
+                    (hi, lo, lo_digits), is_null, ok = packed
+                    is_null = np.asarray(is_null)[:B]
+                    columns[plan.field_id] = {
+                        "kind": plan.kind,
+                        "values": postproc.combine_long_limbs(
+                            hi[:B], lo[:B], lo_digits[:B], is_null
+                        ),
+                        "null": is_null,
+                        "ok": np.asarray(ok)[:B],
+                    }
+                elif plan.kind == "epoch":
+                    (days, sec_of_day), ok = packed
+                    columns[plan.field_id] = {
+                        "kind": "epoch",
+                        "values": postproc.combine_epoch(days[:B], sec_of_day[:B]),
+                        "null": zeros_null,
+                        "ok": np.asarray(ok)[:B],
+                    }
+                else:  # span (firstline parts)
+                    s, e, ok = packed
+                    columns[plan.field_id] = {
+                        "kind": "span",
+                        "starts": np.asarray(s)[:B],
+                        "ends": np.asarray(e)[:B],
+                        "ok": np.asarray(ok)[:B],
+                        "null": zeros_null,
+                    }
+
+        # Host fallback: invalid lines entirely; host-only fields for every line.
+        def coerce(fid: str, value: Any) -> Any:
+            if value is None:
+                return None
+            if self.plan_by_id[fid].kind in _NUMERIC_KINDS:
+                try:
+                    return int(value)
+                except (TypeError, ValueError):
+                    return None
+            return value
+
+        overrides: Dict[str, Dict[int, Any]] = {fid: {} for fid in columns}
+        bad = 0
+        invalid_rows = set(int(i) for i in np.nonzero(~valid)[0])
+        host_rows = range(B) if self.host_fields else sorted(invalid_rows)
+        for i in host_rows:
+            is_invalid = i in invalid_rows
+            fields_needed = self.requested if is_invalid else self.host_fields
+            values = self._run_oracle(lines[i])
+            if values is None:
+                if is_invalid:
+                    bad += 1
+                continue
+            if is_invalid:
+                valid[i] = True
+            for fid in fields_needed:
+                overrides[fid][i] = coerce(fid, values.get(fid))
+
+        good = int(B - bad)
+        return BatchResult(
+            list(lines), buf[:B], lengths[:B], valid, columns, overrides, good, bad
+        )
+
+    def _run_oracle(self, line: Union[bytes, str]) -> Optional[Dict[str, Any]]:
+        if isinstance(line, bytes):
+            line = line.decode("utf-8", errors="replace")
+        try:
+            record = self.oracle.parse(line, _CollectingRecord())
+        except DissectionFailure:
+            return None
+        return record.values

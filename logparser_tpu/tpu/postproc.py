@@ -1,0 +1,255 @@
+"""Vectorized post-stages: typed decoding of captured spans on device.
+
+These replace the reference's per-line sub-dissectors on the hot path:
+- :func:`parse_long_spans` — digit spans -> int64 (CLF '-' aware), replacing
+  Value.getLong / ConvertCLFIntoNumber.
+- :func:`parse_apache_timestamp` — ``dd/MMM/yyyy:HH:mm:ss ZZ`` spans ->
+  epoch millis, replacing TimeStampDissector's formatter parse for the fixed
+  Apache layout (TimeStampDissector.java:404-424).  Fixed offsets + a month
+  name lookup table + days-from-civil integer math: pure VPU arithmetic.
+- :func:`split_firstline` — "GET /x HTTP/1.1" spans -> method/uri/protocol
+  sub-spans (HttpFirstLineDissector.java:59-63 semantics: first space, last
+  space, protocol validated as ``HTTP/``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+MAX_LONG_DIGITS = 18
+
+# Month name lookup: hash = (l0*26 + l1)*26 + l2 over lowercased letters.
+_MONTHS = ["jan", "feb", "mar", "apr", "may", "jun",
+           "jul", "aug", "sep", "oct", "nov", "dec"]
+
+
+def _month_table() -> np.ndarray:
+    table = np.zeros(26 * 26 * 26, dtype=np.int8)
+    for m, name in enumerate(_MONTHS, start=1):
+        h = ((ord(name[0]) - 97) * 26 + (ord(name[1]) - 97)) * 26 + (
+            ord(name[2]) - 97
+        )
+        table[h] = m
+    return table
+
+
+_MONTH_TABLE = _month_table()
+
+
+def gather_span_bytes(buf: jnp.ndarray, start: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Gather `width` bytes per line beginning at start: [B, width]."""
+    B, L = buf.shape
+    idx = jnp.clip(start[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :],
+                   0, L - 1)
+    return jnp.take_along_axis(buf, idx, axis=1)
+
+
+def parse_long_spans(
+    buf: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+    clf: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Spans of ASCII digits -> int64.
+
+    Returns (value, is_null, ok).  With ``clf`` a lone '-' yields
+    is_null=True (the reference maps '-' to null, ApacheHttpdLogFormatDissector
+    decodeExtractedValue :176-178).
+    """
+    n = end - start
+    bytes_ = gather_span_bytes(buf, start, MAX_LONG_DIGITS)
+    in_span = jnp.arange(MAX_LONG_DIGITS, dtype=jnp.int32)[None, :] < n[:, None]
+    digits = (bytes_ - np.uint8(ord("0"))).astype(jnp.int32)
+    digit_ok = (digits >= 0) & (digits <= 9)
+
+    # int64 is unavailable on device without global x64; accumulate two int32
+    # limbs (leading digits / trailing 9 digits) and let the host combine:
+    # value = hi * 10^min(n,9) ... see combine_long_limbs.
+    hi = jnp.zeros(buf.shape[0], dtype=jnp.int32)
+    lo = jnp.zeros(buf.shape[0], dtype=jnp.int32)
+    for i in range(MAX_LONG_DIGITS):
+        take = in_span[:, i]
+        # Digit i belongs to the 'lo' limb when it is within the last 9
+        # digits of the span, i.e. i >= n - 9.
+        is_lo = take & (i >= (n - 9))
+        is_hi = take & ~is_lo
+        hi = jnp.where(is_hi, hi * 10 + digits[:, i], hi)
+        lo = jnp.where(is_lo, lo * 10 + digits[:, i], lo)
+
+    is_dash = (n == 1) & (bytes_[:, 0] == np.uint8(ord("-")))
+    all_digits = jnp.all(digit_ok | ~in_span, axis=1)
+    ok = (
+        ((n > 0) & (n <= MAX_LONG_DIGITS) & all_digits)
+        | (is_dash if clf else False)
+    )
+    is_null = is_dash & clf
+    return (hi, lo, jnp.minimum(n, 9)), is_null, ok
+
+
+def combine_long_limbs(hi, lo, lo_digits, is_null) -> np.ndarray:
+    """Host-side limb combine -> int64 numpy column (null slots -1)."""
+    value = np.asarray(hi, dtype=np.int64) * np.power(
+        10, np.asarray(lo_digits, dtype=np.int64)
+    ) + np.asarray(lo, dtype=np.int64)
+    value[np.asarray(is_null)] = -1
+    return value
+
+
+def _days_from_civil(y: jnp.ndarray, m: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """Days since 1970-01-01 (proleptic Gregorian), vectorized int32/64."""
+    y = y - (m <= 2)
+    era = jnp.floor_divide(jnp.where(y >= 0, y, y - 399), 400)
+    yoe = y - era * 400
+    mp = jnp.mod(m + 9, 12)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+def _two_digits(b: jnp.ndarray, i: int) -> jnp.ndarray:
+    return (
+        (b[:, i] - np.uint8(ord("0"))).astype(jnp.int32) * 10
+        + (b[:, i + 1] - np.uint8(ord("0"))).astype(jnp.int32)
+    )
+
+
+def parse_apache_timestamp(
+    buf: jnp.ndarray, start: jnp.ndarray, end: jnp.ndarray
+) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
+    """``dd/MMM/yyyy:HH:mm:ss +ZZZZ`` spans -> ((days, sec_of_day), ok).
+
+    Layout offsets: dd=0..1 /  MMM=3..5 / yyyy=7..10 : HH=12 : mm=15 : ss=18
+    ' ' sign=21 offHH=22 offMM=24.
+    """
+    b = gather_span_bytes(buf, start, 26)
+    width_ok = (end - start) == 26
+
+    day = _two_digits(b, 0)
+    lower = b | np.uint8(0x20)
+    l0 = (lower[:, 3] - np.uint8(ord("a"))).astype(jnp.int32)
+    l1 = (lower[:, 4] - np.uint8(ord("a"))).astype(jnp.int32)
+    l2 = (lower[:, 5] - np.uint8(ord("a"))).astype(jnp.int32)
+    letters_ok = (
+        (l0 >= 0) & (l0 < 26) & (l1 >= 0) & (l1 < 26) & (l2 >= 0) & (l2 < 26)
+    )
+    h = jnp.clip((l0 * 26 + l1) * 26 + l2, 0, 26 * 26 * 26 - 1)
+    month = jnp.asarray(_MONTH_TABLE)[h].astype(jnp.int32)
+
+    year = (
+        (b[:, 7] - np.uint8(ord("0"))).astype(jnp.int32) * 1000
+        + (b[:, 8] - np.uint8(ord("0"))).astype(jnp.int32) * 100
+        + _two_digits(b, 9)
+    )
+    hour = _two_digits(b, 12)
+    minute = _two_digits(b, 15)
+    second = _two_digits(b, 18)
+
+    sign = jnp.where(b[:, 21] == np.uint8(ord("-")), -1, 1).astype(jnp.int32)
+    off_h = _two_digits(b, 22)
+    off_m = _two_digits(b, 24)
+    offset_s = sign * (off_h * 3600 + off_m * 60)
+
+    seps_ok = (
+        (b[:, 2] == np.uint8(ord("/")))
+        & (b[:, 6] == np.uint8(ord("/")))
+        & (b[:, 11] == np.uint8(ord(":")))
+        & (b[:, 14] == np.uint8(ord(":")))
+        & (b[:, 17] == np.uint8(ord(":")))
+        & (b[:, 20] == np.uint8(ord(" ")))
+        & ((b[:, 21] == np.uint8(ord("+"))) | (b[:, 21] == np.uint8(ord("-"))))
+    )
+    fields_ok = (
+        (month >= 1)
+        & (day >= 1)
+        & (day <= 31)
+        & (hour <= 23)
+        & (minute <= 59)
+        & (second <= 60)
+    )
+
+    days = _days_from_civil(year, month, day)
+    sec_of_day = hour * 3600 + minute * 60 + second - offset_s
+    ok = width_ok & letters_ok & seps_ok & fields_ok
+    # Combined on host: epoch_ms = (days * 86400 + sec_of_day) * 1000 (int64).
+    return (days, sec_of_day), ok
+
+
+def combine_epoch(days, sec_of_day) -> np.ndarray:
+    """Host-side combine -> epoch milliseconds int64 numpy column."""
+    return (
+        np.asarray(days, dtype=np.int64) * 86400
+        + np.asarray(sec_of_day, dtype=np.int64)
+    ) * 1000
+
+
+def split_firstline(
+    buf: jnp.ndarray,
+    lengths: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    """"METHOD URI PROTO" span -> method/uri/protocol sub-spans.
+
+    Mirrors HttpFirstLineDissector: method = up to the first space, protocol =
+    after the last space (only when it matches ``xxx/d.d`` shape — otherwise
+    the truncated-line fallback applies: protocol absent, uri to the end).
+    ``has_protocol`` distinguishes the two cases; fully garbage lines (no
+    space at all) get ok=False.
+    """
+    B, L = buf.shape
+    pos = jnp.arange(L, dtype=jnp.int32)
+    in_span = (pos[None, :] >= start[:, None]) & (pos[None, :] < end[:, None])
+    is_space = (buf == np.uint8(ord(" "))) & in_span
+
+    first_space = jnp.min(jnp.where(is_space, pos[None, :], L), axis=1)
+    last_space = jnp.max(jnp.where(is_space, pos[None, :], -1), axis=1)
+
+    has_space = first_space < L
+    method_start = start
+    method_end = jnp.where(has_space, first_space, start)
+
+    # Protocol candidate: after the last space; valid only when it matches
+    # HTTP/[0-9]+\.[0-9]+ exactly (the 3-part regex arm; otherwise the
+    # truncated-line fallback applies).
+    proto_start = jnp.where(has_space, last_space + 1, end)
+    head = gather_span_bytes(buf, proto_start, 5)
+    head_ok = (
+        (head[:, 0] == np.uint8(ord("H")))
+        & (head[:, 1] == np.uint8(ord("T")))
+        & (head[:, 2] == np.uint8(ord("T")))
+        & (head[:, 3] == np.uint8(ord("P")))
+        & (head[:, 4] == np.uint8(ord("/")))
+    )
+    ver = (pos[None, :] >= (proto_start + 5)[:, None]) & (pos[None, :] < end[:, None])
+    is_digit = (buf >= np.uint8(ord("0"))) & (buf <= np.uint8(ord("9")))
+    is_dot = buf == np.uint8(ord("."))
+    ver_chars_ok = jnp.all(is_digit | is_dot | ~ver, axis=1)
+    one_dot = jnp.sum(jnp.where(is_dot & ver, 1, 0), axis=1) == 1
+    last_b = gather_span_bytes(buf, jnp.maximum(end - 1, 0), 1)[:, 0]
+    first_ver = gather_span_bytes(buf, proto_start + 5, 1)[:, 0]
+    ver_ok = (
+        ((end - proto_start) >= 8)
+        & ver_chars_ok
+        & one_dot
+        & (first_ver >= np.uint8(ord("0"))) & (first_ver <= np.uint8(ord("9")))
+        & (last_b >= np.uint8(ord("0"))) & (last_b <= np.uint8(ord("9")))
+    )
+    has_protocol = has_space & (last_space > first_space) & head_ok & ver_ok
+
+    uri_start = jnp.where(has_space, first_space + 1, end)
+    uri_end = jnp.where(has_protocol, last_space, end)
+
+    return {
+        "method_start": method_start,
+        "method_end": method_end,
+        "uri_start": uri_start,
+        "uri_end": uri_end,
+        "proto_start": jnp.where(has_protocol, proto_start, end),
+        "proto_end": jnp.where(has_protocol, end, end),
+        "has_protocol": has_protocol,
+        "ok": has_space,
+    }

@@ -1,0 +1,157 @@
+"""Batch executor: run a DeviceProgram over ``[B, L]`` uint8 buffers.
+
+All ops are branch-free jnp primitives (masked reductions over the line axis),
+so the whole program jit-compiles to one fused XLA computation per
+(format, L) pair: no data-dependent Python control flow, static shapes,
+everything batched — the XLA-friendly shape of the problem.
+
+Line length handling: lines are padded into power-of-two length buckets
+(``encode_batch``) so recompilation is bounded and the MXU/VPU tiles stay
+dense.  Overlong lines overflow to the host oracle path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .program import DeviceProgram
+
+DEFAULT_MAX_LINE_LEN = 4096
+
+
+def bucket_length(max_len: int, min_bucket: int = 64,
+                  cap: int = DEFAULT_MAX_LINE_LEN) -> int:
+    """Smallest power-of-two bucket >= max_len (>= min_bucket, <= cap)."""
+    size = min_bucket
+    while size < max_len and size < cap:
+        size *= 2
+    return size
+
+
+def encode_batch(
+    lines: Sequence[Union[bytes, str]],
+    line_len: int = 0,
+    min_bucket: int = 64,
+) -> Tuple[np.ndarray, np.ndarray, List[int]]:
+    """Pack lines into a padded [B, L] uint8 buffer + lengths.
+
+    Returns (buffer, lengths, overflow_indices); overflowing lines are
+    truncated in the buffer and reported for host-side handling.
+    """
+    raw = [
+        line.encode("utf-8") if isinstance(line, str) else line for line in lines
+    ]
+    max_len = max((len(r) for r in raw), default=1)
+    if line_len <= 0:
+        line_len = bucket_length(max_len, min_bucket)
+    buf = np.zeros((len(raw), line_len), dtype=np.uint8)
+    lengths = np.zeros(len(raw), dtype=np.int32)
+    overflow: List[int] = []
+    for i, r in enumerate(raw):
+        if len(r) > line_len:
+            overflow.append(i)
+            r = r[:line_len]
+        buf[i, : len(r)] = np.frombuffer(r, dtype=np.uint8)
+        lengths[i] = len(r)
+    return buf, lengths, overflow
+
+
+def _find_literal(buf: jnp.ndarray, lengths: jnp.ndarray, lit: bytes,
+                  cursor: jnp.ndarray) -> jnp.ndarray:
+    """First position >= cursor where `lit` occurs fully inside the line;
+    L (=out of range) when absent.  buf: [B, L]; cursor: [B]."""
+    B, L = buf.shape
+    match = jnp.ones((B, L), dtype=bool)
+    for k, byte in enumerate(lit):
+        shifted = buf if k == 0 else jnp.roll(buf, -k, axis=1)
+        match = match & (shifted == np.uint8(byte))
+    pos = jnp.arange(L, dtype=jnp.int32)
+    inside = pos[None, :] + len(lit) <= lengths[:, None]
+    usable = match & inside & (pos[None, :] >= cursor[:, None])
+    cand = jnp.where(usable, pos[None, :], L)
+    return jnp.min(cand, axis=1).astype(jnp.int32)
+
+
+def _run_program_impl(
+    program: DeviceProgram,
+    buf: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    B, L = buf.shape
+    cursor = jnp.zeros(B, dtype=jnp.int32)
+    valid = jnp.ones(B, dtype=bool)
+    n_tok = len(program.tokens)
+    starts = jnp.zeros((n_tok, B), dtype=jnp.int32)
+    ends = jnp.zeros((n_tok, B), dtype=jnp.int32)
+
+    pos = jnp.arange(L, dtype=jnp.int32)
+    charset_table = jnp.asarray(program.charset_table)
+
+    def check_charset(start, end, spec_charset, spec_min_len, valid):
+        cs = charset_table[program.charset_ids[spec_charset]]
+        in_span = (pos[None, :] >= start[:, None]) & (pos[None, :] < end[:, None])
+        ok_bytes = cs[buf]
+        span_ok = jnp.all(ok_bytes | ~in_span, axis=1)
+        width = end - start
+        # CLF alternations ('number|-'): a lone '-' is legal even though the
+        # charset also admits digits; min_len floor of 1 covers both arms.
+        return valid & span_ok & (width >= spec_min_len)
+
+    for op in program.ops:
+        if op.kind == "lit":
+            ok = jnp.ones(B, dtype=bool)
+            for k, byte in enumerate(op.lit):
+                idx = jnp.clip(cursor + k, 0, L - 1)
+                ok = ok & (jnp.take_along_axis(buf, idx[:, None], axis=1)[:, 0]
+                           == np.uint8(byte))
+            ok = ok & (cursor + len(op.lit) <= lengths)
+            valid = valid & ok
+            cursor = cursor + len(op.lit)
+        elif op.kind == "until_lit":
+            found = _find_literal(buf, lengths, op.lit, cursor)
+            token_valid = found < L
+            start = cursor
+            end = jnp.where(token_valid, found, cursor)
+            valid = check_charset(start, end, op.charset, op.min_len,
+                                  valid & token_valid)
+            starts = starts.at[op.token_index].set(start)
+            ends = ends.at[op.token_index].set(end)
+            cursor = end + len(op.lit)
+        elif op.kind == "to_end":
+            start = cursor
+            end = lengths
+            valid = check_charset(start, end, op.charset, op.min_len, valid)
+            starts = starts.at[op.token_index].set(start)
+            ends = ends.at[op.token_index].set(end)
+            cursor = end
+        else:  # pragma: no cover
+            raise AssertionError(op.kind)
+
+    # The whole line must be consumed (the regex is end-anchored).
+    valid = valid & (cursor == lengths)
+    return {"starts": starts, "ends": ends, "valid": valid}
+
+
+def _jitted_for(program: DeviceProgram):
+    # One jitted executor per program object (DeviceProgram holds numpy
+    # tables, so it is cached by identity on the program itself).
+    jitted = getattr(program, "_jitted", None)
+    if jitted is None:
+        jitted = jax.jit(functools.partial(_run_program_impl, program))
+        program._jitted = jitted
+    return jitted
+
+
+def run_program(
+    program: DeviceProgram,
+    buf: Union[np.ndarray, jnp.ndarray],
+    lengths: Union[np.ndarray, jnp.ndarray],
+) -> Dict[str, jnp.ndarray]:
+    """Execute the split program; returns per-token starts/ends [T, B] and a
+    per-line validity mask [B]."""
+    return _jitted_for(program)(jnp.asarray(buf), jnp.asarray(lengths))

@@ -1,0 +1,54 @@
+"""Sharded execution tests on the virtual 8-device CPU mesh: DP and SP
+results must be identical to single-device execution."""
+import numpy as np
+import pytest
+
+import jax
+
+from logparser_tpu.httpd.apache import ApacheHttpdLogFormatDissector
+from logparser_tpu.parallel import (
+    data_parallel_runner,
+    make_mesh,
+    sequence_parallel_runner,
+)
+from logparser_tpu.tools.demolog import generate_combined_lines
+from logparser_tpu.tpu.program import compile_device_program
+from logparser_tpu.tpu.runtime import encode_batch, run_program
+
+
+@pytest.fixture(scope="module")
+def program():
+    return compile_device_program(ApacheHttpdLogFormatDissector("combined"))
+
+
+@pytest.fixture(scope="module")
+def batch():
+    lines = generate_combined_lines(64, seed=11, garbage_fraction=0.05)
+    buf, lengths, _ = encode_batch(lines, line_len=512)
+    return buf, lengths
+
+
+def test_have_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_data_parallel_matches_single(program, batch):
+    buf, lengths = batch
+    ref = run_program(program, buf, lengths)
+    mesh = make_mesh(n_data=8)
+    runner = data_parallel_runner(program, mesh)
+    out = runner(buf, lengths)
+    np.testing.assert_array_equal(np.asarray(out["valid"]), np.asarray(ref["valid"]))
+    np.testing.assert_array_equal(np.asarray(out["starts"]), np.asarray(ref["starts"]))
+    np.testing.assert_array_equal(np.asarray(out["ends"]), np.asarray(ref["ends"]))
+
+
+def test_sequence_parallel_matches_single(program, batch):
+    buf, lengths = batch
+    ref = run_program(program, buf, lengths)
+    mesh = make_mesh(n_data=2, n_seq=4)
+    runner = sequence_parallel_runner(program, mesh, l_total=buf.shape[1])
+    out = runner(buf, lengths)
+    np.testing.assert_array_equal(np.asarray(out["valid"]), np.asarray(ref["valid"]))
+    np.testing.assert_array_equal(np.asarray(out["starts"]), np.asarray(ref["starts"]))
+    np.testing.assert_array_equal(np.asarray(out["ends"]), np.asarray(ref["ends"]))
