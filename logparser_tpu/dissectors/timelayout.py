@@ -173,22 +173,18 @@ class TimeLayout:
                     while pos < n and s[pos] == " " and pos - start < maxw - 1:
                         pos += 1
                 digits_start = pos
-                sign = 1
-                if field == "epoch" and pos < n and s[pos] in "+-":
-                    sign = -1 if s[pos] == "-" else 1
+                signed = field == "epoch" and pos < n and s[pos] in "+-"
+                if signed:
                     pos += 1
                 while pos < n and s[pos].isdigit() and (pos - digits_start) < maxw:
                     pos += 1
-                ndig = pos - digits_start - (0 if sign == 1 else 1)
-                if ndig < minw and not space_pad:
+                ndig = pos - digits_start - (1 if signed else 0)
+                if (ndig < minw and not space_pad) or ndig == 0:
                     raise TimestampParseError(
                         f"Text '{s}' could not be parsed at index {start}"
                     )
-                if pos == digits_start:
-                    raise TimestampParseError(
-                        f"Text '{s}' could not be parsed at index {start}"
-                    )
-                fields[field] = sign * int(s[digits_start:pos])
+                # The slice keeps any leading sign; int() applies it.
+                fields[field] = int(s[digits_start:pos])
             elif kind == "text":
                 _, field, style = it
                 pos = self._parse_text(s, pos, field, style, fields)

@@ -154,8 +154,12 @@ class TpuBatchParser:
         self.plans: List[_FieldPlan] = [self._resolve(fid) for fid in self.requested]
         self.plan_by_id = {p.field_id: p for p in self.plans}
         self.host_fields = [p.field_id for p in self.plans if p.kind == "host"]
+        # No point running the device program when every field is host-only.
+        any_device_field = any(p.kind != "host" for p in self.plans)
         self._jitted = (
-            jax.jit(self._device_fn) if self.program is not None else None
+            jax.jit(self._device_fn)
+            if self.program is not None and any_device_field
+            else None
         )
 
     # ------------------------------------------------------------------
@@ -196,7 +200,6 @@ class TpuBatchParser:
     def _device_fn(self, buf: jnp.ndarray, lengths: jnp.ndarray):
         res = _run_program_impl(self.program, buf, lengths)
         starts, ends, valid = res["starts"], res["ends"], res["valid"]
-        out: Dict[str, Any] = {"valid": valid, "starts": starts, "ends": ends}
 
         fl_cache: Dict[int, Dict[str, jnp.ndarray]] = {}
         cols: Dict[str, Any] = {}
@@ -213,6 +216,10 @@ class TpuBatchParser:
             elif plan.kind == "epoch":
                 parts, ok = postproc.parse_apache_timestamp(buf, t_start, t_end)
                 cols[plan.field_id] = (parts, ok)
+                # A timestamp the host layout rejects raises DissectionFailure
+                # there, failing the whole line — mirror that: route the line
+                # to the oracle (which will reject it identically).
+                valid = valid & ok
             elif plan.kind in ("fl_method", "fl_uri", "fl_protocol"):
                 if plan.token_index not in fl_cache:
                     fl_cache[plan.token_index] = postproc.split_firstline(
@@ -227,8 +234,7 @@ class TpuBatchParser:
                     ok = fl["ok"]
                     s, e = fl[f"{part}_start"], fl[f"{part}_end"]
                 cols[plan.field_id] = (s, e, ok)
-        out["cols"] = cols
-        return out
+        return {"valid": valid, "starts": starts, "ends": ends, "cols": cols}
 
     # ------------------------------------------------------------------
 

@@ -162,14 +162,24 @@ def parse_apache_timestamp(
         & (b[:, 20] == np.uint8(ord(" ")))
         & ((b[:, 21] == np.uint8(ord("+"))) | (b[:, 21] == np.uint8(ord("-"))))
     )
+    # Day-in-month with leap years, so the device accepts exactly what the
+    # host layout accepts (no silent wrong epochs bypassing the oracle).
+    leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
+    dim = jnp.asarray(
+        np.array([0, 31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                 dtype=np.int32)
+    )[jnp.clip(month, 0, 12)]
+    dim = dim + jnp.where((month == 2) & leap, 1, 0)
     fields_ok = (
         (month >= 1)
         & (day >= 1)
-        & (day <= 31)
+        & (day <= dim)
         & (hour <= 23)
         & (minute <= 59)
         & (second <= 60)
     )
+    # Leap second: the host layout clamps 60 -> 59 (java.time SMART).
+    second = jnp.minimum(second, 59)
 
     days = _days_from_civil(year, month, day)
     sec_of_day = hour * 3600 + minute * 60 + second - offset_s
@@ -249,7 +259,7 @@ def split_firstline(
         "uri_start": uri_start,
         "uri_end": uri_end,
         "proto_start": jnp.where(has_protocol, proto_start, end),
-        "proto_end": jnp.where(has_protocol, end, end),
+        "proto_end": end,
         "has_protocol": has_protocol,
         "ok": has_space,
     }

@@ -151,3 +151,37 @@ class TestEdge:
         result = batch.parse_batch(lines)
         assert result.to_pylist("BYTES:response.body.bytes") == [0, 123456789012]
         assert result.to_pylist("BYTESCLF:response.body.bytes") == [None, 123456789012]
+
+
+class TestTimestampValidation:
+    """Regression tests: device timestamp validation must agree with the host
+    layout (day-in-month, leap years, leap-second clamp)."""
+
+    def _epoch(self, ts):
+        batch = TpuBatchParser("combined", ["TIME.EPOCH:request.receive.time.epoch"])
+        line = f'1.2.3.4 - - [{ts}] "GET / HTTP/1.1" 200 5 "-" "-"'
+        res = batch.parse_batch([line])
+        return res.to_pylist("TIME.EPOCH:request.receive.time.epoch")[0], res
+
+    def test_invalid_day_in_month_rejected(self):
+        val, res = self._epoch("31/Feb/2024:10:00:00 +0000")
+        # The host oracle also rejects this line; it must be counted bad.
+        assert res.bad_lines == 1
+        assert val is None
+
+    def test_leap_day_accepted(self):
+        val, res = self._epoch("29/Feb/2024:00:00:00 +0000")
+        assert res.bad_lines == 0
+        assert val == 1709164800000
+
+    def test_leap_second_clamped_like_host(self):
+        val, _ = self._epoch("27/Jan/2024:10:00:60 +0000")
+        val59, _ = self._epoch("27/Jan/2024:10:00:59 +0000")
+        assert val == val59
+
+
+def test_negative_epoch_strftime():
+    from logparser_tpu.dissectors.strftime_stamp import compile_strftime
+
+    assert compile_strftime("%s").parse("-86400").epoch_millis == -86400000
+    assert compile_strftime("%s").parse("86400").epoch_millis == 86400000
