@@ -1,0 +1,27 @@
+"""GeoIP subsystem: own MaxMind-DB reader, dissectors, device join tables.
+
+Reference: httpdlog-parser/.../dissectors/geoip/ (via com.maxmind.geoip2);
+rebuilt here with a pure-Python .mmdb reader (mmdb.py) and a TPU-native
+flattened-range join (device.py).
+"""
+from .dissectors import (
+    AbstractGeoIPDissector,
+    GeoIPASNDissector,
+    GeoIPCityDissector,
+    GeoIPCountryDissector,
+    GeoIPISPDissector,
+)
+from .device import GeoDeviceTable, ipv4_to_u32
+from .mmdb import InvalidDatabaseError, MMDBReader
+
+__all__ = [
+    "AbstractGeoIPDissector",
+    "GeoIPASNDissector",
+    "GeoIPCityDissector",
+    "GeoIPCountryDissector",
+    "GeoIPISPDissector",
+    "GeoDeviceTable",
+    "InvalidDatabaseError",
+    "MMDBReader",
+    "ipv4_to_u32",
+]
