@@ -1,0 +1,44 @@
+"""Engine adapters (L4): batch file input, table loader, row deserializer,
+streaming operators.
+
+Reference: httpdlog/httpdlog-{inputformat,pigloader,serde}/ — the rebuild
+keeps the same string-configurable surfaces (SURVEY §5.6) on top of the TPU
+batch path.
+"""
+from .inputformat import (
+    CONFIG_KEY_FIELDS,
+    CONFIG_KEY_FORMAT,
+    Counters,
+    FIELDS_MAGIC,
+    FileSplit,
+    LogfileInputFormat,
+    LogfileRecordReader,
+)
+from .loader import Loader, load_dissector_by_name
+from .record import ParsedRecord
+from .serde import LogDeserializer, SerDeException
+from .streaming import (
+    MicroBatcher,
+    ParserConfig,
+    ParserMapOperator,
+    parse_stream,
+)
+
+__all__ = [
+    "CONFIG_KEY_FIELDS",
+    "CONFIG_KEY_FORMAT",
+    "Counters",
+    "FIELDS_MAGIC",
+    "FileSplit",
+    "LogfileInputFormat",
+    "LogfileRecordReader",
+    "Loader",
+    "LogDeserializer",
+    "MicroBatcher",
+    "ParsedRecord",
+    "ParserConfig",
+    "ParserMapOperator",
+    "SerDeException",
+    "load_dissector_by_name",
+    "parse_stream",
+]

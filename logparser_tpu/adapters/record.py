@@ -1,0 +1,193 @@
+"""Generic engine-facing record: typed maps + wildcard multi-values +
+compact binary serialization.
+
+Reference behavior: httpdlog-inputformat/.../ParsedRecord.java — string/long/
+double maps, wildcard string-set maps keyed by a declared ``prefix.*``
+registry (:40-57), and a custom Writable binary round-trip (write :60-96,
+readFields :99-135).  The rebuild serializes with struct-packed
+length-prefixed UTF-8 so records can cross process boundaries (shuffle
+files, Arrow-adjacent sidecars) without pickle.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Set
+
+_U32 = struct.Struct(">I")
+_I64 = struct.Struct(">q")
+_F64 = struct.Struct(">d")
+
+
+def _pack_str(out: List[bytes], s: str) -> None:
+    raw = s.encode("utf-8")
+    out.append(_U32.pack(len(raw)))
+    out.append(raw)
+
+
+class _Cursor:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def u32(self) -> int:
+        (v,) = _U32.unpack_from(self.buf, self.pos)
+        self.pos += 4
+        return v
+
+    def i64(self) -> int:
+        (v,) = _I64.unpack_from(self.buf, self.pos)
+        self.pos += 8
+        return v
+
+    def f64(self) -> float:
+        (v,) = _F64.unpack_from(self.buf, self.pos)
+        self.pos += 8
+        return v
+
+    def string(self) -> str:
+        n = self.u32()
+        v = self.buf[self.pos : self.pos + n].decode("utf-8")
+        self.pos += n
+        return v
+
+
+class ParsedRecord:
+    """One parsed logline as typed name->value maps."""
+
+    def __init__(self) -> None:
+        self.strings: Dict[str, str] = {}
+        self.longs: Dict[str, int] = {}
+        self.doubles: Dict[str, float] = {}
+        # wildcard support: declared "prefix" -> {full.name -> value}
+        self.multi_prefixes: Set[str] = set()
+        self.multi_strings: Dict[str, Dict[str, str]] = {}
+
+    # -- population (the setter surface wired by the adapters) -------------
+
+    def declare_requested_fieldname(self, fieldname: str) -> None:
+        """Register a wildcard target (``prefix.*``) so later string sets
+        under that prefix are captured as multi-values
+        (ParsedRecord.java:40-49)."""
+        if fieldname.endswith(".*"):
+            self.multi_prefixes.add(fieldname[:-2])
+
+    def set_string(self, name: str, value: Optional[str]) -> None:
+        if value is None:
+            return
+        self.strings[name] = value
+        prefix = name.rsplit(".", 1)[0] if "." in name else name
+        if prefix in self.multi_prefixes:
+            self.multi_strings.setdefault(prefix, {})[name] = value
+
+    def set_long(self, name: str, value: Optional[int]) -> None:
+        if value is not None:
+            self.longs[name] = value
+
+    def set_double(self, name: str, value: Optional[float]) -> None:
+        if value is not None:
+            self.doubles[name] = value
+
+    def set_multi_value_string(self, name: str, value: Optional[str]) -> None:
+        if value is None:
+            return
+        prefix = name.rsplit(".", 1)[0] if "." in name else name
+        self.multi_strings.setdefault(prefix, {})[name] = value
+
+    # -- retrieval ----------------------------------------------------------
+
+    def get_string(self, name: str) -> Optional[str]:
+        return self.strings.get(name)
+
+    def get_long(self, name: str) -> Optional[int]:
+        return self.longs.get(name)
+
+    def get_double(self, name: str) -> Optional[float]:
+        return self.doubles.get(name)
+
+    def get_string_set(self, prefix: str) -> Dict[str, str]:
+        """All captured ``prefix.name -> value`` pairs for a wildcard target."""
+        return dict(self.multi_strings.get(prefix, {}))
+
+    def get(self, name: str) -> Any:
+        for m in (self.strings, self.longs, self.doubles):
+            if name in m:
+                return m[name]
+        return None
+
+    def is_empty(self) -> bool:
+        return not (self.strings or self.longs or self.doubles or self.multi_strings)
+
+    def clear(self) -> None:
+        self.strings.clear()
+        self.longs.clear()
+        self.doubles.clear()
+        self.multi_strings.clear()
+
+    # -- binary round-trip (Writable equivalent) ----------------------------
+
+    def to_bytes(self) -> bytes:
+        out: List[bytes] = []
+        out.append(_U32.pack(len(self.strings)))
+        for k, v in self.strings.items():
+            _pack_str(out, k)
+            _pack_str(out, v)
+        out.append(_U32.pack(len(self.longs)))
+        for k, lv in self.longs.items():
+            _pack_str(out, k)
+            out.append(_I64.pack(lv))
+        out.append(_U32.pack(len(self.doubles)))
+        for k, dv in self.doubles.items():
+            _pack_str(out, k)
+            out.append(_F64.pack(dv))
+        out.append(_U32.pack(len(self.multi_prefixes)))
+        for p in sorted(self.multi_prefixes):
+            _pack_str(out, p)
+        out.append(_U32.pack(len(self.multi_strings)))
+        for p, kv in self.multi_strings.items():
+            _pack_str(out, p)
+            out.append(_U32.pack(len(kv)))
+            for k, v in kv.items():
+                _pack_str(out, k)
+                _pack_str(out, v)
+        return b"".join(out)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ParsedRecord":
+        c = _Cursor(data)
+        rec = cls()
+        for _ in range(c.u32()):
+            k = c.string()
+            rec.strings[k] = c.string()
+        for _ in range(c.u32()):
+            k = c.string()
+            rec.longs[k] = c.i64()
+        for _ in range(c.u32()):
+            k = c.string()
+            rec.doubles[k] = c.f64()
+        for _ in range(c.u32()):
+            rec.multi_prefixes.add(c.string())
+        for _ in range(c.u32()):
+            p = c.string()
+            kv: Dict[str, str] = {}
+            for _ in range(c.u32()):
+                k = c.string()
+                kv[k] = c.string()
+            rec.multi_strings[p] = kv
+        return rec
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, ParsedRecord):
+            return NotImplemented
+        return (
+            self.strings == other.strings
+            and self.longs == other.longs
+            and self.doubles == other.doubles
+            and self.multi_prefixes == other.multi_prefixes
+            and self.multi_strings == other.multi_strings
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"ParsedRecord(strings={self.strings!r}, longs={self.longs!r}, "
+            f"doubles={self.doubles!r}, multi={self.multi_strings!r})"
+        )

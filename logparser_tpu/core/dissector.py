@@ -110,7 +110,10 @@ class SimpleDissector(Dissector):
     def prepare_for_dissect(self, input_name: str, output_name: str) -> FrozenSet[Cast]:
         for path, casts in self._output_casts.items():
             name = path.split(":", 1)[1]
-            if output_name == name or output_name.endswith("." + name):
+            # An empty output name is a 1:1 type edge (the translate/
+            # dissectors): the output IS the input path, any name matches
+            # (TypeConvertBaseDissector semantics).
+            if name == "" or output_name == name or output_name.endswith("." + name):
                 return casts
         return STRING_ONLY
 

@@ -253,6 +253,22 @@ class Parser:
                 self.add_type_remapping(inp, nt)
         return self
 
+    def apply_config(
+        self,
+        type_remappings: Optional[Dict[str, Any]] = None,
+        extra_dissectors: Optional[Sequence[Any]] = None,
+    ) -> "Parser":
+        """One-call string-config wiring shared by every adapter surface:
+        remappings values may be a single type name or a collection."""
+        for path, types in (type_remappings or {}).items():
+            if isinstance(types, str):
+                types = [types]
+            for new_type in types:
+                self.add_type_remapping(path, new_type)
+        for dissector in extra_dissectors or ():
+            self.add_dissector(dissector)
+        return self
+
     def add_type_remapping(
         self,
         input_path: str,

@@ -74,12 +74,14 @@ class AbstractGeoIPDissector(Dissector):
     def prepare_for_run(self) -> None:
         try:
             self._reader = MMDBReader(self.database_file_name)
-        except OSError as e:
+        except (OSError, ValueError, TypeError) as e:
             # Same shape as AbstractGeoIPDissector.java:80-82 so the adapters'
-            # error surfaces match ("<class>:<message>").
+            # error surfaces match ("<class>:<message>") — covers missing
+            # files, corrupt databases (InvalidDatabaseError) and an unset
+            # database path alike.
+            detail = getattr(e, "strerror", None) or e
             raise InvalidDissectorException(
-                f"{type(self).__name__}:{self.database_file_name} "
-                f"({e.strerror or e})"
+                f"{type(self).__name__}:{self.database_file_name} ({detail})"
             )
 
     def dissect(self, parsable: Parsable, input_name: str) -> None:

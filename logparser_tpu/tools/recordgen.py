@@ -1,0 +1,105 @@
+"""Record-class generator: print a ready-made annotated record for a format.
+
+Reference behavior: utils/PojoGenerator/.../PojoGenerator.java:31-60 — build a
+parser for the logformat, add every possible path as a target, then print one
+annotated setter per (path, cast).  Here the output is a Python record class
+using the ``@field`` decorator, with the cast expressed as the value
+parameter's type annotation (str/int/float — the signature-dispatch analogue
+of Parser.java:590-603).
+
+CLI:  python -m logparser_tpu.tools.recordgen --logformat 'combined'
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import List, Optional, Sequence
+
+from ..core.casts import Cast
+
+_CAST_TO_PYTYPE = {Cast.STRING: "str", Cast.LONG: "int", Cast.DOUBLE: "float"}
+# Deterministic output order (Java EnumSet iterates in declaration order).
+_CAST_ORDER = [Cast.STRING, Cast.LONG, Cast.DOUBLE]
+
+
+def _method_name(path: str) -> str:
+    name = path.split(":", 1)[1]
+    return "set_" + re.sub(r"[^0-9a-zA-Z]+", "_", name).strip("_").lower()
+
+
+def generate_record_class(
+    log_format: str,
+    class_name: str = "MyRecord",
+    fields: Optional[Sequence[str]] = None,
+) -> str:
+    """Source text of an annotated record class covering every possible path
+    (or the given subset)."""
+    from ..adapters.inputformat import build_metadata_parser
+
+    parser = build_metadata_parser(log_format)
+    paths = list(fields) if fields else parser.get_possible_paths()
+    parser.add_parse_target("set_value", list(paths))
+    parser.assemble_dissectors()
+
+    lines: List[str] = [
+        "from logparser_tpu.core.fields import field",
+        "",
+        "",
+        f"class {class_name}:",
+    ]
+    seen_methods = set()
+    for path in paths:
+        casts = parser.get_casts(path)
+        if not casts:
+            continue
+        for cast in _CAST_ORDER:
+            if cast not in casts:
+                continue
+            method = _method_name(path)
+            pytype = _CAST_TO_PYTYPE[cast]
+            if pytype != "str":
+                method += f"_{pytype}"
+            if method in seen_methods:
+                continue
+            seen_methods.add(method)
+            wildcard = path.endswith(".*")
+            args = (
+                f"self, name: str, value: {pytype}" if wildcard
+                else f"self, value: {pytype}"
+            )
+            value_expr = '{name!r} = {value!r}' if wildcard else '{value!r}'
+            lines.append(f"    @field({path!r})")
+            lines.append(f"    def {method}({args}):")
+            lines.append(
+                f"        print(f'SETTER CALLED FOR {path}: {value_expr}')"
+            )
+            lines.append("")
+    if not seen_methods:
+        lines.append("    pass")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="recordgen",
+        description="Generate an annotated record class for a LogFormat",
+    )
+    ap.add_argument(
+        "--logformat", required=True, help="Apache HTTPD / NGINX LogFormat"
+    )
+    ap.add_argument("--class-name", default="MyRecord")
+    ap.add_argument(
+        "--fields",
+        nargs="*",
+        help="optional subset of TYPE:path fields (default: all possible)",
+    )
+    args = ap.parse_args(argv)
+    sys.stdout.write(
+        generate_record_class(args.logformat, args.class_name, args.fields)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,114 @@
+"""Arrow materialization of batch parse results + IPC interop.
+
+SURVEY §7 step 5: "host materializes Arrow arrays ... Java/any-host interop
+over Arrow IPC; sidecar service mode".  The reference has no columnar output
+(records go through per-line reflection setters); Arrow is the TPU-native
+equivalent of that record-delivery surface: span columns gather straight from
+the [B, L] byte buffer into a StringArray, numeric columns become int64 with
+a null bitmap, wildcard columns become map<string,string>.
+
+Zero-copy note: span gathering must touch Python per row for string assembly;
+pyarrow's builders do the heavy lifting in C++.  Numeric columns go through
+numpy with no per-row Python.
+"""
+from __future__ import annotations
+
+import io
+from typing import TYPE_CHECKING, Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .batch import BatchResult
+
+_NUMERIC_KINDS = {"long", "long_clf_null", "long_clf_zero", "epoch"}
+
+
+def _column_to_arrow(result: "BatchResult", field_id: str):
+    import pyarrow as pa
+
+    col = result.column(field_id)
+    kind = col["kind"]
+    overrides = result._overrides.get(field_id, {})
+    B = result.lines_read
+
+    if kind in _NUMERIC_KINDS and not any(
+        isinstance(v, (str, dict)) for v in overrides.values()
+    ):
+        values = np.asarray(col["values"], dtype=np.int64).copy()
+        mask = ~(np.asarray(result.valid) & np.asarray(col["ok"]))
+        null = np.asarray(col["null"])
+        if kind == "long_clf_zero":
+            values[null] = 0
+        else:
+            mask = mask | null
+        for row, v in overrides.items():
+            if v is None:
+                mask[row] = True
+            else:
+                values[row] = v
+                mask[row] = False
+        return pa.array(values[:B], type=pa.int64(), mask=mask[:B])
+
+    # Host-delivered / span columns: type from the materialized values
+    # (host-path numerics — e.g. dissector-produced numbers like GeoIP
+    # asn.number — must come out int64/float64, not stringified).
+    values_py = result.to_pylist(field_id)
+    if field_id.endswith(".*"):
+        return pa.array(
+            [
+                None if v is None else list(v.items())
+                for v in values_py
+            ],
+            type=pa.map_(pa.string(), pa.string()),
+        )
+    non_null = [v for v in values_py if v is not None]
+    if non_null and all(isinstance(v, int) and not isinstance(v, bool) for v in non_null):
+        return pa.array(values_py, type=pa.int64())
+    if non_null and all(
+        isinstance(v, (int, float)) and not isinstance(v, bool) for v in non_null
+    ):
+        return pa.array(
+            [None if v is None else float(v) for v in values_py],
+            type=pa.float64(),
+        )
+    return pa.array(
+        [None if v is None else str(v) for v in values_py], type=pa.string()
+    )
+
+
+def batch_to_arrow(result: "BatchResult", include_validity: bool = True):
+    """BatchResult -> pyarrow.Table (one column per requested field)."""
+    import pyarrow as pa
+
+    arrays = []
+    names = []
+    for field_id in result.field_ids():
+        arrays.append(_column_to_arrow(result, field_id))
+        names.append(field_id)
+    if include_validity:
+        arrays.append(pa.array(np.asarray(result.valid, dtype=bool)))
+        names.append("__valid__")
+    return pa.table(dict(zip(names, arrays)))
+
+
+def table_to_ipc_bytes(table) -> bytes:
+    """Arrow IPC stream serialization (the cross-process/sidecar format)."""
+    import pyarrow as pa
+
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as writer:
+        writer.write_table(table)
+    return sink.getvalue()
+
+
+def table_from_ipc_bytes(data: bytes):
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(io.BytesIO(data)) as reader:
+        return reader.read_all()
+
+
+def parse_to_ipc(parser, lines: Sequence[Any]) -> bytes:
+    """One-call sidecar surface: lines in, Arrow IPC stream bytes out."""
+    return table_to_ipc_bytes(batch_to_arrow(parser.parse_batch(lines)))

@@ -114,6 +114,12 @@ class BatchResult:
     def to_dict(self) -> Dict[str, List[Any]]:
         return {fid: self.to_pylist(fid) for fid in self._columns}
 
+    def to_arrow(self, include_validity: bool = True):
+        """Materialize as a pyarrow.Table (see tpu/arrow_bridge.py)."""
+        from .arrow_bridge import batch_to_arrow
+
+        return batch_to_arrow(self, include_validity=include_validity)
+
 
 def _bucket_batch(b: int, minimum: int = 64) -> int:
     size = minimum
@@ -131,12 +137,15 @@ class TpuBatchParser:
         log_format: str,
         fields: Sequence[str],
         timestamp_format: Optional[str] = None,
+        type_remappings: Optional[Dict[str, Any]] = None,
+        extra_dissectors: Optional[Sequence[Any]] = None,
     ):
         self.log_format = log_format
         self.requested = [cleanup_field_value(f) for f in fields]
 
         # Host oracle parser (also the metadata source).
         self.oracle = HttpdLoglineParser(_CollectingRecord, log_format, timestamp_format)
+        self.oracle.apply_config(type_remappings, extra_dissectors)
         self.oracle.add_parse_target("set_value", list(self.requested))
         self.oracle.assemble_dissectors()
 
@@ -154,6 +163,9 @@ class TpuBatchParser:
         self.plans: List[_FieldPlan] = [self._resolve(fid) for fid in self.requested]
         self.plan_by_id = {p.field_id: p for p in self.plans}
         self.host_fields = [p.field_id for p in self.plans if p.kind == "host"]
+        self._host_casts = {
+            fid: self.oracle.get_casts(fid) for fid in self.host_fields
+        }
         # No point running the device program when every field is host-only.
         any_device_field = any(p.kind != "host" for p in self.plans)
         self._jitted = (
@@ -322,6 +334,23 @@ class TpuBatchParser:
                     return int(value)
                 except (TypeError, ValueError):
                     return None
+            # Host-delivered values arrive as oracle strings; deliver them
+            # typed per the producing dissector's casts (LONG > DOUBLE >
+            # STRING, matching the reference's setter-signature dispatch).
+            casts = self._host_casts.get(fid)
+            if casts is not None:
+                from ..core.casts import Cast
+
+                if Cast.LONG in casts:
+                    try:
+                        return int(value)
+                    except (TypeError, ValueError):
+                        pass
+                if Cast.DOUBLE in casts:
+                    try:
+                        return float(value)
+                    except (TypeError, ValueError):
+                        pass
             return value
 
         overrides: Dict[str, Dict[int, Any]] = {fid: {} for fid in columns}
@@ -339,7 +368,18 @@ class TpuBatchParser:
             if is_invalid:
                 valid[i] = True
             for fid in fields_needed:
-                overrides[fid][i] = coerce(fid, values.get(fid))
+                if fid.endswith(".*"):
+                    # Wildcard target: deliver {relative.name: value} built
+                    # from every concrete field under the prefix (the oracle
+                    # stores them under their full TYPE:path names).
+                    prefix = fid[:-1]  # keep the trailing dot
+                    overrides[fid][i] = {
+                        k[len(prefix):]: v
+                        for k, v in values.items()
+                        if k.startswith(prefix)
+                    }
+                else:
+                    overrides[fid][i] = coerce(fid, values.get(fid))
 
         good = int(B - bad)
         return BatchResult(

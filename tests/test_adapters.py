@@ -1,0 +1,299 @@
+"""Engine-adapter tests (L4): input format splits/counters/metadata mode,
+Pig-style loader protocol incl. pushdown + dynamic dissector loading,
+Hive-style deserializer incl. the 1% circuit breaker, streaming operators.
+
+Mirrors the reference's local-mode adapter tests
+(TestApacheHttpdLogfileInputFormat, TestParsedRecord, TestLoader,
+TestApacheHttpdlogDeserializer, example tests) without any cluster.
+"""
+import pickle
+
+import pytest
+
+from logparser_tpu.adapters import (
+    FileSplit,
+    Loader,
+    LogDeserializer,
+    LogfileInputFormat,
+    ParsedRecord,
+    ParserConfig,
+    SerDeException,
+    parse_stream,
+)
+from logparser_tpu.tools.demolog import generate_combined_lines
+
+FIELDS = [
+    "IP:connection.client.host",
+    "TIME.EPOCH:request.receive.time.epoch",
+    "HTTP.METHOD:request.firstline.method",
+    "STRING:request.status.last",
+    "BYTES:response.body.bytes",
+]
+
+GOOD_LINE = (
+    '80.100.47.45 - - [07/Mar/2004:16:47:46 -0800] '
+    '"GET /x?res=1024x768&rev=2 HTTP/1.1" 200 4523 "-" "Mozilla/5.0"'
+)
+BAD_LINE = "this is not a logline at all"
+
+
+@pytest.fixture(scope="module")
+def logfile(tmp_path_factory):
+    path = tmp_path_factory.mktemp("logs") / "access.log"
+    lines = generate_combined_lines(300, seed=7)
+    lines.insert(57, BAD_LINE)  # one bad line mid-file
+    path.write_text("\n".join(lines) + "\n")
+    return str(path), lines
+
+
+# -- ParsedRecord -------------------------------------------------------------
+
+def test_parsed_record_roundtrip():
+    rec = ParsedRecord()
+    rec.declare_requested_fieldname("request.firstline.uri.query.*")
+    rec.set_string("connection.client.host", "1.2.3.4")
+    rec.set_long("response.body.bytes", 4523)
+    rec.set_double("response.server.processing.time", 1.25)
+    rec.set_multi_value_string("request.firstline.uri.query.rev", "2")
+    rec.set_string("request.firstline.uri.query.res", "1024x768")
+
+    clone = ParsedRecord.from_bytes(rec.to_bytes())
+    assert clone == rec
+    assert clone.get_long("response.body.bytes") == 4523
+    assert clone.get_string_set("request.firstline.uri.query") == {
+        "request.firstline.uri.query.rev": "2",
+        "request.firstline.uri.query.res": "1024x768",
+    }
+
+
+def test_parsed_record_wildcard_capture_via_set_string():
+    rec = ParsedRecord()
+    rec.declare_requested_fieldname("q.*")
+    rec.set_string("q.a", "1")
+    rec.set_string("other.b", "2")
+    assert rec.get_string_set("q") == {"q.a": "1"}
+
+
+# -- input format -------------------------------------------------------------
+
+def test_inputformat_reads_whole_file(logfile):
+    path, lines = logfile
+    fmt = LogfileInputFormat("combined", FIELDS, batch_size=128)
+    (split,) = fmt.get_splits(path, split_size=10**9)
+    reader = fmt.create_record_reader(split)
+    records = [rec for _, rec in reader]
+
+    assert reader.counters.lines_read == len(lines)
+    assert reader.counters.bad_lines == 1
+    assert reader.counters.good_lines == len(lines) - 1
+    assert len(records) == len(lines) - 1
+    assert records[0].get_string("connection.client.host")
+    assert isinstance(records[0].get_long("response.body.bytes"), (int, type(None)))
+
+
+def test_inputformat_split_union_equals_whole(logfile):
+    path, lines = logfile
+    fmt = LogfileInputFormat("combined", FIELDS, batch_size=64)
+    whole = [
+        rec
+        for _, rec in fmt.create_record_reader(
+            fmt.get_splits(path, split_size=10**9)[0]
+        )
+    ]
+    splits = fmt.get_splits(path, split_size=4096)
+    assert len(splits) > 2
+    parts = []
+    total = 0
+    for split in splits:
+        reader = fmt.create_record_reader(split)
+        parts.extend(rec for _, rec in reader)
+        total += reader.counters.lines_read
+    assert total == len(lines)  # every line read exactly once
+    assert len(parts) == len(whole)
+    assert [r.get_string("connection.client.host") for r in parts] == [
+        r.get_string("connection.client.host") for r in whole
+    ]
+
+
+def test_inputformat_fields_metadata_mode(logfile):
+    path, _ = logfile
+    fmt = LogfileInputFormat("combined", ["fields"])
+    reader = fmt.create_record_reader(FileSplit(path, 0, 1))
+    paths = [rec.get_string("fields") for _, rec in reader]
+    assert "IP:connection.client.host" in paths
+    assert any(p.startswith("TIME.EPOCH:") for p in paths)
+
+
+def test_inputformat_from_config():
+    fmt = LogfileInputFormat.from_config(
+        {
+            "logparser.tpu.format": "common",
+            "logparser.tpu.fields": "IP:connection.client.host, STRING:request.status.last",
+        }
+    )
+    assert fmt.log_format == "common"
+    assert fmt.requested_fields == [
+        "IP:connection.client.host",
+        "STRING:request.status.last",
+    ]
+    # Reference key names keep working.
+    fmt2 = LogfileInputFormat.from_config(
+        {"nl.basjes.parse.apachehttpdlogline.format": "combined"}
+    )
+    assert fmt2.log_format == "combined"
+
+
+def test_inputformat_wildcard_fields(logfile):
+    fmt = LogfileInputFormat(
+        "combined",
+        ["IP:connection.client.host", "STRING:request.firstline.uri.query.*"],
+    )
+    import tempfile, os
+    with tempfile.NamedTemporaryFile("w", suffix=".log", delete=False) as f:
+        f.write(GOOD_LINE + "\n")
+        tmp = f.name
+    try:
+        (split,) = fmt.get_splits(tmp)
+        records = [rec for _, rec in fmt.create_record_reader(split)]
+    finally:
+        os.unlink(tmp)
+    assert len(records) == 1
+    multi = records[0].get_string_set("request.firstline.uri.query")
+    assert multi == {
+        "request.firstline.uri.query.res": "1024x768",
+        "request.firstline.uri.query.rev": "2",
+    }
+
+
+# -- loader -------------------------------------------------------------------
+
+def test_loader_requires_logformat():
+    with pytest.raises(ValueError):
+        Loader()
+
+
+def test_loader_fields_mode():
+    loader = Loader("combined", "fields")
+    rows = list(loader.load("/nonexistent"))  # metadata mode: no file IO
+    paths = [r[0] for r in rows]
+    assert "IP:connection.client.host" in paths
+
+
+def test_loader_example_mode():
+    loader = Loader("common")  # no fields -> example mode
+    (row,) = list(loader.load("/nonexistent"))
+    assert "Loader(" in row[0]
+    assert "IP:connection.client.host" in row[0]
+
+
+def test_loader_data_and_schema(logfile):
+    path, lines = logfile
+    loader = Loader(
+        "combined",
+        "IP:connection.client.host",
+        "BYTES:response.body.bytes",
+        "STRING:request.firstline.uri.query.*",
+    )
+    schema = loader.get_schema()
+    assert schema[0] == ("connection_client_host", "chararray")
+    assert schema[1] == ("response_body_bytes", "long")
+    assert schema[2][1] == "map[]"
+
+    rows = list(loader.load(path))
+    assert len(rows) == len(lines) - 1
+    ip, size, qmap = rows[0]
+    assert isinstance(ip, str)
+    assert size is None or isinstance(size, int)
+    assert isinstance(qmap, dict)
+
+
+def test_loader_projection_pushdown(logfile):
+    path, _ = logfile
+    loader = Loader(
+        "combined",
+        "IP:connection.client.host",
+        "BYTES:response.body.bytes",
+    )
+    loader.push_projection(["BYTES:response.body.bytes"])
+    rows = list(loader.load(path))
+    assert all(len(r) == 1 for r in rows)
+    with pytest.raises(ValueError):
+        loader.push_projection(["STRING:never.requested"])
+
+
+def test_loader_map_and_load_protocol(logfile):
+    loader = Loader(
+        "combined",
+        "-map:request.firstline.uri.query.res:SCREENRESOLUTION",
+        "-load:logparser_tpu.dissectors.screenres.ScreenResolutionDissector:x",
+        "SCREENWIDTH:request.firstline.uri.query.res.width",
+    )
+    import tempfile, os
+    with tempfile.NamedTemporaryFile("w", suffix=".log", delete=False) as f:
+        f.write(GOOD_LINE + "\n")
+        tmp = f.name
+    try:
+        (row,) = list(loader.load(tmp))
+    finally:
+        os.unlink(tmp)
+    assert row[0] == 1024
+
+
+def test_loader_bad_protocol_params():
+    with pytest.raises(ValueError):
+        Loader("combined", "-map:only.two")
+    with pytest.raises(ValueError):
+        Loader("combined", "-load:no.such.module.Klass:param")
+
+
+# -- deserializer -------------------------------------------------------------
+
+def _serde_props():
+    return {
+        "logformat": "combined",
+        "columns": "ip,bytes",
+        "columns.types": "string,bigint",
+        "field:ip": "IP:connection.client.host",
+        "field:bytes": "BYTES:response.body.bytes",
+    }
+
+
+def test_serde_rows():
+    serde = LogDeserializer(_serde_props())
+    row = serde.deserialize(GOOD_LINE)
+    assert row[0] == "80.100.47.45"
+    assert row[1] == 4523
+    assert serde.deserialize(BAD_LINE) is None  # tolerated
+    assert serde.lines_bad == 1
+
+
+def test_serde_missing_field_config():
+    props = _serde_props()
+    del props["field:bytes"]
+    with pytest.raises(SerDeException):
+        LogDeserializer(props)
+
+
+def test_serde_circuit_breaker():
+    serde = LogDeserializer(_serde_props())
+    good = generate_combined_lines(1000, seed=3)
+    serde.deserialize_batch(good)
+    # 1% of 1012 is ~10; the 12th bad line trips the breaker.
+    with pytest.raises(SerDeException, match="bad"):
+        serde.deserialize_batch([BAD_LINE] * 12)
+
+
+# -- streaming ----------------------------------------------------------------
+
+def test_parse_stream_and_config_pickles(logfile):
+    _, lines = logfile
+    config = ParserConfig("combined", FIELDS, micro_batch_size=64)
+    config = pickle.loads(pickle.dumps(config))  # ship-to-worker contract
+
+    out = list(parse_stream(iter(lines[:150]), config))
+    assert len(out) == 150
+    bad = [rec for _, rec in out if rec is None]
+    good = [rec for _, rec in out if rec is not None]
+    assert len(bad) == (1 if BAD_LINE in lines[:150] else 0)
+    assert good[0].get_string("connection.client.host")
+    assert good[0].get_long("response.body.bytes") is not None or True
