@@ -7,6 +7,17 @@ p99 parse latency @ batch=64k.  The reference publishes no numbers
 repo's own host oracle (the per-line engine that is parity-tested against the
 reference's semantics) on the same machine.
 
+Three numbers are measured, pessimistic to optimistic:
+- p99 batch latency: H2D + fused kernel + packed D2H, fully serialized.
+- pipelined end-to-end (the headline `value`): batches in flight overlap
+  transfers with compute, the way the streaming adapters drive the chip.
+- device-resident: kernel rate with input already in HBM (the chip's actual
+  parsing speed; what multi-chip scaling multiplies).
+
+NOTE on timing: jax.block_until_ready does not reliably wait on tunneled
+device attachments, so every measurement synchronizes via an explicit
+1-element device->host fetch of the result.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 import json
@@ -18,7 +29,7 @@ import numpy as np
 
 BATCH = 65536
 WARMUP_ITERS = 2
-ITERS = 10
+ITERS = 8
 ORACLE_SAMPLE = 2000
 
 FIELDS = [
@@ -48,28 +59,45 @@ def main():
     parser = TpuBatchParser("combined", FIELDS)
     buf, lengths, _ = encode_batch(lines)
 
-    fn = parser._jitted
+    fn = parser.device_fn(BATCH, buf.shape[1])
     jbuf = jnp.asarray(buf)
     jlengths = jnp.asarray(lengths)
 
+    def sync(x):
+        # Force completion: tiny dependent D2H (block_until_ready is not
+        # trustworthy through tunneled attachments).
+        return np.asarray(x.ravel()[0])
+
     # Warmup / compile.
     for _ in range(WARMUP_ITERS):
-        out = fn(jbuf, jlengths)
-        jax.block_until_ready(out)
+        sync(fn(jbuf, jlengths))
 
-    # Throughput: fused device program (skeleton split + numeric + epoch +
-    # firstline post-stages) including H2D transfer of the byte buffer.
+    # 1) Serialized per-batch latency: H2D + kernel + full packed D2H.
     latencies = []
-    t_total0 = time.perf_counter()
     for _ in range(ITERS):
         t0 = time.perf_counter()
         out = fn(jnp.asarray(buf), jnp.asarray(lengths))
-        jax.block_until_ready(out)
+        np.asarray(jax.device_get(out))
         latencies.append(time.perf_counter() - t0)
-    t_total = time.perf_counter() - t_total0
-
-    lines_per_sec = BATCH * ITERS / t_total
     p99_ms = float(np.percentile(np.array(latencies), 99) * 1000)
+
+    # 2) Pipelined end-to-end: keep batches in flight so H2D/compute/D2H
+    #    overlap; fetch results as they complete.
+    t0 = time.perf_counter()
+    outs = [fn(jnp.asarray(buf), jnp.asarray(lengths)) for _ in range(ITERS)]
+    for out in outs:
+        np.asarray(jax.device_get(out))
+    pipelined = BATCH * ITERS / (time.perf_counter() - t0)
+
+    # 3) Device-resident kernel rate (input already in HBM).  Iterations are
+    #    queued back-to-back (XLA executes in order) and synced ONCE, so the
+    #    tunnel round-trip latency is paid once, not per iteration.
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(ITERS):
+        out = fn(jbuf, jlengths)
+    sync(out)
+    device_resident = BATCH * ITERS / (time.perf_counter() - t0)
 
     # Host oracle baseline (per-line engine) on a sample.
     oracle = parser.oracle
@@ -77,17 +105,18 @@ def main():
     t0 = time.perf_counter()
     for line in sample:
         oracle.parse(line, _CollectingRecord())
-    oracle_secs = time.perf_counter() - t0
-    oracle_lines_per_sec = ORACLE_SAMPLE / oracle_secs
+    oracle_lines_per_sec = ORACLE_SAMPLE / (time.perf_counter() - t0)
 
     print(json.dumps({
         "metric": "loglines/sec/chip (Apache combined)",
-        "value": round(lines_per_sec, 1),
+        "value": round(pipelined, 1),
         "unit": "lines/sec",
-        "vs_baseline": round(lines_per_sec / oracle_lines_per_sec, 2),
+        "vs_baseline": round(pipelined / oracle_lines_per_sec, 2),
         "p99_batch_latency_ms": round(p99_ms, 2),
+        "device_resident_lines_per_sec": round(device_resident, 1),
         "batch": BATCH,
         "fields": len(FIELDS),
+        "pallas": parser.use_pallas,
         "device": str(device),
         "host_oracle_lines_per_sec": round(oracle_lines_per_sec, 1),
     }))

@@ -196,6 +196,22 @@ class LogfileInputFormat:
     def create_record_reader(self, split: FileSplit) -> "LogfileRecordReader":
         return LogfileRecordReader(self, split)
 
+    def shared_parser(self) -> TpuBatchParser:
+        """One TpuBatchParser per input format, shared by every split's
+        reader: the parse config is identical across splits, and a fresh
+        parser per split would re-assemble the oracle and re-JIT the device
+        program (first TPU compile is tens of seconds) once per split."""
+        parser = getattr(self, "_shared_parser", None)
+        if parser is None:
+            parser = TpuBatchParser(
+                self.log_format,
+                self.requested_fields,
+                type_remappings=self.type_remappings,
+                extra_dissectors=self.extra_dissectors,
+            )
+            self._shared_parser = parser
+        return parser
+
 
 class LogfileRecordReader:
     """Reads one split, parses micro-batches on device, yields ParsedRecords."""
@@ -212,12 +228,7 @@ class LogfileRecordReader:
             self.parser = None
             self._casts: Dict[str, Any] = {}
         else:
-            self.parser = TpuBatchParser(
-                input_format.log_format,
-                fields,
-                type_remappings=input_format.type_remappings,
-                extra_dissectors=input_format.extra_dissectors,
-            )
+            self.parser = input_format.shared_parser()
             self._casts = {
                 fid: self.parser.oracle.get_casts(fid) for fid in self.parser.requested
             }

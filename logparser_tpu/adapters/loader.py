@@ -15,12 +15,14 @@ columns.
 """
 from __future__ import annotations
 
+import os
+
 import importlib
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from ..core.casts import Cast
 from ..core.fields import cleanup_field_value
-from .inputformat import FIELDS_MAGIC, FileSplit, LogfileInputFormat
+from .inputformat import Counters, FIELDS_MAGIC, FileSplit, LogfileInputFormat
 
 _MULTI_COMMENT = (
     "  # If you only want a single field replace * with name and use chararray"
@@ -88,6 +90,7 @@ class Loader:
         self.special_parameters: List[str] = []
         self.only_want_list_of_fields = False
         self.is_building_example = False
+        self.counters = Counters()
 
         for param in parameters:
             if self.log_format is None:
@@ -188,8 +191,11 @@ class Loader:
             return
 
         reader = self.input_format.create_record_reader(
-            FileSplit(path, 0, __import__("os").path.getsize(path))
+            FileSplit(path, 0, os.path.getsize(path))
         )
+        # Live-updating counters: available from the first yield, and still
+        # correct when the caller stops consuming early.
+        self.counters = reader.counters
         data_fields = [f for f in self.requested_fields]
         casts_of = {
             f: reader.parser.oracle.get_casts(f) for f in data_fields
@@ -210,7 +216,6 @@ class Loader:
                     continue
                 values.append(record.get_string(name))
             yield tuple(values)
-        self.counters = reader.counters
 
     # ------------------------------------------------------------------
 

@@ -71,12 +71,26 @@ class ParsedRecord:
         if fieldname.endswith(".*"):
             self.multi_prefixes.add(fieldname[:-2])
 
+    def _matching_prefix(self, name: str) -> Optional[str]:
+        """The declared wildcard prefix this name falls under, if any.
+
+        Matched against the declared registry (not derived by splitting the
+        name): wildcard dissectors emit relative names that may themselves
+        contain dots, e.g. query parameter ``utm.source`` under
+        ``request.firstline.uri.query`` (ParsedRecord.java keys its multi
+        maps by the declared prefix for the same reason)."""
+        best = None
+        for p in self.multi_prefixes:
+            if name.startswith(p + ".") and (best is None or len(p) > len(best)):
+                best = p
+        return best
+
     def set_string(self, name: str, value: Optional[str]) -> None:
         if value is None:
             return
         self.strings[name] = value
-        prefix = name.rsplit(".", 1)[0] if "." in name else name
-        if prefix in self.multi_prefixes:
+        prefix = self._matching_prefix(name)
+        if prefix is not None:
             self.multi_strings.setdefault(prefix, {})[name] = value
 
     def set_long(self, name: str, value: Optional[int]) -> None:
@@ -90,7 +104,9 @@ class ParsedRecord:
     def set_multi_value_string(self, name: str, value: Optional[str]) -> None:
         if value is None:
             return
-        prefix = name.rsplit(".", 1)[0] if "." in name else name
+        prefix = self._matching_prefix(name)
+        if prefix is None:
+            prefix = name.rsplit(".", 1)[0] if "." in name else name
         self.multi_strings.setdefault(prefix, {})[name] = value
 
     # -- retrieval ----------------------------------------------------------

@@ -32,6 +32,17 @@ class SerDeException(Exception):
     pass
 
 
+def check_circuit_breaker(lines_bad: int, lines_input: int) -> None:
+    """The Hive >1%-bad-after->=1000-lines abort policy
+    (ApacheHttpdlogDeserializer.java:120-126, 284-289), shared by the serde
+    and the streaming operators."""
+    if lines_input >= _MINIMAL_FAIL_LINES:
+        if 100 * lines_bad > _MINIMAL_FAIL_PERCENTAGE * lines_input:
+            raise SerDeException(
+                f"To many bad lines: {lines_bad} of {lines_input} are bad."
+            )
+
+
 class LogDeserializer:
     """Properties-configured line -> row deserializer (Hive SerDe equivalent)."""
 
@@ -114,12 +125,7 @@ class LogDeserializer:
         return row
 
     def _check_circuit_breaker(self) -> None:
-        if self.lines_input >= _MINIMAL_FAIL_LINES:
-            if 100 * self.lines_bad > _MINIMAL_FAIL_PERCENTAGE * self.lines_input:
-                raise SerDeException(
-                    f"To many bad lines: {self.lines_bad} of "
-                    f"{self.lines_input} are bad."
-                )
+        check_circuit_breaker(self.lines_bad, self.lines_input)
 
     def deserialize_batch(self, lines: Sequence[Any]) -> List[Optional[List[Any]]]:
         """Micro-batch path: one fused device run for the whole batch;

@@ -19,7 +19,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 
 from .inputformat import Counters, records_from_result
 from .record import ParsedRecord
-from .serde import SerDeException
+from .serde import SerDeException, check_circuit_breaker
 
 DEFAULT_MICRO_BATCH = 1024
 
@@ -82,12 +82,8 @@ class ParserMapOperator:
         self.counters.lines_read += result.lines_read
         self.counters.good_lines += result.good_lines
         self.counters.bad_lines += result.bad_lines
-        if self.config.circuit_breaker and self.counters.lines_read >= 1000:
-            if 100 * self.counters.bad_lines > self.counters.lines_read:
-                raise SerDeException(
-                    f"To many bad lines: {self.counters.bad_lines} of "
-                    f"{self.counters.lines_read} are bad."
-                )
+        if self.config.circuit_breaker:
+            check_circuit_breaker(self.counters.bad_lines, self.counters.lines_read)
 
         # Bad lines become None entries: skip-and-count, never fatal per line.
         return records_from_result(result, self.parser.requested, self._casts)

@@ -27,9 +27,17 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import os
+
 from ..core.exceptions import DissectionFailure
 from ..core.fields import cleanup_field_value
 from ..httpd.parser import HttpdLoglineParser
+from .pipeline import (
+    FieldPlan,
+    PackedLayout,
+    build_jnp_fn,
+    build_pallas_fn,
+)
 from .program import (
     CS_CLF_DIGITS,
     CS_DIGITS,
@@ -37,18 +45,23 @@ from .program import (
     UnsupportedFormatError,
     compile_device_program,
 )
-from .runtime import _run_program_impl, encode_batch
+from .runtime import encode_batch
 from . import postproc
 
 _NUMERIC_KINDS = {"long", "long_clf_null", "long_clf_zero", "epoch"}
 
+# Back-compat alias (plan resolution lives here; packing in pipeline.py).
+_FieldPlan = FieldPlan
 
-@dataclass
-class _FieldPlan:
-    field_id: str                 # cleaned "TYPE:path"
-    kind: str                     # span | long | long_clf_null | long_clf_zero
-    #                             | epoch | fl_method | fl_uri | fl_protocol | host
-    token_index: int = -1
+
+def _default_use_pallas() -> bool:
+    env = os.environ.get("LOGPARSER_TPU_PALLAS")
+    if env is not None:
+        return env not in ("0", "false", "no")
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
 
 
 class _CollectingRecord:
@@ -139,9 +152,13 @@ class TpuBatchParser:
         timestamp_format: Optional[str] = None,
         type_remappings: Optional[Dict[str, Any]] = None,
         extra_dissectors: Optional[Sequence[Any]] = None,
+        use_pallas: Optional[bool] = None,
     ):
         self.log_format = log_format
         self.requested = [cleanup_field_value(f) for f in fields]
+        self.use_pallas = (
+            _default_use_pallas() if use_pallas is None else use_pallas
+        )
 
         # Host oracle parser (also the metadata source).
         self.oracle = HttpdLoglineParser(_CollectingRecord, log_format, timestamp_format)
@@ -168,11 +185,26 @@ class TpuBatchParser:
         }
         # No point running the device program when every field is host-only.
         any_device_field = any(p.kind != "host" for p in self.plans)
-        self._jitted = (
-            jax.jit(self._device_fn)
-            if self.program is not None and any_device_field
-            else None
-        )
+        self.layout = PackedLayout.for_plans(self.plans)
+        if self.program is not None and any_device_field:
+            self._jitted = build_jnp_fn(self.program, self.plans, self.layout)
+        else:
+            self._jitted = None
+        self._pallas_fns: Dict[tuple, Any] = {}  # (B, L) -> jitted pallas fn
+
+    def device_fn(self, B: int, L: int):
+        """The fused device executor for one [B, L] shape bucket: Pallas on
+        TPU (one VMEM-resident kernel), plain XLA elsewhere."""
+        if self._jitted is None:
+            return None
+        if not self.use_pallas:
+            return self._jitted
+        key = (B, L)
+        fn = self._pallas_fns.get(key)
+        if fn is None:
+            fn = build_pallas_fn(self.program, self.plans, self.layout, B, L)
+            self._pallas_fns[key] = fn
+        return fn
 
     # ------------------------------------------------------------------
 
@@ -206,49 +238,6 @@ class TpuBatchParser:
         return _FieldPlan(field_id, "host")
 
     # ------------------------------------------------------------------
-    # The fused device computation (traced once per input shape).
-    # ------------------------------------------------------------------
-
-    def _device_fn(self, buf: jnp.ndarray, lengths: jnp.ndarray):
-        res = _run_program_impl(self.program, buf, lengths)
-        starts, ends, valid = res["starts"], res["ends"], res["valid"]
-
-        fl_cache: Dict[int, Dict[str, jnp.ndarray]] = {}
-        cols: Dict[str, Any] = {}
-        for plan in self.plans:
-            if plan.kind in ("host", "span"):
-                continue
-            t_start = starts[plan.token_index]
-            t_end = ends[plan.token_index]
-            if plan.kind in ("long", "long_clf_null", "long_clf_zero"):
-                limbs, is_null, ok = postproc.parse_long_spans(
-                    buf, t_start, t_end, clf=plan.kind != "long"
-                )
-                cols[plan.field_id] = (limbs, is_null, ok)
-            elif plan.kind == "epoch":
-                parts, ok = postproc.parse_apache_timestamp(buf, t_start, t_end)
-                cols[plan.field_id] = (parts, ok)
-                # A timestamp the host layout rejects raises DissectionFailure
-                # there, failing the whole line — mirror that: route the line
-                # to the oracle (which will reject it identically).
-                valid = valid & ok
-            elif plan.kind in ("fl_method", "fl_uri", "fl_protocol"):
-                if plan.token_index not in fl_cache:
-                    fl_cache[plan.token_index] = postproc.split_firstline(
-                        buf, lengths, t_start, t_end
-                    )
-                fl = fl_cache[plan.token_index]
-                part = plan.kind[3:]
-                if part == "protocol":
-                    ok = fl["ok"] & fl["has_protocol"]
-                    s, e = fl["proto_start"], fl["proto_end"]
-                else:
-                    ok = fl["ok"]
-                    s, e = fl[f"{part}_start"], fl[f"{part}_end"]
-                cols[plan.field_id] = (s, e, ok)
-        return {"valid": valid, "starts": starts, "ends": ends, "cols": cols}
-
-    # ------------------------------------------------------------------
 
     def parse_batch(self, lines: Sequence[Union[bytes, str]]) -> BatchResult:
         B = len(lines)
@@ -263,22 +252,27 @@ class TpuBatchParser:
         ones = np.ones(B, dtype=bool)
         zeros_null = np.zeros(B, dtype=bool)
 
-        if self._jitted is not None:
-            dev = self._jitted(jnp.asarray(buf), jnp.asarray(lengths))
-            dev = jax.device_get(dev)
-            valid = np.array(dev["valid"][:B])
-            starts = dev["starts"][:, :B]
-            ends = dev["ends"][:, :B]
-            dev_cols = dev["cols"]
+        fn = self.device_fn(padded_b, buf.shape[1])
+        if fn is not None:
+            # ONE packed [K, B] int32 output -> ONE device->host fetch
+            # (transfer round-trips dominate on tunneled TPU attachments).
+            packed = np.asarray(
+                jax.device_get(fn(jnp.asarray(buf), jnp.asarray(lengths)))
+            )
+            valid = packed[0, :B] != 0
         else:
+            packed = None
             valid = np.zeros(B, dtype=bool)
-            starts = ends = np.zeros((1, B), dtype=np.int32)
-            dev_cols = {}
         for i in overflow:
             valid[i] = False
 
+        get = (
+            (lambda fid, comp: self.layout.get(packed, fid, comp)[:B])
+            if packed is not None
+            else None
+        )
         for plan in self.plans:
-            if plan.kind == "host":
+            if plan.kind == "host" or packed is None:
                 columns[plan.field_id] = {
                     "kind": "span",
                     "starts": np.zeros(B, dtype=np.int32),
@@ -286,44 +280,37 @@ class TpuBatchParser:
                     "ok": np.zeros(B, dtype=bool),
                     "null": zeros_null,
                 }
-            elif plan.kind == "span":
+            elif plan.kind in ("span", "fl_method", "fl_uri", "fl_protocol"):
+                starts_col = get(plan.field_id, "start")
                 columns[plan.field_id] = {
                     "kind": "span",
-                    "starts": starts[plan.token_index],
-                    "ends": ends[plan.token_index],
-                    "ok": ones,
+                    "starts": starts_col,
+                    "ends": starts_col + get(plan.field_id, "len"),
+                    "ok": get(plan.field_id, "ok") != 0,
                     "null": zeros_null,
                 }
-            else:
-                packed = dev_cols[plan.field_id]
-                if plan.kind in ("long", "long_clf_null", "long_clf_zero"):
-                    (hi, lo, lo_digits), is_null, ok = packed
-                    is_null = np.asarray(is_null)[:B]
-                    columns[plan.field_id] = {
-                        "kind": plan.kind,
-                        "values": postproc.combine_long_limbs(
-                            hi[:B], lo[:B], lo_digits[:B], is_null
-                        ),
-                        "null": is_null,
-                        "ok": np.asarray(ok)[:B],
-                    }
-                elif plan.kind == "epoch":
-                    (days, sec_of_day), ok = packed
-                    columns[plan.field_id] = {
-                        "kind": "epoch",
-                        "values": postproc.combine_epoch(days[:B], sec_of_day[:B]),
-                        "null": zeros_null,
-                        "ok": np.asarray(ok)[:B],
-                    }
-                else:  # span (firstline parts)
-                    s, e, ok = packed
-                    columns[plan.field_id] = {
-                        "kind": "span",
-                        "starts": np.asarray(s)[:B],
-                        "ends": np.asarray(e)[:B],
-                        "ok": np.asarray(ok)[:B],
-                        "null": zeros_null,
-                    }
+            elif plan.kind in ("long", "long_clf_null", "long_clf_zero"):
+                is_null = get(plan.field_id, "null") != 0
+                columns[plan.field_id] = {
+                    "kind": plan.kind,
+                    "values": postproc.combine_long_limbs(
+                        get(plan.field_id, "hi"),
+                        get(plan.field_id, "lo"),
+                        get(plan.field_id, "lo_digits"),
+                        is_null,
+                    ),
+                    "null": is_null,
+                    "ok": get(plan.field_id, "ok") != 0,
+                }
+            else:  # epoch
+                columns[plan.field_id] = {
+                    "kind": "epoch",
+                    "values": postproc.combine_epoch(
+                        get(plan.field_id, "days"), get(plan.field_id, "sec")
+                    ),
+                    "null": zeros_null,
+                    "ok": get(plan.field_id, "ok") != 0,
+                }
 
         # Host fallback: invalid lines entirely; host-only fields for every line.
         def coerce(fid: str, value: Any) -> Any:

@@ -1,0 +1,437 @@
+"""Fused device pipeline: split + typed post-stages -> ONE packed [K, B] int32.
+
+Two executions of the SAME computation (single-source, so they cannot drift):
+
+- **jnp path**: plain XLA, used on CPU (tests / virtual meshes) and as the
+  fallback when Pallas is unavailable.
+- **Pallas path** (TPU): the whole pipeline runs as one kernel over [BB, L]
+  line blocks resident in VMEM — the input is read from HBM exactly once and
+  every mask/intermediate lives on-chip.  This is the rebuild's answer to the
+  reference's per-line `Matcher.find()` hot loop
+  (TokenFormatDissector.java:243-275): a compiled split program executed as a
+  vector automaton, not a backtracking regex.
+
+The output is a single packed ``[K, B]`` int32 array (one row per output
+component, described by :class:`PackedLayout`) so the host needs exactly ONE
+device->host fetch per batch — transfer round-trips, not bandwidth, dominate
+on tunneled/virtualized TPU attachments.
+
+Shift discipline: both paths express every data movement as a left-shift of
+the line axis.  The jnp path zero-fills the tail; the Pallas path uses the
+lane roll (wrap-around).  Callers mask every position that could differ, so
+the two are equivalent (asserted by tests/test_tpu_batch.py golden runs).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dataclass_field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import postproc
+from .program import DeviceProgram
+
+_NUMERIC_KINDS = {"long", "long_clf_null", "long_clf_zero", "epoch"}
+
+
+@dataclass
+class FieldPlan:
+    """How one requested field is produced on device ('host' = oracle-only)."""
+
+    field_id: str                 # cleaned "TYPE:path"
+    kind: str                     # span | long | long_clf_null | long_clf_zero
+    #                             | epoch | fl_method | fl_uri | fl_protocol | host
+    token_index: int = -1
+
+
+# ---------------------------------------------------------------------------
+# Shifts: the only data-movement primitive in the pipeline.
+# ---------------------------------------------------------------------------
+
+
+def shift_zero(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Left-shift columns by k, zero-filling the tail (plain-XLA path)."""
+    if k <= 0:
+        return x
+    B, L = x.shape
+    if k >= L:
+        return jnp.zeros_like(x)
+    return jnp.concatenate([x[:, k:], jnp.zeros((B, k), x.dtype)], axis=1)
+
+
+def shift_wrap(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Left-shift columns by k with wrap-around (Pallas lane roll).
+
+    Wrapped-in tail bytes are garbage; every consumer masks positions past
+    the span/line end, so wrap and zero-fill are interchangeable there."""
+    if k <= 0:
+        return x
+    from jax.experimental.pallas import tpu as pltpu
+
+    L = x.shape[1]
+    return pltpu.roll(x, L - (k % L), axis=1)
+
+
+def make_extract(shift_fn) -> Callable:
+    """Span-window extractor from a shift primitive (log-shift alignment).
+
+    extract(buf, start, width) -> [B, width]: bytes at [start, start+width).
+    Decomposes the per-row shift into its bits — log2(L) select+shift passes,
+    no gather (TPU gathers are scalar-slow)."""
+
+    def extract(buf: jnp.ndarray, start: jnp.ndarray, width: int) -> jnp.ndarray:
+        B, L = buf.shape
+        width = min(width, L)
+        x = buf
+        for j in reversed(range(max(1, (L - 1).bit_length()))):
+            k = 1 << j
+            bit = ((start >> j) & 1) == 1
+            x = jnp.where(bit[:, None], shift_fn(x, k), x)
+        return x[:, :width]
+
+    return extract
+
+
+# ---------------------------------------------------------------------------
+# Split program (shared by runtime.run_program and the packed pipeline).
+# ---------------------------------------------------------------------------
+
+
+def _table_intervals(table: np.ndarray) -> List[Tuple[int, int]]:
+    """Decompose a 256-entry bool charset table into [lo, hi] byte intervals,
+    so membership compiles to a few vector compares instead of a gather."""
+    intervals: List[Tuple[int, int]] = []
+    lo = None
+    for b in range(257):
+        inside = b < 256 and bool(table[b])
+        if inside and lo is None:
+            lo = b
+        elif not inside and lo is not None:
+            intervals.append((lo, b - 1))
+            lo = None
+    return intervals
+
+
+def _charset_mask(b32: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
+    """[B, L] bool: byte admitted by the charset, via interval compares."""
+    intervals = _table_intervals(table)
+    if not intervals:
+        return jnp.zeros(b32.shape, dtype=bool)
+    if len(intervals) == 1 and intervals[0] == (0, 255):
+        return jnp.ones(b32.shape, dtype=bool)
+    ok = None
+    for lo, hi in intervals:
+        part = (b32 == lo) if lo == hi else ((b32 >= lo) & (b32 <= hi))
+        ok = part if ok is None else (ok | part)
+    return ok
+
+
+def compute_split(
+    program: DeviceProgram,
+    b32: jnp.ndarray,
+    lengths: jnp.ndarray,
+    shift_fn=shift_zero,
+) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], jnp.ndarray]:
+    """Run the split program over int32 byte rows.
+
+    Returns (start_list, end_list, valid): per-token [B] cursors plus the
+    per-line validity mask.  Gather-free: precomputed literal-match masks and
+    charset masks + masked reductions."""
+    B, L = b32.shape
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
+    cursor = jnp.zeros(B, dtype=jnp.int32)
+    valid = jnp.ones(B, dtype=bool)
+    n_tok = len(program.tokens)
+    zeros = jnp.zeros(B, dtype=jnp.int32)
+    starts: List[jnp.ndarray] = [zeros] * n_tok
+    ends: List[jnp.ndarray] = [zeros] * n_tok
+
+    # Literal-match masks for every distinct separator, computed once: full
+    # literal matches starting at this position AND fits inside the line.
+    lit_masks: Dict[bytes, jnp.ndarray] = {}
+    for lit in sorted({op.lit for op in program.ops if op.lit}):
+        m = None
+        for k, byte in enumerate(lit):
+            part = shift_fn(b32, k) == byte if k else (b32 == byte)
+            m = part if m is None else (m & part)
+        lit_masks[lit] = m & (pos + len(lit) <= lengths[:, None])
+
+    cs_masks = {
+        name: _charset_mask(b32, program.charset_table[cid])
+        for name, cid in program.charset_ids.items()
+    }
+
+    def check_charset(start, end, spec_charset, spec_min_len, valid):
+        cs_ok = cs_masks[spec_charset]
+        outside = (pos < start[:, None]) | (pos >= end[:, None])
+        span_ok = jnp.all(cs_ok | outside, axis=1)
+        width = end - start
+        # CLF alternations ('number|-'): a lone '-' is legal even though the
+        # charset also admits digits; min_len floor of 1 covers both arms.
+        return valid & span_ok & (width >= spec_min_len)
+
+    for op in program.ops:
+        if op.kind == "lit":
+            # Literal matches exactly at the cursor: probe the match mask
+            # with a one-hot reduction (no gather).
+            ok = jnp.any(lit_masks[op.lit] & (pos == cursor[:, None]), axis=1)
+            valid = valid & ok
+            cursor = cursor + len(op.lit)
+        elif op.kind == "until_lit":
+            usable = lit_masks[op.lit] & (pos >= cursor[:, None])
+            found = jnp.min(jnp.where(usable, pos, L), axis=1).astype(jnp.int32)
+            token_valid = found < L
+            start = cursor
+            end = jnp.where(token_valid, found, cursor)
+            valid = check_charset(start, end, op.charset, op.min_len,
+                                  valid & token_valid)
+            starts[op.token_index] = start
+            ends[op.token_index] = end
+            cursor = end + len(op.lit)
+        elif op.kind == "to_end":
+            start = cursor
+            end = lengths
+            valid = check_charset(start, end, op.charset, op.min_len, valid)
+            starts[op.token_index] = start
+            ends[op.token_index] = end
+            cursor = end
+        else:  # pragma: no cover
+            raise AssertionError(op.kind)
+
+    # The whole line must be consumed (the regex is end-anchored).
+    valid = valid & (cursor == lengths)
+    return starts, ends, valid
+
+
+# ---------------------------------------------------------------------------
+# Packed output layout: every output component is a bit slot (row, shift,
+# bits) in the [K, B] int32 result.  Span-producing kinds pack
+# start|len|ok into ONE row (13+13+1 bits; L is capped at 4096 =
+# runtime.DEFAULT_MAX_LINE_LEN); numeric/epoch aux bits (ok/null/lo_digits)
+# share trailing "meta" rows.  Device->host transfer is round-trip- and
+# bandwidth-bound on tunneled attachments, so rows are precious.
+# ---------------------------------------------------------------------------
+
+_SPAN_BITS = 13          # start / len each; supports L up to 8191
+_SPAN_KINDS = ("span", "fl_method", "fl_uri", "fl_protocol")
+
+Slot = Tuple[int, int, int]   # (row, shift, bits); bits=0 -> full int32 row
+
+
+@dataclass
+class PackedLayout:
+    """Bit-slot map for the packed [K, B] int32 output (row 0 = validity)."""
+
+    slots: Dict[str, Dict[str, Slot]] = dataclass_field(default_factory=dict)
+    n_rows: int = 1
+
+    @classmethod
+    def for_plans(cls, plans: Sequence[FieldPlan]) -> "PackedLayout":
+        layout = cls()
+        aux_needs: List[Tuple[str, str, int]] = []  # (field_id, comp, bits)
+        for plan in plans:
+            kind = plan.kind
+            if kind == "host":
+                continue
+            if kind in _SPAN_KINDS:
+                r = layout.n_rows
+                layout.n_rows += 1
+                layout.slots[plan.field_id] = {
+                    "start": (r, 0, _SPAN_BITS),
+                    "len": (r, _SPAN_BITS, _SPAN_BITS),
+                    "ok": (r, 2 * _SPAN_BITS, 1),
+                }
+            elif kind in ("long", "long_clf_null", "long_clf_zero"):
+                rhi, rlo = layout.n_rows, layout.n_rows + 1
+                layout.n_rows += 2
+                layout.slots[plan.field_id] = {
+                    "hi": (rhi, 0, 0),
+                    "lo": (rlo, 0, 0),
+                }
+                aux_needs += [
+                    (plan.field_id, "ok", 1),
+                    (plan.field_id, "null", 1),
+                    (plan.field_id, "lo_digits", 4),
+                ]
+            elif kind == "epoch":
+                rd, rs = layout.n_rows, layout.n_rows + 1
+                layout.n_rows += 2
+                layout.slots[plan.field_id] = {
+                    "days": (rd, 0, 0),
+                    "sec": (rs, 0, 0),
+                }
+                aux_needs.append((plan.field_id, "ok", 1))
+            else:  # pragma: no cover
+                raise AssertionError(kind)
+        # Pack aux bits into shared meta rows (30 usable bits per row: the
+        # sign bit stays clear and decoding needs no sign games).
+        shift = 30
+        row = layout.n_rows - 1
+        for fid, comp, bits in aux_needs:
+            if shift + bits > 30:
+                row = layout.n_rows
+                layout.n_rows += 1
+                shift = 0
+            layout.slots.setdefault(fid, {})[comp] = (row, shift, bits)
+            shift += bits
+        return layout
+
+    # -- host-side decode ------------------------------------------------
+
+    def get(self, packed: np.ndarray, field_id: str, comp: str) -> np.ndarray:
+        """Decode one component from the packed [K, B] host array."""
+        row, shift, bits = self.slots[field_id][comp]
+        col = packed[row]
+        if bits == 0:
+            return col
+        return (col >> shift) & ((1 << bits) - 1)
+
+
+def compute_rows(
+    program: DeviceProgram,
+    plans: Sequence[FieldPlan],
+    layout: PackedLayout,
+    b32: jnp.ndarray,
+    lengths: jnp.ndarray,
+    shift_fn=shift_zero,
+) -> List[jnp.ndarray]:
+    """The fused computation: split + per-plan post-stages -> K rows of [B]
+    int32 (row 0 = line validity).  Returned as a list so the Pallas kernel
+    can write rows to the output ref one by one (Mosaic miscompiles a wide
+    1-D stack) while the jnp path stacks them."""
+    B = b32.shape[0]
+    starts, ends, valid = compute_split(program, b32, lengths, shift_fn)
+    extract = None if shift_fn is shift_zero else make_extract(shift_fn)
+
+    rows: List[Optional[jnp.ndarray]] = [None] * layout.n_rows
+    fl_cache: Dict[int, Dict[str, jnp.ndarray]] = {}
+    ones = jnp.ones(B, dtype=jnp.int32)
+
+    def put(fid: str, comp: str, val: jnp.ndarray) -> None:
+        row, shift, bits = layout.slots[fid][comp]
+        v = val.astype(jnp.int32)
+        if bits:
+            v = (v & ((1 << bits) - 1)) << shift
+        rows[row] = v if rows[row] is None else (rows[row] | v)
+
+    def put_span(fid: str, s, e, ok) -> None:
+        put(fid, "start", s)
+        put(fid, "len", e - s)
+        put(fid, "ok", ok)
+
+    for plan in plans:
+        if plan.kind == "host":
+            continue
+        t_start = starts[plan.token_index]
+        t_end = ends[plan.token_index]
+        if plan.kind == "span":
+            put_span(plan.field_id, t_start, t_end, ones)
+        elif plan.kind in ("long", "long_clf_null", "long_clf_zero"):
+            (hi, lo, lo_digits), is_null, ok = postproc.parse_long_spans(
+                b32, t_start, t_end, clf=plan.kind != "long", extract=extract
+            )
+            put(plan.field_id, "hi", hi)
+            put(plan.field_id, "lo", lo)
+            put(plan.field_id, "lo_digits", lo_digits)
+            put(plan.field_id, "ok", jnp.where(ok, 1, 0))
+            put(plan.field_id, "null", jnp.where(is_null, 1, 0))
+        elif plan.kind == "epoch":
+            (days, sec), ok = postproc.parse_apache_timestamp(
+                b32, t_start, t_end, extract=extract
+            )
+            put(plan.field_id, "days", days)
+            put(plan.field_id, "sec", sec)
+            put(plan.field_id, "ok", jnp.where(ok, 1, 0))
+            # A timestamp the host layout rejects raises DissectionFailure
+            # there, failing the whole line — mirror that: route the line to
+            # the oracle (which will reject it identically).
+            valid = valid & ok
+        elif plan.kind in ("fl_method", "fl_uri", "fl_protocol"):
+            if plan.token_index not in fl_cache:
+                fl_cache[plan.token_index] = postproc.split_firstline(
+                    b32, lengths, t_start, t_end, extract=extract
+                )
+            fl = fl_cache[plan.token_index]
+            part = plan.kind[3:]
+            if part == "protocol":
+                ok = fl["ok"] & fl["has_protocol"]
+                s, e = fl["proto_start"], fl["proto_end"]
+            else:
+                ok = fl["ok"]
+                s, e = fl[f"{part}_start"], fl[f"{part}_end"]
+            put_span(plan.field_id, s, e, jnp.where(ok, 1, 0))
+        else:  # pragma: no cover
+            raise AssertionError(plan.kind)
+
+    rows[0] = jnp.where(valid, 1, 0).astype(jnp.int32)
+    zero = jnp.zeros(B, dtype=jnp.int32)
+    return [r if r is not None else zero for r in rows]
+
+
+# ---------------------------------------------------------------------------
+# Entry points: jnp and Pallas executors of the packed pipeline.
+# ---------------------------------------------------------------------------
+
+
+def build_jnp_fn(program: DeviceProgram, plans: Sequence[FieldPlan],
+                 layout: PackedLayout):
+    """Plain-XLA executor: (buf [B,L] uint8, lengths [B]) -> [K, B] int32."""
+
+    def fn(buf: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+        return jnp.stack(compute_rows(
+            program, plans, layout, buf.astype(jnp.int32), lengths, shift_zero
+        ))
+
+    return jax.jit(fn)
+
+
+def _block_lines(L: int) -> int:
+    """Lines per Pallas block: keep the [BB, L] working set VMEM-friendly
+    (~0.5 MB per int32 mask, headroom for ~dozen live masks)."""
+    bb = max(32, (128 * 1024) // max(L, 1))
+    # power of two
+    return 1 << (bb.bit_length() - 1)
+
+
+def build_pallas_fn(program: DeviceProgram, plans: Sequence[FieldPlan],
+                    layout: PackedLayout, B: int, L: int,
+                    interpret: Optional[bool] = None):
+    """Pallas executor for a fixed [B, L] shape: one fused VMEM-resident
+    kernel over line blocks.  (buf, lengths[B,1]) -> [K, B] int32.
+
+    ``interpret`` defaults to True off-TPU so the kernel stays testable on
+    the CPU mesh (pltpu.roll & friends run in the Pallas interpreter)."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    K = layout.n_rows
+    BB = min(_block_lines(L), B)
+
+    def kernel(buf_ref, len_ref, out_ref):
+        b32 = buf_ref[...].astype(jnp.int32)
+        lengths = len_ref[...][:, 0]
+        rows = compute_rows(program, plans, layout, b32, lengths, shift_wrap)
+        for i, row in enumerate(rows):
+            out_ref[i, :] = row
+
+    grid = (B // BB,)
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((BB, L), lambda i: (i, 0)),
+            pl.BlockSpec((BB, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((K, BB), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((K, B), jnp.int32),
+        interpret=interpret,
+    )
+
+    def fn(buf: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+        return call(buf, lengths.reshape(-1, 1))
+
+    return jax.jit(fn)

@@ -40,12 +40,31 @@ def _month_table() -> np.ndarray:
 _MONTH_TABLE = _month_table()
 
 
+def _pad_cols(x: jnp.ndarray, w: int) -> jnp.ndarray:
+    B, cur = x.shape
+    if cur >= w:
+        return x[:, :w]
+    return jnp.pad(x, ((0, 0), (0, w - cur)))
+
+
 def gather_span_bytes(buf: jnp.ndarray, start: jnp.ndarray, width: int) -> jnp.ndarray:
-    """Gather `width` bytes per line beginning at start: [B, width]."""
+    """Extract `width` bytes per line beginning at start: [B, width].
+
+    TPU gathers are scalar-slow, so this is a log-shift alignment instead:
+    decompose the per-row shift into its bits and apply each power-of-two
+    shift as a static slice + select.  The working width narrows as high bits
+    are consumed, so total work is ~(width * log2(L) + L) elements — a couple
+    of [B, L]-equivalent vector passes, no gather.  Bytes shifted in from
+    beyond the row are 0 (callers' validity masks already exclude them)."""
     B, L = buf.shape
-    idx = jnp.clip(start[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :],
-                   0, L - 1)
-    return jnp.take_along_axis(buf, idx, axis=1)
+    width = min(width, L)
+    x = buf
+    for j in reversed(range(max(1, (L - 1).bit_length()))):
+        k = 1 << j
+        need = width + k - 1
+        bit = ((start >> j) & 1) == 1
+        x = jnp.where(bit[:, None], _pad_cols(x[:, k:], need), _pad_cols(x, need))
+    return x[:, :width]
 
 
 def parse_long_spans(
@@ -53,6 +72,7 @@ def parse_long_spans(
     start: jnp.ndarray,
     end: jnp.ndarray,
     clf: bool = False,
+    extract=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Spans of ASCII digits -> int64.
 
@@ -60,9 +80,11 @@ def parse_long_spans(
     is_null=True (the reference maps '-' to null, ApacheHttpdLogFormatDissector
     decodeExtractedValue :176-178).
     """
+    extract = extract or gather_span_bytes
     n = end - start
-    bytes_ = gather_span_bytes(buf, start, MAX_LONG_DIGITS)
-    in_span = jnp.arange(MAX_LONG_DIGITS, dtype=jnp.int32)[None, :] < n[:, None]
+    bytes_ = extract(buf, start, MAX_LONG_DIGITS)
+    col = jax.lax.broadcasted_iota(jnp.int32, (buf.shape[0], MAX_LONG_DIGITS), 1)
+    in_span = col < n[:, None]
     digits = (bytes_ - np.uint8(ord("0"))).astype(jnp.int32)
     digit_ok = (digits >= 0) & (digits <= 9)
 
@@ -118,14 +140,15 @@ def _two_digits(b: jnp.ndarray, i: int) -> jnp.ndarray:
 
 
 def parse_apache_timestamp(
-    buf: jnp.ndarray, start: jnp.ndarray, end: jnp.ndarray
+    buf: jnp.ndarray, start: jnp.ndarray, end: jnp.ndarray, extract=None
 ) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
     """``dd/MMM/yyyy:HH:mm:ss +ZZZZ`` spans -> ((days, sec_of_day), ok).
 
     Layout offsets: dd=0..1 /  MMM=3..5 / yyyy=7..10 : HH=12 : mm=15 : ss=18
     ' ' sign=21 offHH=22 offMM=24.
     """
-    b = gather_span_bytes(buf, start, 26)
+    extract = extract or gather_span_bytes
+    b = extract(buf, start, 26)
     width_ok = (end - start) == 26
 
     day = _two_digits(b, 0)
@@ -136,8 +159,14 @@ def parse_apache_timestamp(
     letters_ok = (
         (l0 >= 0) & (l0 < 26) & (l1 >= 0) & (l1 < 26) & (l2 >= 0) & (l2 < 26)
     )
-    h = jnp.clip((l0 * 26 + l1) * 26 + l2, 0, 26 * 26 * 26 - 1)
-    month = jnp.asarray(_MONTH_TABLE)[h].astype(jnp.int32)
+    # 12 vector compares instead of a table gather (TPU gathers are slow).
+    h = (l0 * 26 + l1) * 26 + l2
+    month = jnp.zeros(buf.shape[0], dtype=jnp.int32)
+    for m, name in enumerate(_MONTHS, start=1):
+        hm = ((ord(name[0]) - 97) * 26 + (ord(name[1]) - 97)) * 26 + (
+            ord(name[2]) - 97
+        )
+        month = jnp.where(h == hm, m, month)
 
     year = (
         (b[:, 7] - np.uint8(ord("0"))).astype(jnp.int32) * 1000
@@ -165,11 +194,8 @@ def parse_apache_timestamp(
     # Day-in-month with leap years, so the device accepts exactly what the
     # host layout accepts (no silent wrong epochs bypassing the oracle).
     leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
-    dim = jnp.asarray(
-        np.array([0, 31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
-                 dtype=np.int32)
-    )[jnp.clip(month, 0, 12)]
-    dim = dim + jnp.where((month == 2) & leap, 1, 0)
+    thirty = (month == 4) | (month == 6) | (month == 9) | (month == 11)
+    dim = jnp.where(thirty, 30, jnp.where(month == 2, jnp.where(leap, 29, 28), 31))
     fields_ok = (
         (month >= 1)
         & (day >= 1)
@@ -201,6 +227,7 @@ def split_firstline(
     lengths: jnp.ndarray,
     start: jnp.ndarray,
     end: jnp.ndarray,
+    extract=None,
 ) -> Dict[str, jnp.ndarray]:
     """"METHOD URI PROTO" span -> method/uri/protocol sub-spans.
 
@@ -210,13 +237,14 @@ def split_firstline(
     ``has_protocol`` distinguishes the two cases; fully garbage lines (no
     space at all) get ok=False.
     """
+    extract = extract or gather_span_bytes
     B, L = buf.shape
-    pos = jnp.arange(L, dtype=jnp.int32)
-    in_span = (pos[None, :] >= start[:, None]) & (pos[None, :] < end[:, None])
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
+    in_span = (pos >= start[:, None]) & (pos < end[:, None])
     is_space = (buf == np.uint8(ord(" "))) & in_span
 
-    first_space = jnp.min(jnp.where(is_space, pos[None, :], L), axis=1)
-    last_space = jnp.max(jnp.where(is_space, pos[None, :], -1), axis=1)
+    first_space = jnp.min(jnp.where(is_space, pos, L), axis=1)
+    last_space = jnp.max(jnp.where(is_space, pos, -1), axis=1)
 
     has_space = first_space < L
     method_start = start
@@ -226,7 +254,7 @@ def split_firstline(
     # HTTP/[0-9]+\.[0-9]+ exactly (the 3-part regex arm; otherwise the
     # truncated-line fallback applies).
     proto_start = jnp.where(has_space, last_space + 1, end)
-    head = gather_span_bytes(buf, proto_start, 5)
+    head = extract(buf, proto_start, 5)
     head_ok = (
         (head[:, 0] == np.uint8(ord("H")))
         & (head[:, 1] == np.uint8(ord("T")))
@@ -234,13 +262,13 @@ def split_firstline(
         & (head[:, 3] == np.uint8(ord("P")))
         & (head[:, 4] == np.uint8(ord("/")))
     )
-    ver = (pos[None, :] >= (proto_start + 5)[:, None]) & (pos[None, :] < end[:, None])
+    ver = (pos >= (proto_start + 5)[:, None]) & (pos < end[:, None])
     is_digit = (buf >= np.uint8(ord("0"))) & (buf <= np.uint8(ord("9")))
     is_dot = buf == np.uint8(ord("."))
     ver_chars_ok = jnp.all(is_digit | is_dot | ~ver, axis=1)
     one_dot = jnp.sum(jnp.where(is_dot & ver, 1, 0), axis=1) == 1
-    last_b = gather_span_bytes(buf, jnp.maximum(end - 1, 0), 1)[:, 0]
-    first_ver = gather_span_bytes(buf, proto_start + 5, 1)[:, 0]
+    last_b = extract(buf, jnp.maximum(end - 1, 0), 1)[:, 0]
+    first_ver = extract(buf, proto_start + 5, 1)[:, 0]
     ver_ok = (
         ((end - proto_start) >= 8)
         & ver_chars_ok

@@ -61,80 +61,20 @@ def encode_batch(
     return buf, lengths, overflow
 
 
-def _find_literal(buf: jnp.ndarray, lengths: jnp.ndarray, lit: bytes,
-                  cursor: jnp.ndarray) -> jnp.ndarray:
-    """First position >= cursor where `lit` occurs fully inside the line;
-    L (=out of range) when absent.  buf: [B, L]; cursor: [B]."""
-    B, L = buf.shape
-    match = jnp.ones((B, L), dtype=bool)
-    for k, byte in enumerate(lit):
-        shifted = buf if k == 0 else jnp.roll(buf, -k, axis=1)
-        match = match & (shifted == np.uint8(byte))
-    pos = jnp.arange(L, dtype=jnp.int32)
-    inside = pos[None, :] + len(lit) <= lengths[:, None]
-    usable = match & inside & (pos[None, :] >= cursor[:, None])
-    cand = jnp.where(usable, pos[None, :], L)
-    return jnp.min(cand, axis=1).astype(jnp.int32)
-
-
 def _run_program_impl(
     program: DeviceProgram,
     buf: jnp.ndarray,
     lengths: jnp.ndarray,
 ) -> Dict[str, jnp.ndarray]:
-    B, L = buf.shape
-    cursor = jnp.zeros(B, dtype=jnp.int32)
-    valid = jnp.ones(B, dtype=bool)
-    n_tok = len(program.tokens)
-    starts = jnp.zeros((n_tok, B), dtype=jnp.int32)
-    ends = jnp.zeros((n_tok, B), dtype=jnp.int32)
+    """Back-compat wrapper over the shared split pipeline (pipeline.py)."""
+    from .pipeline import compute_split
 
-    pos = jnp.arange(L, dtype=jnp.int32)
-    charset_table = jnp.asarray(program.charset_table)
-
-    def check_charset(start, end, spec_charset, spec_min_len, valid):
-        cs = charset_table[program.charset_ids[spec_charset]]
-        in_span = (pos[None, :] >= start[:, None]) & (pos[None, :] < end[:, None])
-        ok_bytes = cs[buf]
-        span_ok = jnp.all(ok_bytes | ~in_span, axis=1)
-        width = end - start
-        # CLF alternations ('number|-'): a lone '-' is legal even though the
-        # charset also admits digits; min_len floor of 1 covers both arms.
-        return valid & span_ok & (width >= spec_min_len)
-
-    for op in program.ops:
-        if op.kind == "lit":
-            ok = jnp.ones(B, dtype=bool)
-            for k, byte in enumerate(op.lit):
-                idx = jnp.clip(cursor + k, 0, L - 1)
-                ok = ok & (jnp.take_along_axis(buf, idx[:, None], axis=1)[:, 0]
-                           == np.uint8(byte))
-            ok = ok & (cursor + len(op.lit) <= lengths)
-            valid = valid & ok
-            cursor = cursor + len(op.lit)
-        elif op.kind == "until_lit":
-            found = _find_literal(buf, lengths, op.lit, cursor)
-            token_valid = found < L
-            start = cursor
-            end = jnp.where(token_valid, found, cursor)
-            valid = check_charset(start, end, op.charset, op.min_len,
-                                  valid & token_valid)
-            starts = starts.at[op.token_index].set(start)
-            ends = ends.at[op.token_index].set(end)
-            cursor = end + len(op.lit)
-        elif op.kind == "to_end":
-            start = cursor
-            end = lengths
-            valid = check_charset(start, end, op.charset, op.min_len, valid)
-            starts = starts.at[op.token_index].set(start)
-            ends = ends.at[op.token_index].set(end)
-            cursor = end
-        else:  # pragma: no cover
-            raise AssertionError(op.kind)
-
-    # The whole line must be consumed (the regex is end-anchored).
-    valid = valid & (cursor == lengths)
-    return {"starts": starts, "ends": ends, "valid": valid}
+    starts, ends, valid = compute_split(program, buf.astype(jnp.int32), lengths)
+    return {
+        "starts": jnp.stack(starts),
+        "ends": jnp.stack(ends),
+        "valid": valid,
+    }
 
 
 def _jitted_for(program: DeviceProgram):

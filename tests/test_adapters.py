@@ -297,3 +297,22 @@ def test_parse_stream_and_config_pickles(logfile):
     assert len(bad) == (1 if BAD_LINE in lines[:150] else 0)
     assert good[0].get_string("connection.client.host")
     assert good[0].get_long("response.body.bytes") is not None or True
+
+
+def test_wildcard_multi_value_with_dotted_relative_name():
+    """Wildcard values whose relative names contain dots (e.g. query param
+    'utm.source') must be filed under the DECLARED prefix, not one derived by
+    splitting the full name."""
+    from logparser_tpu.adapters.record import ParsedRecord
+
+    rec = ParsedRecord()
+    rec.declare_requested_fieldname("request.firstline.uri.query.*")
+    rec.set_string("request.firstline.uri.query.page", "1")
+    rec.set_string("request.firstline.uri.query.utm.source", "news")
+    got = rec.get_string_set("request.firstline.uri.query")
+    assert got == {
+        "request.firstline.uri.query.page": "1",
+        "request.firstline.uri.query.utm.source": "news",
+    }
+    # binary round-trip keeps the multi map intact
+    assert ParsedRecord.from_bytes(rec.to_bytes()).multi_strings == rec.multi_strings

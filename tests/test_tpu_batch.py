@@ -185,3 +185,25 @@ def test_negative_epoch_strftime():
 
     assert compile_strftime("%s").parse("-86400").epoch_millis == -86400000
     assert compile_strftime("%s").parse("86400").epoch_millis == 86400000
+
+
+def test_pallas_kernel_matches_jnp_pipeline():
+    """The Pallas kernel (interpret mode on CPU) and the plain-XLA pipeline
+    are the same single-source computation; this asserts the wrap-shift vs
+    zero-shift discipline really is observationally equivalent."""
+    lines = generate_combined_lines(48, seed=7) + [
+        b"garbage that does not parse",
+        b'1.2.3.4 - - [31/Dec/2019:23:59:59 -1130] "HEAD / HTTP/1.0" 301 - "-" "-"',
+    ]
+    fields = [
+        "IP:connection.client.host",
+        "TIME.EPOCH:request.receive.time.epoch",
+        "HTTP.METHOD:request.firstline.method",
+        "HTTP.URI:request.firstline.uri",
+        "STRING:request.status.last",
+        "BYTES:response.body.bytes",
+    ]
+    jnp_parser = TpuBatchParser("combined", fields, use_pallas=False)
+    pallas_parser = TpuBatchParser("combined", fields, use_pallas=True)
+    assert jnp_parser.parse_batch(lines).to_dict() == \
+        pallas_parser.parse_batch(lines).to_dict()
