@@ -1,0 +1,156 @@
+"""Native host tier: C++ line framing/packing with a pure-numpy fallback.
+
+``encode_blob(data)`` is the product ingest path: newline-delimited log bytes
+-> (padded [B, L] uint8 buffer, lengths, overflow rows) ready for the device
+pipeline.  The C++ library (logframe.cc) is compiled on first use with the
+baked-in g++ toolchain and bound via ctypes (no pybind11 in the image); when
+no compiler is available the numpy fallback keeps everything working at
+reduced host throughput.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "logframe.cc")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_OVERFLOW_BIT = 1 << 30
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _compile_lib() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_BUILD_DIR, f"logframe-{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", tmp]
+    try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)
+    except (OSError, subprocess.SubprocessError):
+        # No toolchain or a read-only install tree: numpy fallback.
+        return None
+    return so_path
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, compiling it on first use; None if the
+    toolchain is unavailable (callers fall back to numpy)."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        so_path = _compile_lib()
+        if so_path is None:
+            _lib_failed = True
+            return None
+        lib = ctypes.CDLL(so_path)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.lp_scan.argtypes = [u8p, ctypes.c_int64, i64p, i64p]
+        lib.lp_scan.restype = None
+        lib.lp_frame.argtypes = [u8p, ctypes.c_int64, i64p, i32p, ctypes.c_int64]
+        lib.lp_frame.restype = ctypes.c_int64
+        lib.lp_pack.argtypes = [u8p, i64p, i32p, ctypes.c_int64, u8p, i32p,
+                                ctypes.c_int64, ctypes.c_int32]
+        lib.lp_pack.restype = None
+        lib.lp_frame_pack.argtypes = [u8p, ctypes.c_int64, u8p, i32p,
+                                      ctypes.c_int64, ctypes.c_int64,
+                                      ctypes.c_int32]
+        lib.lp_frame_pack.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def _u8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _default_threads() -> int:
+    return min(8, os.cpu_count() or 1)
+
+
+def encode_blob(
+    data: bytes,
+    line_len: int = 0,
+    min_bucket: int = 64,
+    cap: int = 4096,
+    threads: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, List[int]]:
+    """Newline-delimited bytes -> (buf [B, L] uint8, lengths [B] int32,
+    overflow row indices).  L is the power-of-two bucket of the longest line
+    (<= cap) unless ``line_len`` pins it."""
+    blob = np.frombuffer(data, dtype=np.uint8)
+    lib = get_lib()
+    if lib is None:
+        return _encode_blob_numpy(data, line_len, min_bucket, cap)
+
+    n_lines = ctypes.c_int64()
+    max_len = ctypes.c_int64()
+    lib.lp_scan(_u8(blob), blob.size, ctypes.byref(n_lines),
+                ctypes.byref(max_len))
+    n = n_lines.value
+    if line_len <= 0:
+        L = min_bucket
+        while L < max_len.value and L < cap:
+            L *= 2
+    else:
+        L = line_len
+    buf = np.zeros((max(n, 1), L), dtype=np.uint8)
+    lengths = np.zeros(max(n, 1), dtype=np.int32)
+    if n:
+        lib.lp_frame_pack(
+            _u8(blob), blob.size, _u8(buf),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n, L, threads or _default_threads(),
+        )
+    overflow = np.nonzero(lengths & _OVERFLOW_BIT)[0]
+    lengths = (lengths & ~_OVERFLOW_BIT).astype(np.int32)
+    return buf[:n], lengths[:n], [int(i) for i in overflow if i < n]
+
+
+def _encode_blob_numpy(
+    data: bytes, line_len: int, min_bucket: int, cap: int
+) -> Tuple[np.ndarray, np.ndarray, List[int]]:
+    """Pure-numpy fallback with identical semantics."""
+    lines = data.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    lines = [ln[:-1] if ln.endswith(b"\r") else ln for ln in lines]
+    max_len = max((len(r) for r in lines), default=1)
+    if line_len <= 0:
+        L = min_bucket
+        while L < max_len and L < cap:
+            L *= 2
+    else:
+        L = line_len
+    buf = np.zeros((max(len(lines), 1), L), dtype=np.uint8)
+    lengths = np.zeros(max(len(lines), 1), dtype=np.int32)
+    overflow: List[int] = []
+    for i, r in enumerate(lines):
+        if len(r) > L:
+            overflow.append(i)
+            r = r[:L]
+        buf[i, : len(r)] = np.frombuffer(r, dtype=np.uint8)
+        lengths[i] = len(r)
+    return buf[: len(lines)], lengths[: len(lines)], overflow

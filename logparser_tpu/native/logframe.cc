@@ -1,0 +1,110 @@
+// Host-side batch framing: newline-delimited log bytes -> padded [B, L]
+// uint8 buffers + lengths, the wire format of the TPU split pipeline
+// (logparser_tpu/tpu/runtime.py encode_batch).
+//
+// This is the rebuild's native data-loader tier.  The reference has no
+// native code (SURVEY.md §2: 100% Java; its line framing lives in Hadoop's
+// LineRecordReader, httpdlog-inputformat/.../ApacheHttpdLogfileRecordReader
+// .java:57) — here the framing + packing loop is the host hot path feeding
+// the chip, so it is C++ with a pthread fan-out over row ranges, exposed to
+// Python via ctypes (no pybind11 in the image).
+//
+// Line semantics match the reader: lines split on '\n', a trailing '\r' is
+// stripped (CRLF tolerance), a final unterminated line counts.  Lines longer
+// than L are truncated in the buffer and reported through the per-line
+// lengths array as (L | LP_OVERFLOW_BIT) — the flag marks the row for the
+// host oracle path; the stored length is the truncated one.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+const int32_t LP_OVERFLOW_BIT = 1 << 30;
+
+// Pass 1: count lines and the maximum line length (bucket selection).
+void lp_scan(const uint8_t* data, int64_t size,
+             int64_t* n_lines, int64_t* max_len) {
+  int64_t lines = 0, maxlen = 0, start = 0;
+  for (int64_t i = 0; i <= size; ++i) {
+    if (i == size || data[i] == '\n') {
+      if (i == size && i == start) break;  // no trailing fragment
+      int64_t end = i;
+      if (end > start && data[end - 1] == '\r') --end;
+      ++lines;
+      maxlen = std::max(maxlen, end - start);
+      start = i + 1;
+    }
+  }
+  *n_lines = lines;
+  *max_len = maxlen;
+}
+
+// Frame into offsets (line starts) + lens.  Returns the number of lines.
+int64_t lp_frame(const uint8_t* data, int64_t size,
+                 int64_t* offsets, int32_t* lens, int64_t max_lines) {
+  int64_t n = 0, start = 0;
+  for (int64_t i = 0; i <= size && n < max_lines; ++i) {
+    if (i == size || data[i] == '\n') {
+      if (i == size && i == start) break;
+      int64_t end = i;
+      if (end > start && data[end - 1] == '\r') --end;
+      offsets[n] = start;
+      lens[n] = static_cast<int32_t>(end - start);
+      ++n;
+      start = i + 1;
+    }
+  }
+  return n;
+}
+
+// Pack framed lines into a padded [n, L] uint8 buffer (zero-filled) +
+// lengths with the overflow bit for truncated lines.  Multi-threaded over
+// row ranges.
+void lp_pack(const uint8_t* data, const int64_t* offsets,
+             const int32_t* lens, int64_t n,
+             uint8_t* out, int32_t* lengths, int64_t L, int32_t threads) {
+  if (threads < 1) threads = 1;
+  int64_t chunk = (n + threads - 1) / threads;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      int64_t len = lens[r];
+      uint8_t* row = out + r * L;
+      if (len > L) {
+        std::memcpy(row, data + offsets[r], L);
+        lengths[r] = static_cast<int32_t>(L) | LP_OVERFLOW_BIT;
+      } else {
+        std::memcpy(row, data + offsets[r], len);
+        std::memset(row + len, 0, L - len);
+        lengths[r] = static_cast<int32_t>(len);
+      }
+    }
+  };
+  if (threads == 1 || n < 4096) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  for (int32_t t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back(work, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+}
+
+// One-shot convenience: frame + pack a whole blob.  Returns line count.
+int64_t lp_frame_pack(const uint8_t* data, int64_t size,
+                      uint8_t* out, int32_t* lengths,
+                      int64_t max_lines, int64_t L, int32_t threads) {
+  std::vector<int64_t> offsets(max_lines);
+  std::vector<int32_t> lens(max_lines);
+  int64_t n = lp_frame(data, size, offsets.data(), lens.data(), max_lines);
+  lp_pack(data, offsets.data(), lens.data(), n, out, lengths, L, threads);
+  return n;
+}
+
+}  // extern "C"

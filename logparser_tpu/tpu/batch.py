@@ -57,7 +57,7 @@ _FieldPlan = FieldPlan
 def _default_use_pallas() -> bool:
     env = os.environ.get("LOGPARSER_TPU_PALLAS")
     if env is not None:
-        return env not in ("0", "false", "no")
+        return env.strip().lower() not in ("0", "false", "no")
     try:
         return jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover

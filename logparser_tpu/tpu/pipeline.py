@@ -34,8 +34,6 @@ import jax.numpy as jnp
 from . import postproc
 from .program import DeviceProgram
 
-_NUMERIC_KINDS = {"long", "long_clf_null", "long_clf_zero", "epoch"}
-
 
 @dataclass
 class FieldPlan:

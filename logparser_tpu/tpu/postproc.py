@@ -22,22 +22,10 @@ import jax.numpy as jnp
 
 MAX_LONG_DIGITS = 18
 
-# Month name lookup: hash = (l0*26 + l1)*26 + l2 over lowercased letters.
+# Month names; matched via (l0*26 + l1)*26 + l2 hash compares in
+# parse_apache_timestamp.
 _MONTHS = ["jan", "feb", "mar", "apr", "may", "jun",
            "jul", "aug", "sep", "oct", "nov", "dec"]
-
-
-def _month_table() -> np.ndarray:
-    table = np.zeros(26 * 26 * 26, dtype=np.int8)
-    for m, name in enumerate(_MONTHS, start=1):
-        h = ((ord(name[0]) - 97) * 26 + (ord(name[1]) - 97)) * 26 + (
-            ord(name[2]) - 97
-        )
-        table[h] = m
-    return table
-
-
-_MONTH_TABLE = _month_table()
 
 
 def _pad_cols(x: jnp.ndarray, w: int) -> jnp.ndarray:

@@ -46,6 +46,20 @@ def encode_batch(
     raw = [
         line.encode("utf-8") if isinstance(line, str) else line for line in lines
     ]
+    # Native fast path: join + C++ frame/pack (logparser_tpu/native).  Only
+    # safe when re-framing the joined blob reproduces the list exactly — no
+    # embedded newlines, no trailing '\r' the framer would strip.
+    if raw:
+        from ..native import encode_blob, native_available
+
+        if native_available() and not any(
+            b"\n" in r or r.endswith(b"\r") or not r for r in raw
+        ):
+            buf, lengths, overflow = encode_blob(
+                b"\n".join(raw), line_len, min_bucket
+            )
+            if buf.shape[0] == len(raw):
+                return buf, lengths, overflow
     max_len = max((len(r) for r in raw), default=1)
     if line_len <= 0:
         line_len = bucket_length(max_len, min_bucket)

@@ -1,0 +1,65 @@
+"""Native C++ framing tier vs the numpy fallback: identical semantics."""
+import numpy as np
+import pytest
+
+from logparser_tpu.native import (
+    _encode_blob_numpy,
+    encode_blob,
+    native_available,
+)
+from logparser_tpu.tpu.runtime import encode_batch
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain"
+)
+
+
+def _both(blob, **kw):
+    return encode_blob(blob, **kw), _encode_blob_numpy(
+        blob, kw.get("line_len", 0), kw.get("min_bucket", 64), kw.get("cap", 4096)
+    )
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "blob",
+    [
+        b"",
+        b"one line no newline",
+        b"a\nbb\nccc\n",
+        b"a\r\nb\r\n",          # CRLF stripped
+        b"\n\n",                # empty lines
+        b"x" * 5000 + b"\nshort\n",  # overflow beyond the 4096 cap
+        bytes(range(1, 10)) + b"\n" + b"\xff\xfe binary ok\n",
+    ],
+)
+def test_native_matches_numpy(blob):
+    (b1, l1, o1), (b2, l2, o2) = _both(blob)
+    assert b1.shape == b2.shape
+    assert (b1 == b2).all()
+    assert (l1 == l2).all()
+    assert o1 == o2
+
+
+@needs_native
+def test_native_overflow_reported():
+    blob = b"y" * 5000 + b"\nok\n"
+    buf, lengths, overflow = encode_blob(blob)
+    assert overflow == [0]
+    assert buf.shape[1] == 4096
+    assert lengths[0] == 4096  # truncated, overflow bit stripped
+    assert bytes(buf[1][: lengths[1]]) == b"ok"
+
+
+def test_encode_batch_native_path_equivalent():
+    """encode_batch must produce the same buffers whether or not the native
+    join fast path engages (lines with \\r / \\n / empties force fallback)."""
+    lines = [b"simple", b"two words", b"trailing-cr\r", b"", b"with\nnewline"]
+    buf, lengths, overflow = encode_batch(lines)
+    assert buf.shape[0] == len(lines)
+    for i, ln in enumerate(lines):
+        assert bytes(buf[i][: lengths[i]]) == ln[: buf.shape[1]]
+    fast_lines = [b"alpha", b"beta", b"gamma delta"]
+    buf2, lengths2, _ = encode_batch(fast_lines)
+    for i, ln in enumerate(fast_lines):
+        assert bytes(buf2[i][: lengths2[i]]) == ln
