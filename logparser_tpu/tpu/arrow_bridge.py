@@ -38,10 +38,11 @@ def _column_to_arrow(result: "BatchResult", field_id: str):
         values = np.asarray(col["values"], dtype=np.int64).copy()
         mask = ~(np.asarray(result.valid) & np.asarray(col["ok"]))
         null = np.asarray(col["null"])
-        if kind == "long_clf_zero":
-            values[null] = 0
-        else:
-            mask = mask | null
+        # Per-line CLF-zero semantics: the format that won the line decides
+        # whether '-' means 0 (ConvertCLFIntoNumber) or null.
+        null_zero = np.asarray(col["null_zero"])
+        values[null & null_zero] = 0
+        mask = mask | (null & ~null_zero)
         for row, v in overrides.items():
             if v is None:
                 mask[row] = True

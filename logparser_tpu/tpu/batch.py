@@ -11,11 +11,15 @@ epoch, first-line split) trace into ONE jitted function per parser — a single
 fused XLA computation per (B, L) shape bucket; batch and line length are both
 padded to power-of-two buckets so recompilation is bounded.
 
-The host oracle (the exact per-line engine in logparser_tpu.core/httpd)
-handles lines the optimistic device split rejects (including multi-format
-switching) and requested fields outside the device-resolvable set (wildcards,
-URI repair, cookies, ...), so the combined result is bit-exact with the
-reference semantics at batch throughput for the common case.
+Multi-format parsers run EVERY registered format's split automaton in the
+same fused device computation and pick the per-line winner by registration
+priority (the vectorized version of HttpdLogFormatDissector.java:174-204's
+active/fallback switching — see pipeline.FormatUnit).  The host oracle (the
+exact per-line engine in logparser_tpu.core/httpd) handles lines the
+optimistic device split rejects and requested fields outside the winning
+format's device-resolvable set (wildcards, URI repair, cookies, ...), so the
+combined result is bit-exact with the reference semantics at batch
+throughput for the common case.
 """
 from __future__ import annotations
 
@@ -34,9 +38,11 @@ from ..core.fields import cleanup_field_value
 from ..httpd.parser import HttpdLoglineParser
 from .pipeline import (
     FieldPlan,
+    FormatUnit,
     PackedLayout,
-    build_jnp_fn,
-    build_pallas_fn,
+    assign_row_offsets,
+    build_units_jnp_fn,
+    build_units_pallas_fn,
 )
 from .program import (
     CS_CLF_DIGITS,
@@ -77,7 +83,8 @@ class _CollectingRecord:
 class BatchResult:
     """Columnar parse result over one batch."""
 
-    def __init__(self, lines, buf, lengths, valid, columns, overrides, good, bad):
+    def __init__(self, lines, buf, lengths, valid, columns, overrides, good, bad,
+                 format_index=None):
         self._lines = lines
         self.buf = buf                  # np [B, L] uint8
         self.lengths = lengths
@@ -87,6 +94,15 @@ class BatchResult:
         self.lines_read = len(lines)
         self.good_lines = good
         self.bad_lines = bad
+        # Per-line index of the registered format that matched on device
+        # (-1 = decided by the host oracle / no device match).  The columnar
+        # analogue of the reference's "Switched to LogFormat" signal
+        # (HttpdLogFormatDissector.java:162-165).
+        self.format_index = (
+            format_index
+            if format_index is not None
+            else np.full(self.lines_read, -1, dtype=np.int64)
+        )
 
     def field_ids(self) -> List[str]:
         return list(self._columns.keys())
@@ -112,7 +128,9 @@ class BatchResult:
                 continue
             if kind in _NUMERIC_KINDS:
                 if col["null"][i]:
-                    out.append(0 if kind == "long_clf_zero" else None)
+                    # Per-line CLF-zero semantics: the format that won the
+                    # line decides whether '-' means 0 or null.
+                    out.append(0 if col["null_zero"][i] else None)
                 else:
                     out.append(int(col["values"][i]))
             else:
@@ -166,28 +184,53 @@ class TpuBatchParser:
         self.oracle.add_parse_target("set_value", list(self.requested))
         self.oracle.assemble_dissectors()
 
-        # Device program for the FIRST registered format; other formats are
-        # host-fallback territory (multi-format batches run the switch logic
-        # per invalid line).
+        # Device programs: one FormatUnit per registered format, in
+        # registration order (SURVEY §7.7 "run k format automata, pick the
+        # per-line winner").  Only the compilable PREFIX of the format list
+        # runs on device: a line must never be claimed by format k while an
+        # earlier, uncompilable format j < k would also have matched it —
+        # stopping at the first uncompilable format preserves the reference's
+        # registration-priority semantics; the rest is oracle territory.
         fmt = self.oracle.all_dissectors[0]
         dissectors = getattr(fmt, "dissectors", [fmt])
-        self.program: Optional[DeviceProgram]
-        try:
-            self.program = compile_device_program(dissectors[0])
-        except UnsupportedFormatError:
-            self.program = None
+        self.units: List[FormatUnit] = []
+        for d in dissectors:
+            try:
+                prog = compile_device_program(d)
+            except UnsupportedFormatError:
+                break
+            plans = [self._resolve(prog, fid) for fid in self.requested]
+            self.units.append(FormatUnit(prog, plans, PackedLayout.for_plans(plans)))
+        assign_row_offsets(self.units)
 
-        self.plans: List[_FieldPlan] = [self._resolve(fid) for fid in self.requested]
-        self.plan_by_id = {p.field_id: p for p in self.plans}
-        self.host_fields = [p.field_id for p in self.plans if p.kind == "host"]
+        # Merged per-field plan: the first non-host kind across formats (used
+        # for numeric coercion of oracle-delivered values).
+        self.plan_by_id = {
+            fid: self._merged_plan(fid) for fid in self.requested
+        }
+        # Fields that need the oracle for EVERY line (host under all formats).
+        self.host_fields = [
+            fid for fid, p in self.plan_by_id.items() if p.kind == "host"
+        ]
         self._host_casts = {
             fid: self.oracle.get_casts(fid) for fid in self.host_fields
         }
-        # No point running the device program when every field is host-only.
-        any_device_field = any(p.kind != "host" for p in self.plans)
-        self.layout = PackedLayout.for_plans(self.plans)
-        if self.program is not None and any_device_field:
-            self._jitted = build_jnp_fn(self.program, self.plans, self.layout)
+        # Per-unit: fields the oracle must supply for lines won by that unit
+        # (host under it, or a kind-group mismatch with the merged column).
+        self._unit_oracle_fields: List[List[str]] = [
+            [
+                fid
+                for fid in self.requested
+                if not self._unit_decodable(u, fid)
+            ]
+            for u in self.units
+        ]
+        # No point running the device programs when every field is host-only.
+        any_device_field = any(
+            p.kind != "host" for u in self.units for p in u.plans
+        )
+        if self.units and any_device_field:
+            self._jitted = build_units_jnp_fn(self.units)
         else:
             self._jitted = None
         self._pallas_fns: Dict[tuple, Any] = {}  # (B, L) -> jitted pallas fn
@@ -202,17 +245,40 @@ class TpuBatchParser:
         key = (B, L)
         fn = self._pallas_fns.get(key)
         if fn is None:
-            fn = build_pallas_fn(self.program, self.plans, self.layout, B, L)
+            fn = build_units_pallas_fn(self.units, B, L)
             self._pallas_fns[key] = fn
         return fn
 
     # ------------------------------------------------------------------
 
-    def _resolve(self, field_id: str) -> _FieldPlan:
-        if self.program is None:
-            return _FieldPlan(field_id, "host")
+    def _merged_plan(self, field_id: str) -> _FieldPlan:
+        for u in self.units:
+            p = u.plan_for(field_id)
+            if p.kind != "host":
+                return p
+        return _FieldPlan(field_id, "host")
+
+    @staticmethod
+    def _kind_group(kind: str) -> str:
+        """Merge group: kinds in the same group share column arrays."""
+        if kind in ("span", "fl_method", "fl_uri", "fl_protocol"):
+            return "span"
+        if kind in _NUMERIC_KINDS:  # long variants + epoch
+            return "numeric"
+        return "host"
+
+    def _unit_decodable(self, unit: FormatUnit, field_id: str) -> bool:
+        """Can lines won by `unit` take this field from the device output?"""
+        merged = self.plan_by_id[field_id]
+        if merged.kind == "host":
+            return False
+        return self._kind_group(unit.plan_for(field_id).kind) == self._kind_group(
+            merged.kind
+        )
+
+    def _resolve(self, program: DeviceProgram, field_id: str) -> _FieldPlan:
         ftype, _, path = field_id.partition(":")
-        for tok in self.program.tokens:
+        for tok in program.tokens:
             for out_type, out_name in tok.outputs:
                 if out_name == path:
                     if out_type == ftype:
@@ -249,74 +315,136 @@ class TpuBatchParser:
             lengths = np.pad(lengths, (0, padded_b - B))
 
         columns: Dict[str, Dict[str, np.ndarray]] = {}
-        ones = np.ones(B, dtype=bool)
         zeros_null = np.zeros(B, dtype=bool)
 
         fn = self.device_fn(padded_b, buf.shape[1])
         if fn is not None:
-            # ONE packed [K, B] int32 output -> ONE device->host fetch
+            # ONE packed [sum K_i, B] int32 output -> ONE device->host fetch
             # (transfer round-trips dominate on tunneled TPU attachments).
             packed = np.asarray(
                 jax.device_get(fn(jnp.asarray(buf), jnp.asarray(lengths)))
             )
-            valid = packed[0, :B] != 0
+            # Per-line winner: first registered format whose automaton
+            # accepted the line (row_offset row: bit 0 = valid, bit 1 =
+            # plausible).  A line is only CLAIMED by format i when no
+            # earlier format is still plausible (its separators occur in
+            # order) — those lines go to the oracle, which applies the
+            # reference's registration-priority semantics with the real
+            # backtracking regexes (HttpdLogFormatDissector.java:174-204).
+            row0 = np.stack([packed[u.row_offset, :B] for u in self.units])
+            validity = (row0 & 1) != 0
+            plausible = (row0 & 2) != 0
+            valid = validity.any(axis=0)
+            winner = np.where(valid, validity.argmax(axis=0), -1)
+            if len(self.units) > 1:
+                earlier_plausible = np.cumsum(plausible, axis=0) - plausible
+                contested = np.take_along_axis(
+                    earlier_plausible,
+                    np.maximum(winner, 0)[None, :],
+                    axis=0,
+                )[0] > 0
+                winner = np.where(contested, -1, winner)
+                valid = valid & ~contested
         else:
             packed = None
             valid = np.zeros(B, dtype=bool)
+            winner = np.full(B, -1, dtype=np.int64)
         for i in overflow:
             valid[i] = False
+            winner[i] = -1
 
-        get = (
-            (lambda fid, comp: self.layout.get(packed, fid, comp)[:B])
-            if packed is not None
-            else None
-        )
-        for plan in self.plans:
-            if plan.kind == "host" or packed is None:
-                columns[plan.field_id] = {
+        def unit_get(u: FormatUnit, fid: str, comp: str) -> np.ndarray:
+            block = packed[u.row_offset : u.row_offset + u.layout.n_rows]
+            return u.layout.get(block, fid, comp)[:B]
+
+        for fid in self.requested:
+            merged = self.plan_by_id[fid]
+            group = self._kind_group(merged.kind)
+            if packed is None or group == "host":
+                columns[fid] = {
                     "kind": "span",
                     "starts": np.zeros(B, dtype=np.int32),
                     "ends": np.zeros(B, dtype=np.int32),
                     "ok": np.zeros(B, dtype=bool),
                     "null": zeros_null,
                 }
-            elif plan.kind in ("span", "fl_method", "fl_uri", "fl_protocol"):
-                starts_col = get(plan.field_id, "start")
-                columns[plan.field_id] = {
+                continue
+            if group == "span":
+                col = {
                     "kind": "span",
-                    "starts": starts_col,
-                    "ends": starts_col + get(plan.field_id, "len"),
-                    "ok": get(plan.field_id, "ok") != 0,
+                    "starts": np.zeros(B, dtype=np.int32),
+                    "ends": np.zeros(B, dtype=np.int32),
+                    "ok": np.zeros(B, dtype=bool),
                     "null": zeros_null,
                 }
-            elif plan.kind in ("long", "long_clf_null", "long_clf_zero"):
-                is_null = get(plan.field_id, "null") != 0
-                columns[plan.field_id] = {
-                    "kind": plan.kind,
-                    "values": postproc.combine_long_limbs(
-                        get(plan.field_id, "hi"),
-                        get(plan.field_id, "lo"),
-                        get(plan.field_id, "lo_digits"),
-                        is_null,
-                    ),
-                    "null": is_null,
-                    "ok": get(plan.field_id, "ok") != 0,
+            else:
+                col = {
+                    "kind": merged.kind,
+                    "values": np.zeros(B, dtype=np.int64),
+                    "null": np.zeros(B, dtype=bool),
+                    "null_zero": np.zeros(B, dtype=bool),
+                    "ok": np.zeros(B, dtype=bool),
                 }
-            else:  # epoch
-                columns[plan.field_id] = {
-                    "kind": "epoch",
-                    "values": postproc.combine_epoch(
-                        get(plan.field_id, "days"), get(plan.field_id, "sec")
-                    ),
-                    "null": zeros_null,
-                    "ok": get(plan.field_id, "ok") != 0,
-                }
+            for ui, u in enumerate(self.units):
+                plan = u.plan_for(fid)
+                if not self._unit_decodable(u, fid):
+                    continue  # lines won by this unit go through the oracle
+                sel = winner == ui
+                if not sel.any():
+                    continue
+                if group == "span":
+                    starts_col = unit_get(u, fid, "start")
+                    col["starts"] = np.where(sel, starts_col, col["starts"])
+                    col["ends"] = np.where(
+                        sel, starts_col + unit_get(u, fid, "len"), col["ends"]
+                    )
+                    col["ok"] = np.where(
+                        sel, unit_get(u, fid, "ok") != 0, col["ok"]
+                    )
+                elif plan.kind == "epoch":
+                    col["values"] = np.where(
+                        sel,
+                        postproc.combine_epoch(
+                            unit_get(u, fid, "days"), unit_get(u, fid, "sec")
+                        ),
+                        col["values"],
+                    )
+                    col["ok"] = np.where(
+                        sel, unit_get(u, fid, "ok") != 0, col["ok"]
+                    )
+                else:  # long variants
+                    is_null = unit_get(u, fid, "null") != 0
+                    col["values"] = np.where(
+                        sel,
+                        postproc.combine_long_limbs(
+                            unit_get(u, fid, "hi"),
+                            unit_get(u, fid, "lo"),
+                            unit_get(u, fid, "lo_digits"),
+                            is_null,
+                        ),
+                        col["values"],
+                    )
+                    col["null"] = np.where(sel, is_null, col["null"])
+                    col["ok"] = np.where(
+                        sel, unit_get(u, fid, "ok") != 0, col["ok"]
+                    )
+                    if plan.kind == "long_clf_zero":
+                        col["null_zero"] = np.where(sel, True, col["null_zero"])
+            columns[fid] = col
 
         # Host fallback: invalid lines entirely; host-only fields for every line.
-        def coerce(fid: str, value: Any) -> Any:
+        def coerce(fid: str, value: Any, winner_index: int) -> Any:
             if value is None:
                 return None
-            if self.plan_by_id[fid].kind in _NUMERIC_KINDS:
+            # Numeric coercion follows the kind of the format that won the
+            # line (a field can be numeric under one format and a plain
+            # string under another); unknown winner -> merged kind.
+            kind = (
+                self.units[winner_index].plan_for(fid).kind
+                if winner_index >= 0
+                else self.plan_by_id[fid].kind
+            )
+            if kind in _NUMERIC_KINDS:
                 try:
                     return int(value)
                 except (TypeError, ValueError):
@@ -343,10 +471,19 @@ class TpuBatchParser:
         overrides: Dict[str, Dict[int, Any]] = {fid: {} for fid in columns}
         bad = 0
         invalid_rows = set(int(i) for i in np.nonzero(~valid)[0])
-        host_rows = range(B) if self.host_fields else sorted(invalid_rows)
-        for i in host_rows:
+        # Rows the oracle must visit: lines no automaton accepted, plus lines
+        # whose winning format can't supply every requested field on device.
+        need_oracle = set(invalid_rows)
+        for ui, flds in enumerate(self._unit_oracle_fields):
+            if flds:
+                need_oracle.update(int(r) for r in np.nonzero(winner == ui)[0])
+        for i in sorted(need_oracle):
             is_invalid = i in invalid_rows
-            fields_needed = self.requested if is_invalid else self.host_fields
+            fields_needed = (
+                self.requested
+                if is_invalid
+                else self._unit_oracle_fields[winner[i]]
+            )
             values = self._run_oracle(lines[i])
             if values is None:
                 if is_invalid:
@@ -366,11 +503,12 @@ class TpuBatchParser:
                         if k.startswith(prefix)
                     }
                 else:
-                    overrides[fid][i] = coerce(fid, values.get(fid))
+                    overrides[fid][i] = coerce(fid, values.get(fid), int(winner[i]))
 
         good = int(B - bad)
         return BatchResult(
-            list(lines), buf[:B], lengths[:B], valid, columns, overrides, good, bad
+            list(lines), buf[:B], lengths[:B], valid, columns, overrides,
+            good, bad, format_index=winner[:B],
         )
 
     def _run_oracle(self, line: Union[bytes, str]) -> Optional[Dict[str, Any]]:

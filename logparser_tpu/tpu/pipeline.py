@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from . import postproc
-from .program import DeviceProgram
+from .program import CS_ANY, DeviceProgram
 
 
 @dataclass
@@ -132,12 +132,23 @@ def compute_split(
     b32: jnp.ndarray,
     lengths: jnp.ndarray,
     shift_fn=shift_zero,
-) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], jnp.ndarray]:
+    need_plausible: bool = False,
+) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], jnp.ndarray, Optional[jnp.ndarray]]:
     """Run the split program over int32 byte rows.
 
-    Returns (start_list, end_list, valid): per-token [B] cursors plus the
-    per-line validity mask.  Gather-free: precomputed literal-match masks and
-    charset masks + masked reductions."""
+    Returns (start_list, end_list, valid, plausible): per-token [B] cursors
+    plus the per-line validity mask.  Gather-free: precomputed literal-match
+    masks and charset masks + masked reductions.
+
+    ``plausible`` (only when need_plausible) is a SOUND over-approximation of
+    "the format's real regex could accept this line": all literal separators
+    occur in order (greedy first-occurrence matching is exact for subsequence
+    existence, so regex-accept implies plausible; valid implies plausible).
+    Multi-format winner selection uses it to avoid claiming a line for format
+    k when an earlier format j < k — whose non-backtracking device automaton
+    false-rejected the line — might still accept it: such lines go to the
+    host oracle, which applies the reference's registration-priority
+    semantics exactly."""
     B, L = b32.shape
     pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
     cursor = jnp.zeros(B, dtype=jnp.int32)
@@ -171,6 +182,55 @@ def compute_split(
         # charset also admits digits; min_len floor of 1 covers both arms.
         return valid & span_ok & (width >= spec_min_len)
 
+    # Plausibility: chase each separator's FIRST occurrence at/after a free
+    # cursor — subsequence existence, for which greedy first-occurrence
+    # matching is exact — with three additional SOUND anchorings (each is a
+    # consequence of regex acceptance, so regex-accept still implies
+    # plausible): (a) a leading literal must match at position 0 (the regex
+    # is ^-anchored); (b) the final literal must end exactly at the line end
+    # ($-anchored); (c) when the last token is to_end with a bounded
+    # charset, the preceding separator must sit past the last
+    # charset-violating byte.  (b)/(c) keep e.g. `common` from looking
+    # plausible on every `combined` line (spaces occur everywhere), which
+    # would otherwise send all those lines to the oracle.
+    plausible = None
+    if need_plausible:
+        ops_list = list(program.ops)
+        plausible = jnp.ones(B, dtype=bool)
+        p_cursor = jnp.zeros(B, dtype=jnp.int32)
+        for idx, op in enumerate(ops_list):
+            if not op.lit:
+                continue  # to_end: handled via the preceding separator
+            k = len(op.lit)
+            is_first = idx == 0 and op.kind == "lit"
+            remaining = ops_list[idx + 1 :]
+            is_final_sep = not any(o.lit for o in remaining)
+            usable = lit_masks[op.lit]
+            if is_first:
+                usable = usable & (pos == 0)
+            else:
+                usable = usable & (pos >= p_cursor[:, None])
+            if is_final_sep and not remaining:
+                # Trailing separator: the regex is end-anchored.
+                usable = usable & (pos == lengths[:, None] - k)
+            elif is_final_sep and remaining[0].kind == "to_end":
+                tail = remaining[0]
+                if tail.charset != CS_ANY:
+                    # The to_end token spans [q + k, length); it can only
+                    # satisfy its charset if q + k is past the last
+                    # violating byte.
+                    bad = ~cs_masks[tail.charset] & (pos < lengths[:, None])
+                    last_bad = jnp.max(
+                        jnp.where(bad, pos, -1), axis=1
+                    ).astype(jnp.int32)
+                    usable = usable & (pos >= (last_bad - k + 1)[:, None])
+                # until_lit final sep followed by to_end cannot happen (the
+                # separator belongs to until_lit and to_end has none), so q
+                # need not sit at line end here.
+            found = jnp.min(jnp.where(usable, pos, L), axis=1).astype(jnp.int32)
+            plausible = plausible & (found < L)
+            p_cursor = found + k
+
     for op in program.ops:
         if op.kind == "lit":
             # Literal matches exactly at the cursor: probe the match mask
@@ -201,7 +261,7 @@ def compute_split(
 
     # The whole line must be consumed (the regex is end-anchored).
     valid = valid & (cursor == lengths)
-    return starts, ends, valid
+    return starts, ends, valid, plausible
 
 
 # ---------------------------------------------------------------------------
@@ -295,13 +355,17 @@ def compute_rows(
     b32: jnp.ndarray,
     lengths: jnp.ndarray,
     shift_fn=shift_zero,
+    need_plausible: bool = False,
 ) -> List[jnp.ndarray]:
     """The fused computation: split + per-plan post-stages -> K rows of [B]
-    int32 (row 0 = line validity).  Returned as a list so the Pallas kernel
-    can write rows to the output ref one by one (Mosaic miscompiles a wide
-    1-D stack) while the jnp path stacks them."""
+    int32 (row 0: bit 0 = line validity, bit 1 = plausibility when
+    requested).  Returned as a list so the Pallas kernel can write rows to
+    the output ref one by one (Mosaic miscompiles a wide 1-D stack) while
+    the jnp path stacks them."""
     B = b32.shape[0]
-    starts, ends, valid = compute_split(program, b32, lengths, shift_fn)
+    starts, ends, valid, plausible = compute_split(
+        program, b32, lengths, shift_fn, need_plausible
+    )
     extract = None if shift_fn is shift_zero else make_extract(shift_fn)
 
     rows: List[Optional[jnp.ndarray]] = [None] * layout.n_rows
@@ -364,26 +428,72 @@ def compute_rows(
         else:  # pragma: no cover
             raise AssertionError(plan.kind)
 
-    rows[0] = jnp.where(valid, 1, 0).astype(jnp.int32)
+    row0 = jnp.where(valid, 1, 0).astype(jnp.int32)
+    if plausible is not None:
+        row0 = row0 | (jnp.where(plausible, 2, 0).astype(jnp.int32))
+    rows[0] = row0
     zero = jnp.zeros(B, dtype=jnp.int32)
     return [r if r is not None else zero for r in rows]
 
 
 # ---------------------------------------------------------------------------
 # Entry points: jnp and Pallas executors of the packed pipeline.
+#
+# Multi-format (SURVEY §7.7): the reference keeps ONE active format and
+# switches on DissectionFailure (HttpdLogFormatDissector.java:174-204) — a
+# stateful, path-dependent scheme.  The vectorized equivalent runs EVERY
+# registered format's split automaton over the batch in the same fused
+# computation and picks the per-line winner by registration priority
+# (deterministic, order-independent — strictly better than active/fallback).
+# Each format is one FormatUnit; its rows are stacked into one [sum K_i, B]
+# packed output, so multi-format still costs exactly one device->host fetch.
 # ---------------------------------------------------------------------------
 
 
-def build_jnp_fn(program: DeviceProgram, plans: Sequence[FieldPlan],
-                 layout: PackedLayout):
-    """Plain-XLA executor: (buf [B,L] uint8, lengths [B]) -> [K, B] int32."""
+@dataclass
+class FormatUnit:
+    """One registered LogFormat's compiled device pipeline: split program +
+    per-field plans + packed row layout.  row_offset is its first row in the
+    stacked multi-format output (row row_offset = this format's validity)."""
+
+    program: DeviceProgram
+    plans: List[FieldPlan]
+    layout: PackedLayout
+    row_offset: int = 0
+
+    def plan_for(self, field_id: str) -> FieldPlan:
+        for p in self.plans:
+            if p.field_id == field_id:
+                return p
+        return FieldPlan(field_id, "host")
+
+
+def assign_row_offsets(units: Sequence[FormatUnit]) -> int:
+    """Set each unit's row_offset; returns the stacked row count K."""
+    off = 0
+    for u in units:
+        u.row_offset = off
+        off += u.layout.n_rows
+    return off
+
+
+def build_units_jnp_fn(units: Sequence[FormatUnit]):
+    """Plain-XLA executor over all formats:
+    (buf [B,L] uint8, lengths [B]) -> [sum K_i, B] int32."""
 
     def fn(buf: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
-        return jnp.stack(compute_rows(
-            program, plans, layout, buf.astype(jnp.int32), lengths, shift_zero
-        ))
+        b32 = buf.astype(jnp.int32)
+        rows: List[jnp.ndarray] = []
+        for i, u in enumerate(units):
+            rows.extend(compute_rows(
+                u.program, u.plans, u.layout, b32, lengths, shift_zero,
+                need_plausible=i < len(units) - 1,
+            ))
+        return jnp.stack(rows)
 
     return jax.jit(fn)
+
+
 
 
 def _block_lines(L: int) -> int:
@@ -394,11 +504,11 @@ def _block_lines(L: int) -> int:
     return 1 << (bb.bit_length() - 1)
 
 
-def build_pallas_fn(program: DeviceProgram, plans: Sequence[FieldPlan],
-                    layout: PackedLayout, B: int, L: int,
-                    interpret: Optional[bool] = None):
+def build_units_pallas_fn(units: Sequence[FormatUnit], B: int, L: int,
+                          interpret: Optional[bool] = None):
     """Pallas executor for a fixed [B, L] shape: one fused VMEM-resident
-    kernel over line blocks.  (buf, lengths[B,1]) -> [K, B] int32.
+    kernel over line blocks running every format's automaton.
+    (buf, lengths[B,1]) -> [sum K_i, B] int32.
 
     ``interpret`` defaults to True off-TPU so the kernel stays testable on
     the CPU mesh (pltpu.roll & friends run in the Pallas interpreter)."""
@@ -406,15 +516,20 @@ def build_pallas_fn(program: DeviceProgram, plans: Sequence[FieldPlan],
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    K = layout.n_rows
+    K = sum(u.layout.n_rows for u in units)
     BB = min(_block_lines(L), B)
 
     def kernel(buf_ref, len_ref, out_ref):
         b32 = buf_ref[...].astype(jnp.int32)
         lengths = len_ref[...][:, 0]
-        rows = compute_rows(program, plans, layout, b32, lengths, shift_wrap)
-        for i, row in enumerate(rows):
-            out_ref[i, :] = row
+        off = 0
+        for ui, u in enumerate(units):
+            rows = compute_rows(u.program, u.plans, u.layout, b32, lengths,
+                                shift_wrap,
+                                need_plausible=ui < len(units) - 1)
+            for i, row in enumerate(rows):
+                out_ref[off + i, :] = row
+            off += len(rows)
 
     grid = (B // BB,)
     call = pl.pallas_call(

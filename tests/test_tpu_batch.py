@@ -207,3 +207,112 @@ def test_pallas_kernel_matches_jnp_pipeline():
     pallas_parser = TpuBatchParser("combined", fields, use_pallas=True)
     assert jnp_parser.parse_batch(lines).to_dict() == \
         pallas_parser.parse_batch(lines).to_dict()
+
+
+COMMON = '%h %l %u %t "%r" %>s %b'
+
+
+def _common_lines(n, seed=11):
+    """Common-format lines: combined lines with the quoted referer/UA cut."""
+    out = []
+    for line in generate_combined_lines(n, seed=seed):
+        out.append(line.rsplit(' "', 2)[0])
+    return out
+
+
+class TestMultiFormat:
+    """Vectorized multi-format: every registered format's automaton runs in
+    the fused device computation; per-line winner by registration priority
+    (the deterministic version of HttpdLogFormatDissector.java:174-204)."""
+
+    FIELDS = [
+        "IP:connection.client.host",
+        "TIME.EPOCH:request.receive.time.epoch",
+        "HTTP.METHOD:request.firstline.method",
+        "HTTP.URI:request.firstline.uri",
+        "STRING:request.status.last",
+        "BYTES:response.body.bytes",
+        "HTTP.URI:request.referer",
+        "HTTP.USERAGENT:request.user-agent",
+    ]
+
+    def _mixed(self, n=32):
+        a = generate_combined_lines(n, seed=3)
+        b = _common_lines(n, seed=5)
+        lines = [x for pair in zip(a, b) for x in pair]
+        return lines
+
+    def test_two_units_compiled(self):
+        parser = TpuBatchParser("combined\n" + COMMON, self.FIELDS)
+        assert len(parser.units) == 2
+        assert parser.units[1].row_offset == parser.units[0].layout.n_rows
+
+    def test_winner_per_line(self):
+        parser = TpuBatchParser("combined\n" + COMMON, self.FIELDS,
+                                use_pallas=False)
+        res = parser.parse_batch(self._mixed())
+        # Interleaved combined/common lines -> alternating winners.
+        assert list(res.format_index[:6]) == [0, 1, 0, 1, 0, 1]
+        assert res.bad_lines == 0
+
+    @pytest.mark.parametrize("use_pallas", [False, True])
+    def test_matches_oracle(self, use_pallas):
+        fmt = "combined\n" + COMMON
+        lines = self._mixed() + [
+            "garbage neither format accepts",
+            '8.8.8.8 - - [01/Jan/2020:00:00:00 +0000] "GET / HTTP/1.1" 200 -',
+        ]
+        parser = TpuBatchParser(fmt, self.FIELDS, use_pallas=use_pallas)
+        res = parser.parse_batch(lines)
+
+        p = HttpdLoglineParser(_Rec, fmt)
+        p.add_parse_target("set_value", list(self.FIELDS))
+        for i, line in enumerate(lines):
+            try:
+                expected = p.parse(line, _Rec()).values
+            except DissectionFailure:
+                expected = None
+            if expected is None:
+                assert not res.valid[i], line
+                continue
+            assert res.valid[i], line
+            for fid in self.FIELDS:
+                got = res.to_pylist(fid)[i]
+                exp = expected.get(fid)
+                if isinstance(got, int) and exp is not None:
+                    exp = int(exp)  # raw oracle stores strings; batch types them
+                assert got == exp, (line, fid, got, exp)
+
+    def test_clf_zero_semantics_per_line(self):
+        """'-' bytes under Apache %b -> 0 (ConvertCLFIntoNumber); a format
+        whose bytes token is a plain number never produces null."""
+        fmt = "combined\n" + COMMON
+        lines = [
+            '1.1.1.1 - - [01/Jan/2020:00:00:00 +0000] "GET / HTTP/1.1" 200 - "-" "-"',
+            '2.2.2.2 - - [01/Jan/2020:00:00:00 +0000] "GET / HTTP/1.1" 200 -',
+        ]
+        parser = TpuBatchParser(fmt, ["BYTES:response.body.bytes"],
+                                use_pallas=False)
+        res = parser.parse_batch(lines)
+        assert res.to_pylist("BYTES:response.body.bytes") == [0, 0]
+
+    def test_priority_inversion_goes_to_oracle(self):
+        """A line format 0's non-backtracking automaton false-rejects but
+        format 1 accepts must NOT be claimed by format 1: the reference's
+        lazy regex backtracks and accepts it under format 0 (registration
+        priority).  The plausibility guard routes it to the oracle."""
+        fmt0 = '"%{A}i" %h'
+        fmt1 = '"%{A}i" %{C}i %h'
+        line = '"x" y" 1.2.3.4'
+        fields = ["HTTP.HEADER:request.header.a", "IP:connection.client.host"]
+        parser = TpuBatchParser(fmt0 + "\n" + fmt1, fields, use_pallas=False)
+        assert len(parser.units) == 2
+        res = parser.parse_batch([line])
+
+        p = HttpdLoglineParser(_Rec, fmt0 + "\n" + fmt1)
+        p.add_parse_target("set_value", fields)
+        expected = p.parse(line, _Rec()).values
+        assert expected["HTTP.HEADER:request.header.a"] == 'x" y'
+        assert res.valid[0]
+        assert res.to_pylist("HTTP.HEADER:request.header.a")[0] == 'x" y'
+        assert res.to_pylist("IP:connection.client.host")[0] == "1.2.3.4"
