@@ -1,0 +1,22 @@
+"""Write the synthetic demolog corpus to disk.
+
+Reference counterpart: examples/demolog/hackers-access.log — a 3456-line real
+`combined` access log used as demo and bench data.  This repo generates a
+deterministic equivalent instead of checking in third-party data; 3456 lines,
+seed 42, ~2% hostile/garbage lines to exercise the bad-line path.
+"""
+import sys
+
+from logparser_tpu.tools.demolog import write_demolog
+
+DEFAULT_LINES = 3456
+
+
+def main(path: str = "demolog-access.log") -> int:
+    n = write_demolog(path, n=DEFAULT_LINES, seed=42, garbage_fraction=0.02)
+    print(f"Wrote {n} lines to {path}")
+    return n
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:2])

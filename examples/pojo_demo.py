@@ -1,0 +1,110 @@
+"""Canonical API demo: discovery + annotated-record parsing.
+
+Reference behavior: examples/java-pojo/.../Main.java:34-90 — first list every
+possible output path (with casts) for a hairy custom LogFormat using a dummy
+parser, then parse one real-world logline into a record class whose setters
+are marked with field annotations.
+"""
+from logparser_tpu.core import Parser, field
+from logparser_tpu.httpd import HttpdLoglineParser
+
+# A deliberately gnarly LogFormat: custom field order, %D response time,
+# request headers, an environment variable, cookies, and a quoted host header.
+LOG_FORMAT = (
+    "%t %u [%D %h %{True-Client-IP}i %{UNIQUE_ID}e %r] %{Cookie}i %s "
+    '"%{User-Agent}i" "%{host}i" %l %b %{Referer}i'
+)
+
+LOG_LINE = (
+    "[02/Dec/2013:14:10:30 -0000] - [52075 10.102.4.254 177.43.52.210 "
+    "UpyU1gpmBAwAACfd5W0AAAAW GET /products/NY-019.jpg.rendition.zoomable.jpg "
+    "HTTP/1.1] firstvisit=http%3A%2F%2Fwww.example.com%2Fen-us||1372268254000; "
+    "has_js=1; session=julinho%3A5248423a; lang=en 200 "
+    '"Mozilla/5.0 (Windows NT 6.2; WOW64) AppleWebKit/537.36 (KHTML, like '
+    'Gecko) Chrome/31.0.1650.57 Safari/537.36" "www.example.com" - 463952 '
+    "http://www.example.com/content/report/shows/New_York/trip/sheers.html"
+)
+
+
+class MyRecord:
+    """The POJO equivalent: setters marked with @field get the values."""
+
+    def __init__(self):
+        self.results = {}
+
+    @field("IP:connection.client.host")
+    def set_ip(self, value: str):
+        self.results["ip"] = value
+
+    @field("TIME.STAMP:request.receive.time")
+    def set_time(self, value: str):
+        self.results["time"] = value
+
+    @field("MICROSECONDS:response.server.processing.time")
+    def set_process_time(self, value: int):
+        self.results["process.time.us"] = value
+
+    @field("HTTP.METHOD:request.firstline.method")
+    def set_method(self, value: str):
+        self.results["method"] = value
+
+    @field("HTTP.PATH:request.firstline.uri.path")
+    def set_path(self, value: str):
+        self.results["uri.path"] = value
+
+    @field("STRING:request.status")
+    def set_status(self, value: str):
+        self.results["status"] = value
+
+    @field("BYTESCLF:response.body.bytes")
+    def set_bytes(self, value: int):
+        self.results["body.bytes"] = value
+
+    @field("HTTP.COOKIE:request.cookies.*")
+    def set_cookie(self, name: str, value: str):
+        self.results[name] = value
+
+    @field("HTTP.USERAGENT:request.user-agent")
+    def set_useragent(self, value: str):
+        self.results["useragent"] = value
+
+    def __str__(self):
+        return "\n".join(f"  {k} = {v!r}" for k, v in sorted(self.results.items()))
+
+
+def print_all_possibles(log_format: str) -> None:
+    # To figure out what values we CAN get from this format we instantiate
+    # the parser with no record class at all (Main.java:36-38 uses a dummy
+    # Object.class the same way).
+    dummy_parser = HttpdLoglineParser(None, log_format)
+    possible_paths = dummy_parser.get_possible_paths()
+
+    # getCasts needs an actually-assembled parser, so register every path
+    # against a throwaway setter first (Main.java:43-47).
+    dummy_parser.record_class = type("Dummy", (), {"sink": lambda self, v: None})
+    dummy_parser.add_parse_target("sink", possible_paths)
+    dummy_parser.ignore_missing_dissectors()
+
+    print("==================================")
+    print("Possible output:")
+    for path in possible_paths:
+        casts = dummy_parser.get_casts(path)
+        names = sorted(c.name for c in casts) if casts else None
+        print(f"{path}     {names}")
+    print("==================================")
+
+
+def main() -> MyRecord:
+    print_all_possibles(LOG_FORMAT)
+
+    parser = HttpdLoglineParser(MyRecord, LOG_FORMAT)
+    record = parser.parse(LOG_LINE)
+
+    print("================================================================")
+    print(record)
+    print("================================================================")
+    return record
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Storm-style bolt embedding.
+
+Reference behavior: examples/apache-storm/.../HttpdLoglineParserBolt.java +
+ParserBoltTest.java — a BaseBasicBolt holding a parser; a LocalCluster test
+feeds it tuples from a spout and asserts on the emitted records.  Here the
+"topology" is an in-process loop: a spout generator, the bolt's
+``execute(tuple, collector)``, and a list collector.
+"""
+from typing import List, Optional
+
+from logparser_tpu.adapters.streaming import ParserConfig, ParserMapOperator
+from logparser_tpu.tools.demolog import generate_combined_lines
+
+FIELDS = [
+    "IP:connection.client.host",
+    "HTTP.USERAGENT:request.user-agent",
+]
+
+
+class ListCollector:
+    def __init__(self):
+        self.emitted: List[tuple] = []
+
+    def emit(self, values: tuple) -> None:
+        self.emitted.append(values)
+
+
+class HttpdLoglineParserBolt:
+    """prepare/execute/declare_output_fields surface over the map operator."""
+
+    def __init__(self, log_format: str, fields: List[str]):
+        self._config = ParserConfig(log_format=log_format, fields=fields)
+        self._operator: Optional[ParserMapOperator] = None
+
+    def prepare(self) -> None:
+        self._operator = ParserMapOperator(self._config)
+        self._operator.open()
+
+    def declare_output_fields(self) -> List[str]:
+        return list(self._config.fields)
+
+    def execute(self, tup: str, collector: ListCollector) -> None:
+        record = self._operator.map(tup)
+        if record is not None:
+            collector.emit(
+                tuple(
+                    record.get(f.split(":", 1)[1]) for f in self._config.fields
+                )
+            )
+
+    def cleanup(self) -> None:
+        if self._operator is not None:
+            self._operator.close()
+
+
+def main() -> List[tuple]:
+    bolt = HttpdLoglineParserBolt("combined", FIELDS)
+    collector = ListCollector()
+    bolt.prepare()
+    try:
+        for line in generate_combined_lines(100, seed=9):  # the "spout"
+            bolt.execute(line, collector)
+    finally:
+        bolt.cleanup()
+
+    print(f"Bolt emitted {len(collector.emitted)} tuples; first 3:")
+    for values in collector.emitted[:3]:
+        print(f"  {values}")
+    return collector.emitted
+
+
+if __name__ == "__main__":
+    main()

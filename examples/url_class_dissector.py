@@ -1,0 +1,50 @@
+"""A custom dissector, loadable dynamically by class path.
+
+Reference behavior: examples/apache-pig/.../UrlClassDissector.java — a
+user-written dissector classifying `HTTP.PATH` values into a new
+`HTTP.PATH.CLASS:class` output, registered from a Pig script via
+``-load:nl.basjes.parse.UrlClassDissector:``.  The equivalent here plugs into
+the same demand-driven graph: ask for ``HTTP.PATH.CLASS:...path.class`` and
+the compiler wires this dissector behind the URI dissector automatically.
+"""
+from logparser_tpu.core import Dissector
+from logparser_tpu.core.casts import STRING_ONLY
+
+
+def classify(path_value: str) -> str:
+    if path_value.endswith(".html"):
+        return "Page"
+    if path_value.endswith((".gif", ".png", ".jpg")):
+        return "Image"
+    if path_value.endswith(".css"):
+        return "StyleSheet"
+    if path_value.endswith(".js"):
+        return "Script"
+    if path_value.endswith("_form"):
+        return "HackAttempt"
+    return "Other"
+
+
+class UrlClassDissector(Dissector):
+    INPUT_TYPE = "HTTP.PATH"
+
+    def initialize_from_settings_parameter(self, settings: str) -> bool:
+        return True  # no settings needed; accept the -load: protocol call
+
+    def get_input_type(self) -> str:
+        return self.INPUT_TYPE
+
+    def get_possible_output(self):
+        return ["HTTP.PATH.CLASS:class"]
+
+    def prepare_for_dissect(self, input_name: str, output_name: str):
+        return STRING_ONLY
+
+    def dissect(self, parsable, input_name: str) -> None:
+        parsed_field = parsable.get_parsable_field(self.INPUT_TYPE, input_name)
+        if parsed_field is None:
+            return
+        value = parsed_field.value.get_string()
+        if not value:
+            return
+        parsable.add_dissection(input_name, "HTTP.PATH.CLASS", "class", classify(value))

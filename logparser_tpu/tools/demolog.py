@@ -79,7 +79,11 @@ def generate_combined_lines(
     return lines
 
 
-def write_demolog(path: str, n: int = 3456, seed: int = 42) -> None:
+def write_demolog(
+    path: str, n: int = 3456, seed: int = 42, garbage_fraction: float = 0.0
+) -> int:
+    lines = generate_combined_lines(n, seed, garbage_fraction)
     with open(path, "w") as f:
-        for line in generate_combined_lines(n, seed):
+        for line in lines:
             f.write(line + "\n")
+    return len(lines)
