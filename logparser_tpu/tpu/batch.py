@@ -174,6 +174,10 @@ class TpuBatchParser:
     ):
         self.log_format = log_format
         self.requested = [cleanup_field_value(f) for f in fields]
+        # Remember whether the caller pinned the execution path: a defaulted
+        # flag is re-derived from the LOCAL backend when an artifact is
+        # loaded on a different machine (see __setstate__).
+        self._use_pallas_explicit = use_pallas is not None
         self.use_pallas = (
             _default_use_pallas() if use_pallas is None else use_pallas
         )
@@ -225,15 +229,17 @@ class TpuBatchParser:
             ]
             for u in self.units
         ]
+        self._jitted = self._build_jitted()
+        self._pallas_fns: Dict[tuple, Any] = {}  # (B, L) -> jitted pallas fn
+
+    def _build_jitted(self):
         # No point running the device programs when every field is host-only.
         any_device_field = any(
             p.kind != "host" for u in self.units for p in u.plans
         )
         if self.units and any_device_field:
-            self._jitted = build_units_jnp_fn(self.units)
-        else:
-            self._jitted = None
-        self._pallas_fns: Dict[tuple, Any] = {}  # (B, L) -> jitted pallas fn
+            return build_units_jnp_fn(self.units)
+        return None
 
     def device_fn(self, B: int, L: int):
         """The fused device executor for one [B, L] shape bucket: Pallas on
@@ -519,3 +525,62 @@ class TpuBatchParser:
         except DissectionFailure:
             return None
         return record.values
+
+    # ------------------------------------------------------------------
+    # serialization — the compiled format program (token tables, split ops,
+    # packed layouts, field plans) is a serializable, device-loadable
+    # artifact.  The analogue of the reference's `Parser implements
+    # Serializable` contract (Parser.java:91-97): engines serialize the
+    # parser once and ship it to workers; jit executables are rebuilt on
+    # load the way the reference re-resolves reflection Methods.
+    #
+    # SECURITY: the payload is a pickle (exactly as the reference's artifact
+    # is a Java serialized object) — loading executes code from the blob.
+    # Only load artifacts produced by your own pipeline over a trusted
+    # channel; never feed user-uploaded files to from_bytes/load.
+    # ------------------------------------------------------------------
+
+    _ARTIFACT_MAGIC = b"LPTPU-PROGRAM-v1\n"
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = self.__dict__.copy()
+        state["_jitted"] = None
+        state["_pallas_fns"] = {}
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        if not getattr(self, "_use_pallas_explicit", False):
+            # The defaulted flag described the BUILDER's backend; this
+            # process may be a different machine — re-derive locally.
+            self.use_pallas = _default_use_pallas()
+        self._jitted = self._build_jitted()
+
+    def to_bytes(self) -> bytes:
+        """The compiled parser as a versioned artifact blob (a pickle — see
+        the SECURITY note above: treat artifacts as executable)."""
+        import pickle
+
+        return self._ARTIFACT_MAGIC + pickle.dumps(self)
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "TpuBatchParser":
+        """Load an artifact produced by :meth:`to_bytes`.  TRUSTED INPUT
+        ONLY — the payload is a pickle and loading executes code."""
+        import pickle
+
+        if not blob.startswith(cls._ARTIFACT_MAGIC):
+            raise ValueError("not a logparser_tpu program artifact")
+        parser = pickle.loads(blob[len(cls._ARTIFACT_MAGIC):])
+        if not isinstance(parser, cls):
+            raise ValueError("artifact does not contain a TpuBatchParser")
+        return parser
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            f.write(self.to_bytes())
+
+    @classmethod
+    def load(cls, path: str) -> "TpuBatchParser":
+        with open(path, "rb") as f:
+            return cls.from_bytes(f.read())

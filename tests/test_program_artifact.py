@@ -1,0 +1,79 @@
+"""Serialization of the compiled format program (SURVEY §5.4).
+
+The reference's checkpoint/resume contract is `Parser implements
+Serializable` with post-deserialization method re-resolution
+(Parser.java:91-97, 242-277); the TPU equivalent is the compiled program
+artifact: save/load a TpuBatchParser and get identical parse results, with
+jit executables rebuilt lazily on the loaded copy.
+"""
+import pickle
+
+import pytest
+
+from logparser_tpu.tools.demolog import generate_combined_lines
+from logparser_tpu.tpu.batch import TpuBatchParser
+
+FIELDS = [
+    "IP:connection.client.host",
+    "TIME.EPOCH:request.receive.time.epoch",
+    "HTTP.METHOD:request.firstline.method",
+    "STRING:request.status.last",
+    "BYTES:response.body.bytes",
+    "STRING:request.firstline.uri.query.*",
+]
+
+
+@pytest.fixture(scope="module")
+def lines():
+    return generate_combined_lines(64, seed=17, garbage_fraction=0.05)
+
+
+def assert_same_results(a: TpuBatchParser, b: TpuBatchParser, lines) -> None:
+    ra = a.parse_batch(lines)
+    rb = b.parse_batch(lines)
+    assert ra.good_lines == rb.good_lines
+    assert ra.bad_lines == rb.bad_lines
+    for fid in FIELDS:
+        assert ra.to_pylist(fid) == rb.to_pylist(fid), fid
+
+
+def test_pickle_round_trip(lines):
+    parser = TpuBatchParser("combined", FIELDS, use_pallas=False)
+    clone = pickle.loads(pickle.dumps(parser))
+    assert_same_results(parser, clone, lines)
+
+
+def test_artifact_file_round_trip(tmp_path, lines):
+    parser = TpuBatchParser("combined", FIELDS, use_pallas=False)
+    path = str(tmp_path / "combined.lpprog")
+    parser.save(path)
+    loaded = TpuBatchParser.load(path)
+    assert loaded.log_format == "combined"
+    assert loaded.requested == parser.requested
+    assert len(loaded.units) == len(parser.units)
+    assert_same_results(parser, loaded, lines)
+
+
+def test_artifact_round_trip_before_first_parse(tmp_path, lines):
+    # Serialize IMMEDIATELY after construction (no jit has ever run) and
+    # parse only on the loaded copy — the ship-to-worker pattern.
+    blob = TpuBatchParser("combined", FIELDS, use_pallas=False).to_bytes()
+    loaded = TpuBatchParser.from_bytes(blob)
+    fresh = TpuBatchParser("combined", FIELDS, use_pallas=False)
+    assert_same_results(fresh, loaded, lines)
+
+
+def test_artifact_rejects_garbage(tmp_path):
+    with pytest.raises(ValueError, match="not a logparser_tpu program artifact"):
+        TpuBatchParser.from_bytes(b"random bytes")
+
+
+def test_multiformat_artifact(lines):
+    multi = "combined\ncommon"
+    parser = TpuBatchParser(multi, FIELDS[:4], use_pallas=False)
+    clone = pickle.loads(pickle.dumps(parser))
+    ra = parser.parse_batch(lines)
+    rb = clone.parse_batch(lines)
+    assert (ra.format_index == rb.format_index).all()
+    for fid in FIELDS[:4]:
+        assert ra.to_pylist(fid) == rb.to_pylist(fid)
