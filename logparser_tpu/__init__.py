@@ -12,6 +12,16 @@ parsing and the bit-exactness baseline.
 
 __version__ = "0.1.0"
 
+from .observability import (  # noqa: F401
+    CappedLogger,
+    CounterRegistry,
+    Tracer,
+    counters,
+    disable_tracing,
+    enable_tracing,
+    tracer,
+    version_banner,
+)
 from .core import (  # noqa: F401
     Cast,
     DissectionFailure,

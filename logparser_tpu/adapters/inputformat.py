@@ -217,10 +217,12 @@ class LogfileRecordReader:
     """Reads one split, parses micro-batches on device, yields ParsedRecords."""
 
     def __init__(self, input_format: LogfileInputFormat, split: FileSplit):
+        from ..observability import CappedLogger
+
         self.input_format = input_format
         self.split = split
         self.counters = Counters()
-        self._errors_logged = 0
+        self._error_log = CappedLogger(LOG, cap=MAX_LOGGED_ERRORS)
 
         fields = input_format.requested_fields
         self.metadata_mode = list(fields) == [FIELDS_MAGIC]
@@ -284,22 +286,21 @@ class LogfileRecordReader:
     def _flush(
         self, batch: List[bytes], base_index: int = 0
     ) -> Iterator[Tuple[int, ParsedRecord]]:
+        from ..observability import counters as global_counters
+
         result = self.parser.parse_batch(batch)
         self.counters.lines_read += result.lines_read
         self.counters.bad_lines += result.bad_lines
         self.counters.good_lines += result.good_lines
+        # Process-wide aggregation across all readers/splits.
+        registry = global_counters()
+        registry.increment("Lines read", result.lines_read)
+        registry.increment("Good lines", result.good_lines)
+        registry.increment("Bad lines", result.bad_lines)
 
         records = records_from_result(result, self.parser.requested, self._casts)
         for i, record in enumerate(records):
             if record is None:
-                if self._errors_logged < MAX_LOGGED_ERRORS:
-                    self._errors_logged += 1
-                    LOG.error(
-                        "Parse error in line: %r%s",
-                        batch[i][:200],
-                        ""
-                        if self._errors_logged < MAX_LOGGED_ERRORS
-                        else " (further parse errors will not be logged)",
-                    )
+                self._error_log.error("Parse error in line: %r", batch[i][:200])
                 continue  # bad lines are skipped, not fatal
             yield base_index + i, record

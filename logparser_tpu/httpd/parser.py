@@ -36,7 +36,10 @@ class HttpdLoglineParser(Parser):
         log_format: str,
         timestamp_format: Optional[str] = None,
     ):
+        from ..observability import log_version_banner_once
+
         super().__init__(record_class)
+        log_version_banner_once()  # startup banner, HttpdLoglineParser.java:54-94
         self._setup_dissectors(log_format, timestamp_format)
 
     def _setup_dissectors(

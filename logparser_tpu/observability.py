@@ -1,0 +1,244 @@
+"""Tracing, counters, capped error logging, and the version banner.
+
+The reference has no profiling beyond slf4j debug logs (SURVEY §5.1) — real
+tracing is new work in this rebuild.  What it does have, and what is kept
+bit-compatible in spirit here:
+
+- Hadoop counters "Lines read/Good lines/Bad lines"
+  (ApacheHttpdLogfileRecordReader.java:118-120) — each record reader keeps its
+  own `adapters.inputformat.Counters` (the per-task view) and also feeds the
+  process-wide :class:`CounterRegistry` here (the job-aggregate view).
+- Capped error logging, 10 lines max (RecordReader :228-267) —
+  :class:`CappedLogger`, used by the record reader.
+- A startup version banner with build info (HttpdLoglineParser.java:54-94 +
+  the Version template) — :func:`version_banner` / :func:`log_version_banner_once`.
+
+New work:
+
+- :class:`Tracer` — per-stage wall-time accounting for the batch pipeline
+  (encode, device submit, device fetch, column assembly, oracle fallback),
+  enabled via :func:`enable_tracing` or LOGPARSER_TPU_TRACE=1.  The stage set
+  mirrors the hot-path inventory in SURVEY §3.3.
+- :func:`profile` — wraps ``jax.profiler.trace`` so a whole parse_batch call
+  can be captured for xprof/tensorboard when running on real hardware.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from dataclasses import dataclass, field as dataclass_field
+from typing import Any, Dict, Iterator, Optional
+
+LOG = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# stage tracing
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StageStats:
+    calls: int = 0
+    total_s: float = 0.0
+    last_s: float = 0.0
+    items: int = 0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "calls": self.calls,
+            "total_s": round(self.total_s, 6),
+            "last_s": round(self.last_s, 6),
+            "items": self.items,
+        }
+
+
+class Tracer:
+    """Per-stage wall-clock accounting.  Disabled tracers cost one attribute
+    check per stage; timing only happens when enabled."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self.stages: Dict[str, StageStats] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str, items: int = 0) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            stats = self.stages.setdefault(name, StageStats())
+            stats.calls += 1
+            stats.total_s += dt
+            stats.last_s = dt
+            stats.items += items
+
+    def add(self, name: str, seconds: float, items: int = 0) -> None:
+        """Manual accounting for spans that don't nest as a with-block."""
+        if not self.enabled:
+            return
+        stats = self.stages.setdefault(name, StageStats())
+        stats.calls += 1
+        stats.total_s += seconds
+        stats.last_s = seconds
+        stats.items += items
+
+    def reset(self) -> None:
+        self.stages.clear()
+
+    def report(self) -> Dict[str, Dict[str, Any]]:
+        return {name: s.as_dict() for name, s in sorted(self.stages.items())}
+
+    def pretty(self) -> str:
+        if not self.stages:
+            return "(no stages recorded)"
+        width = max(len(n) for n in self.stages)
+        lines = []
+        for name, s in sorted(
+            self.stages.items(), key=lambda kv: -kv[1].total_s
+        ):
+            rate = f"  {s.items / s.total_s:12.0f} items/s" if s.items and s.total_s else ""
+            lines.append(
+                f"{name:<{width}}  {s.calls:6d} calls  {s.total_s * 1000:10.2f} ms{rate}"
+            )
+        return "\n".join(lines)
+
+
+_GLOBAL_TRACER = Tracer(
+    enabled=os.environ.get("LOGPARSER_TPU_TRACE", "").strip().lower()
+    in ("1", "true", "yes")
+)
+
+
+def tracer() -> Tracer:
+    return _GLOBAL_TRACER
+
+
+def enable_tracing() -> Tracer:
+    _GLOBAL_TRACER.enabled = True
+    return _GLOBAL_TRACER
+
+
+def disable_tracing() -> Tracer:
+    _GLOBAL_TRACER.enabled = False
+    return _GLOBAL_TRACER
+
+
+@contextlib.contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Capture a JAX profiler trace (xprof/tensorboard readable) around a
+    block — the device-side complement of the host Tracer."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+# ---------------------------------------------------------------------------
+# counters
+# ---------------------------------------------------------------------------
+
+
+class CounterRegistry:
+    """Process-wide named counters (the Hadoop Counter analogue); adapters
+    keep their own per-reader Counters, this aggregates across them."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, int] = {}
+
+    def increment(self, name: str, delta: int = 1) -> None:
+        self._counters[name] = self._counters.get(name, 0) + delta
+
+    def get(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def as_dict(self) -> Dict[str, int]:
+        return dict(self._counters)
+
+    def reset(self) -> None:
+        self._counters.clear()
+
+
+_GLOBAL_COUNTERS = CounterRegistry()
+
+
+def counters() -> CounterRegistry:
+    return _GLOBAL_COUNTERS
+
+
+# ---------------------------------------------------------------------------
+# capped error logging (RecordReader :228-267 caps at 10 lines)
+# ---------------------------------------------------------------------------
+
+
+class CappedLogger:
+    """Log at most ``cap`` errors, then one suppression notice, then count
+    silently; ``suppressed`` holds the overflow for end-of-run reporting."""
+
+    def __init__(self, logger: logging.Logger, cap: int = 10):
+        self._logger = logger
+        self.cap = cap
+        self.logged = 0
+        self.suppressed = 0
+
+    def error(self, msg: str, *args: Any) -> None:
+        if self.logged < self.cap:
+            self.logged += 1
+            self._logger.error(msg, *args)
+            if self.logged == self.cap:
+                self._logger.error(
+                    "Max number of displayed errors (%d) reached; "
+                    "further bad lines are counted but not logged.",
+                    self.cap,
+                )
+        else:
+            self.suppressed += 1
+
+
+# ---------------------------------------------------------------------------
+# version banner (HttpdLoglineParser.java:54-94)
+# ---------------------------------------------------------------------------
+
+_BANNER_LOGGED = False
+
+
+def version_banner() -> str:
+    import sys
+
+    from . import __version__
+
+    # jax.__version__ is safe (importing jax does not initialize a backend);
+    # deliberately NO jax.devices()/default_backend() here — enumerating
+    # devices would acquire the TPU from a process that may never use it.
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        jax_line = "JAX not imported yet"
+    else:
+        jax_line = f"JAX {jax_mod.__version__}"
+    content = [
+        f"logparser_tpu {__version__} — TPU-native access log parsing",
+        jax_line,
+    ]
+    width = max(len(c) for c in content)
+    border = "-" * (width + 2)
+    lines = [f"/{border}\\"]
+    lines.extend(f"| {c:<{width}} |" for c in content)
+    lines.append(f"\\{border}/")
+    return "\n".join(lines)
+
+
+def log_version_banner_once(logger: Optional[logging.Logger] = None) -> None:
+    global _BANNER_LOGGED
+    if _BANNER_LOGGED:
+        return
+    log = logger or LOG
+    if not log.isEnabledFor(logging.INFO):
+        return  # don't build (or mark logged) until someone can see it
+    _BANNER_LOGGED = True
+    log.info("\n%s", version_banner())

@@ -23,6 +23,7 @@ throughput for the common case.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -312,8 +313,12 @@ class TpuBatchParser:
     # ------------------------------------------------------------------
 
     def parse_batch(self, lines: Sequence[Union[bytes, str]]) -> BatchResult:
+        from ..observability import tracer
+
+        trace = tracer()
         B = len(lines)
-        buf, lengths, overflow = encode_batch(lines)
+        with trace.stage("encode", items=B):
+            buf, lengths, overflow = encode_batch(lines)
         # Pad the batch dimension to a bucket so jit recompiles stay bounded.
         padded_b = _bucket_batch(B)
         if padded_b != B:
@@ -327,9 +332,10 @@ class TpuBatchParser:
         if fn is not None:
             # ONE packed [sum K_i, B] int32 output -> ONE device->host fetch
             # (transfer round-trips dominate on tunneled TPU attachments).
-            packed = np.asarray(
-                jax.device_get(fn(jnp.asarray(buf), jnp.asarray(lengths)))
-            )
+            with trace.stage("device", items=B):
+                out = fn(jnp.asarray(buf), jnp.asarray(lengths))
+            with trace.stage("fetch", items=B):
+                packed = np.asarray(jax.device_get(out))
             # Per-line winner: first registered format whose automaton
             # accepted the line (row_offset row: bit 0 = valid, bit 1 =
             # plausible).  A line is only CLAIMED by format i when no
@@ -363,6 +369,10 @@ class TpuBatchParser:
             block = packed[u.row_offset : u.row_offset + u.layout.n_rows]
             return u.layout.get(block, fid, comp)[:B]
 
+        # Timestamps are taken unconditionally (perf_counter is ~20ns against
+        # a multi-ms batch) so a tracer enabled mid-batch still records real
+        # durations; trace.add() itself no-ops when disabled.
+        t_columns = time.perf_counter()
         for fid in self.requested:
             merged = self.plan_by_id[fid]
             group = self._kind_group(merged.kind)
@@ -437,6 +447,7 @@ class TpuBatchParser:
                     if plan.kind == "long_clf_zero":
                         col["null_zero"] = np.where(sel, True, col["null_zero"])
             columns[fid] = col
+        trace.add("columns", time.perf_counter() - t_columns, items=B)
 
         # Host fallback: invalid lines entirely; host-only fields for every line.
         def coerce(fid: str, value: Any, winner_index: int) -> Any:
@@ -483,6 +494,7 @@ class TpuBatchParser:
         for ui, flds in enumerate(self._unit_oracle_fields):
             if flds:
                 need_oracle.update(int(r) for r in np.nonzero(winner == ui)[0])
+        t_oracle = time.perf_counter()
         for i in sorted(need_oracle):
             is_invalid = i in invalid_rows
             fields_needed = (
@@ -510,6 +522,10 @@ class TpuBatchParser:
                     }
                 else:
                     overrides[fid][i] = coerce(fid, values.get(fid), int(winner[i]))
+        trace.add(
+            "oracle_fallback", time.perf_counter() - t_oracle,
+            items=len(need_oracle),
+        )
 
         good = int(B - bad)
         return BatchResult(

@@ -1,0 +1,108 @@
+"""Observability subsystem: tracer stages, counters, capped logging, banner
+(SURVEY §5.1/§5.5 — tracing is new work; counters/cap/banner mirror the
+reference's Hadoop counters, RecordReader log cap, and startup banner)."""
+import logging
+
+import logparser_tpu
+from logparser_tpu.observability import (
+    CappedLogger,
+    CounterRegistry,
+    Tracer,
+    version_banner,
+)
+
+
+def test_tracer_records_stages():
+    t = Tracer(enabled=True)
+    with t.stage("encode", items=10):
+        pass
+    with t.stage("encode", items=5):
+        pass
+    t.add("oracle_fallback", 0.25, items=2)
+    report = t.report()
+    assert report["encode"]["calls"] == 2
+    assert report["encode"]["items"] == 15
+    assert report["oracle_fallback"]["total_s"] == 0.25
+    assert "encode" in t.pretty()
+
+
+def test_tracer_disabled_records_nothing():
+    t = Tracer(enabled=False)
+    with t.stage("encode", items=10):
+        pass
+    t.add("x", 1.0)
+    assert t.report() == {}
+    assert t.pretty() == "(no stages recorded)"
+
+
+def test_parse_batch_traces_pipeline_stages():
+    from logparser_tpu.tools.demolog import generate_combined_lines
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    t = logparser_tpu.enable_tracing()
+    t.reset()
+    try:
+        parser = TpuBatchParser(
+            "combined",
+            ["IP:connection.client.host", "BYTES:response.body.bytes"],
+            use_pallas=False,
+        )
+        lines = generate_combined_lines(32, seed=23, garbage_fraction=0.1)
+        parser.parse_batch(lines)
+    finally:
+        logparser_tpu.disable_tracing()
+    report = t.report()
+    for stage in ("encode", "device", "fetch", "columns", "oracle_fallback"):
+        assert stage in report, stage
+    assert report["encode"]["items"] == 32
+    # The garbage lines forced the oracle fallback to visit some rows.
+    assert report["oracle_fallback"]["items"] > 0
+
+
+def test_reader_feeds_global_counters(tmp_path):
+    from logparser_tpu.adapters.inputformat import FileSplit, LogfileInputFormat
+    from logparser_tpu.observability import counters
+    from logparser_tpu.tools.demolog import write_demolog
+
+    path = str(tmp_path / "access.log")
+    write_demolog(path, n=50, seed=31, garbage_fraction=0.1)
+
+    counters().reset()
+    fmt = LogfileInputFormat("combined", ["IP:connection.client.host"])
+    import os
+
+    reader = fmt.create_record_reader(FileSplit(path, 0, os.path.getsize(path)))
+    list(reader)
+    agg = counters().as_dict()
+    assert agg["Lines read"] == 50
+    assert agg["Good lines"] + agg["Bad lines"] == 50
+    assert agg["Bad lines"] > 0
+    # Per-reader counters agree with the process-wide aggregate.
+    assert reader.counters.as_dict() == agg
+
+
+def test_counter_registry():
+    c = CounterRegistry()
+    c.increment("Lines read", 100)
+    c.increment("Bad lines")
+    assert c.get("Lines read") == 100
+    assert c.as_dict() == {"Lines read": 100, "Bad lines": 1}
+    c.reset()
+    assert c.get("Lines read") == 0
+
+
+def test_capped_logger(caplog):
+    logger = logging.getLogger("test_capped")
+    capped = CappedLogger(logger, cap=3)
+    with caplog.at_level(logging.ERROR, logger="test_capped"):
+        for i in range(10):
+            capped.error("bad line %d", i)
+    # 3 errors + 1 suppression notice; the other 7 only counted.
+    assert len(caplog.records) == 4
+    assert capped.suppressed == 7
+
+
+def test_version_banner():
+    banner = version_banner()
+    assert logparser_tpu.__version__ in banner
+    assert "JAX" in banner
