@@ -27,6 +27,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass, field as dataclass_field
 from typing import Any, Dict, Iterator, Optional
@@ -62,6 +63,17 @@ class Tracer:
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
         self.stages: Dict[str, StageStats] = {}
+        # parse_batch runs on concurrent service threads; stats updates are
+        # read-modify-write and must not interleave.
+        self._lock = threading.Lock()
+
+    def _record(self, name: str, seconds: float, items: int) -> None:
+        with self._lock:
+            stats = self.stages.setdefault(name, StageStats())
+            stats.calls += 1
+            stats.total_s += seconds
+            stats.last_s = seconds
+            stats.items += items
 
     @contextlib.contextmanager
     def stage(self, name: str, items: int = 0) -> Iterator[None]:
@@ -72,25 +84,17 @@ class Tracer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            stats = self.stages.setdefault(name, StageStats())
-            stats.calls += 1
-            stats.total_s += dt
-            stats.last_s = dt
-            stats.items += items
+            self._record(name, time.perf_counter() - t0, items)
 
     def add(self, name: str, seconds: float, items: int = 0) -> None:
         """Manual accounting for spans that don't nest as a with-block."""
         if not self.enabled:
             return
-        stats = self.stages.setdefault(name, StageStats())
-        stats.calls += 1
-        stats.total_s += seconds
-        stats.last_s = seconds
-        stats.items += items
+        self._record(name, seconds, items)
 
     def reset(self) -> None:
-        self.stages.clear()
+        with self._lock:
+            self.stages.clear()
 
     def report(self) -> Dict[str, Dict[str, Any]]:
         return {name: s.as_dict() for name, s in sorted(self.stages.items())}
@@ -151,18 +155,23 @@ class CounterRegistry:
 
     def __init__(self) -> None:
         self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
 
     def increment(self, name: str, delta: int = 1) -> None:
-        self._counters[name] = self._counters.get(name, 0) + delta
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + delta
 
     def get(self, name: str) -> int:
-        return self._counters.get(name, 0)
+        with self._lock:
+            return self._counters.get(name, 0)
 
     def as_dict(self) -> Dict[str, int]:
-        return dict(self._counters)
+        with self._lock:
+            return dict(self._counters)
 
     def reset(self) -> None:
-        self._counters.clear()
+        with self._lock:
+            self._counters.clear()
 
 
 _GLOBAL_COUNTERS = CounterRegistry()

@@ -1,0 +1,260 @@
+"""Sidecar parse service: any-host interop over Arrow IPC.
+
+SURVEY §7 step 5: "Java/any-host interop over Arrow IPC; sidecar service
+mode".  The reference embeds the parser in-process in each engine (Hadoop,
+Pig, Hive, ...); the TPU-native equivalent offers the same capability to
+non-Python hosts by running the batch parser behind a socket: a JVM/Go/C++
+data engine ships raw loglines to the sidecar and gets typed Arrow columns
+back, so one TPU-attached process serves many engine workers.
+
+Wire protocol (deliberately trivial to implement from any language):
+
+    frame     := u32 big-endian length, then `length` payload bytes
+    session   := CONFIG frame, then any number of [LINES frame -> ARROW frame]
+    CONFIG    := JSON {"log_format": str, "fields": [str, ...],
+                       "timestamp_format": str|null}
+    LINES     := loglines joined by '\n' (UTF-8; no trailing newline needed)
+    ARROW     := one Arrow IPC stream (schema + one record batch) with the
+                 requested columns plus the `__valid__` validity column
+    error     := in place of an ARROW frame: 0xFFFFFFFF marker frame followed
+                 by one frame of UTF-8 error text
+    length 0  := end of session (client side); server closes the connection
+
+Compiled parsers are cached per config, so successive sessions with the same
+LogFormat skip recompilation (the service-side analogue of the reference's
+"compile the Pattern only once", TokenFormatDissector.java:209-210).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+LOG = logging.getLogger(__name__)
+
+_ERROR_MARKER = 0xFFFFFFFF
+_MAX_FRAME = 1 << 30  # 1 GiB sanity cap
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def read_frame(sock: socket.socket) -> Optional[bytes]:
+    """One length-prefixed frame; None on clean EOF or length-0 frame."""
+    header = _read_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack(">I", header)
+    if length == 0:
+        return None
+    if length == _ERROR_MARKER:
+        payload = read_frame(sock)
+        raise ParseServiceError(
+            (payload or b"(no error text)").decode("utf-8", errors="replace")
+        )
+    if length > _MAX_FRAME:
+        raise ValueError(f"frame length {length} exceeds cap {_MAX_FRAME}")
+    return _read_exact(sock, length)
+
+
+def write_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def write_error(sock: socket.socket, message: str) -> None:
+    sock.sendall(struct.pack(">I", _ERROR_MARKER))
+    write_frame(sock, message.encode("utf-8"))
+
+
+class ParseServiceError(RuntimeError):
+    """Server-side failure relayed to the client."""
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class _ParserCache:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._parsers: Dict[Tuple, Any] = {}
+
+    def get(self, config: Dict[str, Any]):
+        from .tpu.batch import TpuBatchParser
+
+        key = (
+            config["log_format"],
+            tuple(config["fields"]),
+            config.get("timestamp_format"),
+        )
+        with self._lock:
+            parser = self._parsers.get(key)
+            if parser is None:
+                parser = TpuBatchParser(
+                    config["log_format"],
+                    list(config["fields"]),
+                    timestamp_format=config.get("timestamp_format"),
+                )
+                self._parsers[key] = parser
+            return parser
+
+
+class _SessionHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:  # noqa: D102 — socketserver contract
+        sock = self.request
+        try:
+            config_frame = read_frame(sock)
+        except (ValueError, ConnectionError) as e:
+            LOG.error("Bad config frame: %s", e)
+            return
+        if config_frame is None:
+            return
+        try:
+            config = json.loads(config_frame)
+            parser = self.server.parser_cache.get(config)  # type: ignore[attr-defined]
+        except Exception as e:  # noqa: BLE001 — relay config errors to client
+            write_error(sock, f"bad config: {e}")
+            return
+
+        while True:
+            try:
+                lines_frame = read_frame(sock)
+            except (ValueError, ConnectionError) as e:
+                LOG.error("Bad lines frame: %s", e)
+                return
+            if lines_frame is None:
+                return  # end of session
+            try:
+                lines = lines_frame.split(b"\n")
+                if lines and lines[-1] == b"":
+                    lines.pop()
+                result = parser.parse_batch(lines)
+                table = result.to_arrow(include_validity=True)
+                import pyarrow as pa
+
+                sink = pa.BufferOutputStream()
+                with pa.ipc.new_stream(sink, table.schema) as writer:
+                    writer.write_table(table)
+                write_frame(sock, sink.getvalue().to_pybytes())
+            except Exception as e:  # noqa: BLE001 — keep the session alive
+                LOG.exception("parse_batch failed")
+                try:
+                    write_error(sock, f"parse failed: {e}")
+                except OSError:
+                    return
+
+
+class ParseService:
+    """The sidecar: `with ParseService() as svc: ... svc.port ...` or call
+    `serve_forever()` from a main program."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _SessionHandler)
+        self._server.parser_cache = _ParserCache()  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def host(self) -> str:
+        return self._server.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "ParseService":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="logparser-tpu-service",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "ParseService":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class ParseServiceClient:
+    """Python reference client (the wire protocol is the interop surface;
+    a JVM/Go client implements the same five-line framing)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        log_format: str,
+        fields: Sequence[str],
+        timestamp_format: Optional[str] = None,
+    ):
+        self._sock = socket.create_connection((host, port))
+        config = {
+            "log_format": log_format,
+            "fields": list(fields),
+            "timestamp_format": timestamp_format,
+        }
+        write_frame(self._sock, json.dumps(config).encode("utf-8"))
+
+    def parse(self, lines: Sequence[Union[str, bytes]]):
+        """Ship one batch; returns a pyarrow.Table."""
+        import pyarrow as pa
+
+        payload = b"\n".join(
+            line.encode("utf-8") if isinstance(line, str) else line
+            for line in lines
+        )
+        write_frame(self._sock, payload)
+        response = read_frame(self._sock)
+        if response is None:
+            raise ParseServiceError("server closed the connection")
+        with pa.ipc.open_stream(pa.BufferReader(response)) as reader:
+            return reader.read_all()
+
+    def close(self) -> None:
+        try:
+            self._sock.sendall(struct.pack(">I", 0))
+        except OSError:
+            pass
+        self._sock.close()
+
+    def __enter__(self) -> "ParseServiceClient":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

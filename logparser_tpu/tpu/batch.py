@@ -334,6 +334,11 @@ class TpuBatchParser:
             # (transfer round-trips dominate on tunneled TPU attachments).
             with trace.stage("device", items=B):
                 out = fn(jnp.asarray(buf), jnp.asarray(lengths))
+                if trace.enabled:
+                    # Dispatch is async: make the device stage contain the
+                    # actual kernel time instead of misattributing it to
+                    # the fetch stage (only when someone is looking).
+                    out = jax.block_until_ready(out)
             with trace.stage("fetch", items=B):
                 packed = np.asarray(jax.device_get(out))
             # Per-line winner: first registered format whose automaton

@@ -1,0 +1,85 @@
+"""Sidecar parse service: framing, Arrow IPC round trip, error relay,
+parser caching (SURVEY §7.5 "sidecar service mode")."""
+import pytest
+
+from logparser_tpu.service import (
+    ParseService,
+    ParseServiceClient,
+    ParseServiceError,
+)
+from logparser_tpu.tools.demolog import generate_combined_lines
+
+FIELDS = [
+    "IP:connection.client.host",
+    "TIME.EPOCH:request.receive.time.epoch",
+    "STRING:request.status.last",
+    "BYTES:response.body.bytes",
+]
+
+
+@pytest.fixture(scope="module")
+def service():
+    with ParseService() as svc:
+        yield svc
+
+
+def test_parse_round_trip(service):
+    lines = generate_combined_lines(100, seed=41)
+    with ParseServiceClient(
+        service.host, service.port, "combined", FIELDS
+    ) as client:
+        table = client.parse(lines)
+    assert table.num_rows == 100
+    assert set(table.column_names) >= set(FIELDS) | {"__valid__"}
+    ips = table.column("IP:connection.client.host").to_pylist()
+    assert all(ip.count(".") == 3 for ip in ips)
+    epochs = table.column("TIME.EPOCH:request.receive.time.epoch").to_pylist()
+    assert all(isinstance(e, int) for e in epochs)
+
+
+def test_multiple_batches_one_session(service):
+    with ParseServiceClient(
+        service.host, service.port, "combined", FIELDS[:1]
+    ) as client:
+        for seed in (1, 2, 3):
+            table = client.parse(generate_combined_lines(10, seed=seed))
+            assert table.num_rows == 10
+
+
+def test_bytes_and_str_lines(service):
+    line = '9.8.7.6 - - [01/Jan/2026:00:00:00 +0000] "GET / HTTP/1.1" 200 5 "-" "x"'
+    with ParseServiceClient(
+        service.host, service.port, "combined", FIELDS[:1]
+    ) as client:
+        t1 = client.parse([line])
+        t2 = client.parse([line.encode("utf-8")])
+    assert t1.column(FIELDS[0]).to_pylist() == t2.column(FIELDS[0]).to_pylist() == ["9.8.7.6"]
+
+
+def test_bad_config_relays_error(service):
+    with pytest.raises(ParseServiceError, match="bad config"):
+        ParseServiceClient(
+            service.host, service.port, "combined", ["NOSUCH:field.path"]
+        ).parse(["x"])
+
+
+def test_bad_lines_are_nulls_not_errors(service):
+    lines = ["complete garbage", "more garbage"]
+    with ParseServiceClient(
+        service.host, service.port, "combined", FIELDS[:1]
+    ) as client:
+        table = client.parse(lines)
+    assert table.num_rows == 2
+    assert table.column("__valid__").to_pylist() == [False, False]
+    assert table.column(FIELDS[0]).to_pylist() == [None, None]
+
+
+def test_parser_cache_shared_across_sessions(service):
+    cache = service._server.parser_cache
+    n_before = len(cache._parsers)
+    for _ in range(3):
+        with ParseServiceClient(
+            service.host, service.port, "combined", FIELDS
+        ) as client:
+            client.parse(generate_combined_lines(5, seed=2))
+    assert len(cache._parsers) == n_before  # same config -> same compiled parser
