@@ -133,9 +133,12 @@ class Token:
         if self.warning_message_when_used:
             import logging
 
-            logging.getLogger(__name__).warning(
-                "%s %s", self.warning_message_when_used, self.output_fields
+            # slf4j-style: any remaining {} placeholder takes the output
+            # fields (the field-name one was filled at token-match time).
+            message = self.warning_message_when_used.replace(
+                "{}", str(self.output_fields), 1
             )
+            logging.getLogger(__name__).warning("%s", message)
 
     def __repr__(self) -> str:
         return f"{{{self.output_fields} ({self.start_pos}+{self.length});Prio={self.prio}}}"

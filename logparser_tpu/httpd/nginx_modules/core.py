@@ -196,5 +196,15 @@ class CoreLogModule(NginxModule):
                STRING_OR_LONG, FORMAT_NUMBER),
             _t("$tcpinfo_rcv_space", "connection.tcpinfo.receive.space", "BYTES",
                STRING_OR_LONG, FORMAT_NUMBER),
+            # Fallback for all unknown variables that might appear
+            # (CoreLogModule.java:481-486): lowest priority, warns on use,
+            # assumes a whitespace-free text value.
+            NamedTokenParser("\\$([a-z0-9\\-\\_]*)", "nginx.unknown.",
+                             "UNKNOWN_NGINX_VARIABLE", STRING_ONLY,
+                             FORMAT_NO_SPACE_STRING, -10)
+            .set_warning_message_when_used(
+                'Found unknown variable "${}" that was mapped to "{}". It is '
+                "assumed the values are text that cannot contain a whitespace."
+            ),
         ]
         return p

@@ -97,19 +97,26 @@ class Tracer:
             self.stages.clear()
 
     def report(self) -> Dict[str, Dict[str, Any]]:
-        return {name: s.as_dict() for name, s in sorted(self.stages.items())}
+        with self._lock:
+            snapshot = {name: s.as_dict() for name, s in self.stages.items()}
+        return dict(sorted(snapshot.items()))
 
     def pretty(self) -> str:
-        if not self.stages:
+        with self._lock:
+            stages = {
+                name: (s.calls, s.total_s, s.items)
+                for name, s in self.stages.items()
+            }
+        if not stages:
             return "(no stages recorded)"
-        width = max(len(n) for n in self.stages)
+        width = max(len(n) for n in stages)
         lines = []
-        for name, s in sorted(
-            self.stages.items(), key=lambda kv: -kv[1].total_s
+        for name, (calls, total_s, items) in sorted(
+            stages.items(), key=lambda kv: -kv[1][1]
         ):
-            rate = f"  {s.items / s.total_s:12.0f} items/s" if s.items and s.total_s else ""
+            rate = f"  {items / total_s:12.0f} items/s" if items and total_s else ""
             lines.append(
-                f"{name:<{width}}  {s.calls:6d} calls  {s.total_s * 1000:10.2f} ms{rate}"
+                f"{name:<{width}}  {calls:6d} calls  {total_s * 1000:10.2f} ms{rate}"
             )
         return "\n".join(lines)
 

@@ -13,7 +13,10 @@ Wire protocol (deliberately trivial to implement from any language):
     session   := CONFIG frame, then any number of [LINES frame -> ARROW frame]
     CONFIG    := JSON {"log_format": str, "fields": [str, ...],
                        "timestamp_format": str|null}
-    LINES     := loglines joined by '\n' (UTF-8; no trailing newline needed)
+    LINES     := u32 big-endian line count, then the loglines joined by '\n'
+                 (UTF-8).  Loglines cannot contain '\n' — they are lines.
+                 count=0 means an empty batch (an empty ARROW table comes
+                 back); an empty logline is a present-but-empty row.
     ARROW     := one Arrow IPC stream (schema + one record batch) with the
                  requested columns plus the `__valid__` validity column
     error     := in place of an ARROW frame: 0xFFFFFFFF marker frame followed
@@ -32,6 +35,7 @@ import socket
 import socketserver
 import struct
 import threading
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 LOG = logging.getLogger(__name__)
@@ -74,7 +78,10 @@ def read_frame(sock: socket.socket) -> Optional[bytes]:
 
 
 def write_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    # Two sendalls: no header+payload concatenation copy (Arrow responses
+    # can be large).
+    sock.sendall(struct.pack(">I", len(payload)))
+    sock.sendall(payload)
 
 
 def write_error(sock: socket.socket, message: str) -> None:
@@ -92,9 +99,14 @@ class ParseServiceError(RuntimeError):
 
 
 class _ParserCache:
-    def __init__(self) -> None:
+    """LRU-bounded: each entry pins a compiled parser + XLA executables, so
+    a long-lived sidecar serving many distinct configs must evict."""
+
+    def __init__(self, max_entries: int = 32) -> None:
         self._lock = threading.Lock()
-        self._parsers: Dict[Tuple, Any] = {}
+        self._max_entries = max_entries
+        self._parsers: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._building: Dict[Tuple, threading.Lock] = {}
 
     def get(self, config: Dict[str, Any]):
         from .tpu.batch import TpuBatchParser
@@ -104,15 +116,31 @@ class _ParserCache:
             tuple(config["fields"]),
             config.get("timestamp_format"),
         )
+        # Compile outside the global lock: a cold compile takes seconds and
+        # must not stall sessions whose parser is already cached.  A per-key
+        # lock still deduplicates concurrent compiles of the same config.
         with self._lock:
             parser = self._parsers.get(key)
+            if parser is not None:
+                self._parsers.move_to_end(key)
+                return parser
+            key_lock = self._building.setdefault(key, threading.Lock())
+        with key_lock:
+            with self._lock:
+                parser = self._parsers.get(key)
+                if parser is not None:
+                    self._parsers.move_to_end(key)
             if parser is None:
                 parser = TpuBatchParser(
                     config["log_format"],
                     list(config["fields"]),
                     timestamp_format=config.get("timestamp_format"),
                 )
-                self._parsers[key] = parser
+                with self._lock:
+                    self._parsers[key] = parser
+                    while len(self._parsers) > self._max_entries:
+                        self._parsers.popitem(last=False)
+                    self._building.pop(key, None)
             return parser
 
 
@@ -121,7 +149,7 @@ class _SessionHandler(socketserver.BaseRequestHandler):
         sock = self.request
         try:
             config_frame = read_frame(sock)
-        except (ValueError, ConnectionError) as e:
+        except (ValueError, ConnectionError, ParseServiceError) as e:
             LOG.error("Bad config frame: %s", e)
             return
         if config_frame is None:
@@ -130,21 +158,36 @@ class _SessionHandler(socketserver.BaseRequestHandler):
             config = json.loads(config_frame)
             parser = self.server.parser_cache.get(config)  # type: ignore[attr-defined]
         except Exception as e:  # noqa: BLE001 — relay config errors to client
-            write_error(sock, f"bad config: {e}")
+            # Keep draining the session instead of closing: a client already
+            # mid-send of a large LINES frame would otherwise see ECONNRESET
+            # and the RST can discard the buffered error text.
+            message = f"bad config: {e}"
+            try:
+                write_error(sock, message)
+                while read_frame(sock) is not None:
+                    write_error(sock, message)
+            except (OSError, ValueError, ParseServiceError):
+                pass
             return
 
         while True:
             try:
                 lines_frame = read_frame(sock)
-            except (ValueError, ConnectionError) as e:
+            except (ValueError, ConnectionError, ParseServiceError) as e:
                 LOG.error("Bad lines frame: %s", e)
                 return
             if lines_frame is None:
                 return  # end of session
             try:
-                lines = lines_frame.split(b"\n")
-                if lines and lines[-1] == b"":
-                    lines.pop()
+                if len(lines_frame) < 4:
+                    raise ValueError("LINES frame shorter than its count header")
+                (count,) = struct.unpack(">I", lines_frame[:4])
+                lines = lines_frame[4:].split(b"\n") if count else []
+                if len(lines) != count:
+                    raise ValueError(
+                        f"LINES frame declared {count} lines, payload has "
+                        f"{len(lines)}"
+                    )
                 result = parser.parse_batch(lines)
                 table = result.to_arrow(include_validity=True)
                 import pyarrow as pa
@@ -173,6 +216,7 @@ class ParseService:
         self._server = _Server((host, port), _SessionHandler)
         self._server.parser_cache = _ParserCache()  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+        self._serving = False
 
     @property
     def host(self) -> str:
@@ -183,6 +227,7 @@ class ParseService:
         return self._server.server_address[1]
 
     def start(self) -> "ParseService":
+        self._serving = True
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="logparser-tpu-service",
             daemon=True,
@@ -191,10 +236,14 @@ class ParseService:
         return self
 
     def serve_forever(self) -> None:
+        self._serving = True
         self._server.serve_forever()
 
     def shutdown(self) -> None:
-        self._server.shutdown()
+        # BaseServer.shutdown() waits on an event only a running
+        # serve_forever loop sets; calling it before start() blocks forever.
+        if self._serving:
+            self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -235,10 +284,16 @@ class ParseServiceClient:
         """Ship one batch; returns a pyarrow.Table."""
         import pyarrow as pa
 
-        payload = b"\n".join(
+        encoded = [
             line.encode("utf-8") if isinstance(line, str) else line
             for line in lines
-        )
+        ]
+        for line in encoded:
+            if b"\n" in line:
+                raise ValueError(
+                    "loglines cannot contain '\\n'; split them before parse()"
+                )
+        payload = struct.pack(">I", len(encoded)) + b"\n".join(encoded)
         write_frame(self._sock, payload)
         response = read_frame(self._sock)
         if response is None:

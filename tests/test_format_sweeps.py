@@ -1,0 +1,511 @@
+"""Full-vocabulary sweep tests for the Apache and NGINX dialects.
+
+Parity contracts ported from the reference suite:
+- ApacheHttpdAllFieldsTest.java — every %-token (with </> original/last
+  variants) must advertise its documented output fields.
+- nginxmodules/NginxAllFieldsTest.java — every variable on
+  nginx.org/en/docs/varindex.html must be explicitly handled (i.e. never fall
+  into the UNKNOWN_NGINX_VARIABLE catch-all).
+- JettyLogFormatParserTest.java — the Jetty extra-space quirk formats.
+- JsonLogFormatTest.java — a LogFormat embedded in a JSON template.
+"""
+import pytest
+
+from logparser_tpu.httpd import HttpdLoglineParser
+
+
+class MapRecord:
+    def __init__(self):
+        self.results = {}
+
+    def set_value(self, name: str, value):
+        self.results[name] = value
+
+
+def possible_paths(logformat: str):
+    return HttpdLoglineParser(MapRecord, logformat).get_possible_paths()
+
+
+# --------------------------------------------------------------------------
+# Apache %-token output vocabulary (ApacheHttpdAllFieldsTest.java:133-365)
+# --------------------------------------------------------------------------
+
+APACHE_FIELD_AVAILABILITY = [
+    ("%a", ["IP:connection.client.ip", "IP:connection.client.ip.last"]),
+    ("%<a", ["IP:connection.client.ip.original"]),
+    ("%>a", ["IP:connection.client.ip.last"]),
+    ("%{c}a", ["IP:connection.client.peerip", "IP:connection.client.peerip.last"]),
+    ("%<{c}a", ["IP:connection.client.peerip.original"]),
+    ("%>{c}a", ["IP:connection.client.peerip.last"]),
+    ("%A", ["IP:connection.server.ip", "IP:connection.server.ip.last"]),
+    ("%<A", ["IP:connection.server.ip.original"]),
+    ("%>A", ["IP:connection.server.ip.last"]),
+    ("%B", ["BYTES:response.body.bytes", "BYTES:response.body.bytes.last"]),
+    ("%<B", ["BYTES:response.body.bytes.original"]),
+    ("%>B", ["BYTES:response.body.bytes.last"]),
+    ("%b Deprecated", ["BYTES:response.body.bytesclf"]),
+    ("%b", ["BYTESCLF:response.body.bytes", "BYTESCLF:response.body.bytes.last"]),
+    ("%<b", ["BYTESCLF:response.body.bytes.original"]),
+    ("%>b", ["BYTESCLF:response.body.bytes.last"]),
+    ("%{FooBar}C", ["HTTP.COOKIE:request.cookies.foobar"]),
+    ("%{FooBar}e", ["VARIABLE:server.environment.foobar"]),
+    ("%f", ["FILENAME:server.filename", "FILENAME:server.filename.last"]),
+    ("%<f", ["FILENAME:server.filename.original"]),
+    ("%>f", ["FILENAME:server.filename.last"]),
+    ("%h", ["IP:connection.client.host", "IP:connection.client.host.last"]),
+    ("%<h", ["IP:connection.client.host.original"]),
+    ("%>h", ["IP:connection.client.host.last"]),
+    ("%H", ["PROTOCOL:request.protocol", "PROTOCOL:request.protocol.last"]),
+    ("%<H", ["PROTOCOL:request.protocol.original"]),
+    ("%>H", ["PROTOCOL:request.protocol.last"]),
+    ("%{FooBar}i", ["HTTP.HEADER:request.header.foobar"]),
+    ("%{FooBar}^ti", ["HTTP.TRAILER:request.trailer.foobar"]),
+    ("%k", ["NUMBER:connection.keepalivecount",
+            "NUMBER:connection.keepalivecount.last"]),
+    ("%<k", ["NUMBER:connection.keepalivecount.original"]),
+    ("%>k", ["NUMBER:connection.keepalivecount.last"]),
+    ("%l", ["NUMBER:connection.client.logname",
+            "NUMBER:connection.client.logname.last"]),
+    ("%<l", ["NUMBER:connection.client.logname.original"]),
+    ("%>l", ["NUMBER:connection.client.logname.last"]),
+    ("%L", ["STRING:request.errorlogid", "STRING:request.errorlogid.last"]),
+    ("%<L", ["STRING:request.errorlogid.original"]),
+    ("%>L", ["STRING:request.errorlogid.last"]),
+    ("%m", ["HTTP.METHOD:request.method", "HTTP.METHOD:request.method.last"]),
+    ("%<m", ["HTTP.METHOD:request.method.original"]),
+    ("%>m", ["HTTP.METHOD:request.method.last"]),
+    ("%{FooBar}n", ["STRING:server.module_note.foobar"]),
+    ("%{FooBar}o", ["HTTP.HEADER:response.header.foobar"]),
+    ("%{FooBar}^to", ["HTTP.TRAILER:response.trailer.foobar"]),
+    ("%p", ["PORT:request.server.port.canonical",
+            "PORT:request.server.port.canonical.last"]),
+    ("%<p", ["PORT:request.server.port.canonical.original"]),
+    ("%>p", ["PORT:request.server.port.canonical.last"]),
+    ("%{canonical}p", ["PORT:connection.server.port.canonical",
+                       "PORT:connection.server.port.canonical.last"]),
+    ("%<{canonical}p", ["PORT:connection.server.port.canonical.original"]),
+    ("%>{canonical}p", ["PORT:connection.server.port.canonical.last"]),
+    ("%{local}p", ["PORT:connection.server.port",
+                   "PORT:connection.server.port.last"]),
+    ("%<{local}p", ["PORT:connection.server.port.original"]),
+    ("%>{local}p", ["PORT:connection.server.port.last"]),
+    ("%{remote}p", ["PORT:connection.client.port",
+                    "PORT:connection.client.port.last"]),
+    ("%<{remote}p", ["PORT:connection.client.port.original"]),
+    ("%>{remote}p", ["PORT:connection.client.port.last"]),
+    ("%P", ["NUMBER:connection.server.child.processid",
+            "NUMBER:connection.server.child.processid.last"]),
+    ("%<P", ["NUMBER:connection.server.child.processid.original"]),
+    ("%>P", ["NUMBER:connection.server.child.processid.last"]),
+    ("%{pid}P", ["NUMBER:connection.server.child.processid",
+                 "NUMBER:connection.server.child.processid.last"]),
+    ("%<{pid}P", ["NUMBER:connection.server.child.processid.original"]),
+    ("%>{pid}P", ["NUMBER:connection.server.child.processid.last"]),
+    ("%{tid}P", ["NUMBER:connection.server.child.threadid",
+                 "NUMBER:connection.server.child.threadid.last"]),
+    ("%<{tid}P", ["NUMBER:connection.server.child.threadid.original"]),
+    ("%>{tid}P", ["NUMBER:connection.server.child.threadid.last"]),
+    ("%{hextid}P", ["NUMBER:connection.server.child.hexthreadid",
+                    "NUMBER:connection.server.child.hexthreadid.last"]),
+    ("%<{hextid}P", ["NUMBER:connection.server.child.hexthreadid.original"]),
+    ("%>{hextid}P", ["NUMBER:connection.server.child.hexthreadid.last"]),
+    ("%q", ["HTTP.QUERYSTRING:request.querystring",
+            "HTTP.QUERYSTRING:request.querystring.last"]),
+    ("%<q", ["HTTP.QUERYSTRING:request.querystring.original"]),
+    ("%>q", ["HTTP.QUERYSTRING:request.querystring.last"]),
+    ("%r", ["HTTP.FIRSTLINE:request.firstline",
+            "HTTP.FIRSTLINE:request.firstline.original"]),
+    ("%<r", ["HTTP.FIRSTLINE:request.firstline.original"]),
+    ("%>r", ["HTTP.FIRSTLINE:request.firstline.last"]),
+    ("%R", ["STRING:request.handler", "STRING:request.handler.last"]),
+    ("%<R", ["STRING:request.handler.original"]),
+    ("%>R", ["STRING:request.handler.last"]),
+    ("%s", ["STRING:request.status", "STRING:request.status.original"]),
+    ("%<s", ["STRING:request.status.original"]),
+    ("%>s", ["STRING:request.status.last"]),
+    ("%t", ["TIME.STAMP:request.receive.time",
+            "TIME.STAMP:request.receive.time.last"]),
+    ("%<t", ["TIME.STAMP:request.receive.time.original"]),
+    ("%>t", ["TIME.STAMP:request.receive.time.last"]),
+    ("%{%Y}t", ["TIME.YEAR:request.receive.time.year"]),
+    ("%{begin:%Y}t", ["TIME.YEAR:request.receive.time.begin.year"]),
+    ("%{end:%Y}t", ["TIME.YEAR:request.receive.time.end.year"]),
+    ("%{sec}t", ["TIME.SECONDS:request.receive.time.sec"]),
+    ("%<{sec}t", ["TIME.SECONDS:request.receive.time.sec.original"]),
+    ("%>{sec}t", ["TIME.SECONDS:request.receive.time.sec.last"]),
+    ("%{begin:sec}t", ["TIME.SECONDS:request.receive.time.begin.sec",
+                       "TIME.SECONDS:request.receive.time.begin.sec.last"]),
+    ("%<{begin:sec}t", ["TIME.SECONDS:request.receive.time.begin.sec.original"]),
+    ("%>{begin:sec}t", ["TIME.SECONDS:request.receive.time.begin.sec.last"]),
+    ("%{end:sec}t", ["TIME.SECONDS:request.receive.time.end.sec",
+                     "TIME.SECONDS:request.receive.time.end.sec.last"]),
+    ("%<{end:sec}t", ["TIME.SECONDS:request.receive.time.end.sec.original"]),
+    ("%>{end:sec}t", ["TIME.SECONDS:request.receive.time.end.sec.last"]),
+    ("%{msec}t Deprecated", ["TIME.EPOCH:request.receive.time.begin.msec"]),
+    ("%{msec}t", ["TIME.EPOCH:request.receive.time.msec",
+                  "TIME.EPOCH:request.receive.time.msec.last"]),
+    ("%<{msec}t", ["TIME.EPOCH:request.receive.time.msec.original"]),
+    ("%>{msec}t", ["TIME.EPOCH:request.receive.time.msec.last"]),
+    ("%{begin:msec}t", ["TIME.EPOCH:request.receive.time.begin.msec",
+                        "TIME.EPOCH:request.receive.time.begin.msec.last"]),
+    ("%<{begin:msec}t", ["TIME.EPOCH:request.receive.time.begin.msec.original"]),
+    ("%>{begin:msec}t", ["TIME.EPOCH:request.receive.time.begin.msec.last"]),
+    ("%{end:msec}t", ["TIME.EPOCH:request.receive.time.end.msec",
+                      "TIME.EPOCH:request.receive.time.end.msec.last"]),
+    ("%<{end:msec}t", ["TIME.EPOCH:request.receive.time.end.msec.original"]),
+    ("%>{end:msec}t", ["TIME.EPOCH:request.receive.time.end.msec.last"]),
+    ("%{usec}t Deprecated", ["TIME.EPOCH.USEC:request.receive.time.begin.usec"]),
+    ("%{usec}t", ["TIME.EPOCH.USEC:request.receive.time.usec",
+                  "TIME.EPOCH.USEC:request.receive.time.usec.last"]),
+    ("%<{usec}t", ["TIME.EPOCH.USEC:request.receive.time.usec.original"]),
+    ("%>{usec}t", ["TIME.EPOCH.USEC:request.receive.time.usec.last"]),
+    ("%{begin:usec}t", ["TIME.EPOCH.USEC:request.receive.time.begin.usec",
+                        "TIME.EPOCH.USEC:request.receive.time.begin.usec.last"]),
+    ("%<{begin:usec}t", ["TIME.EPOCH.USEC:request.receive.time.begin.usec.original"]),
+    ("%>{begin:usec}t", ["TIME.EPOCH.USEC:request.receive.time.begin.usec.last"]),
+    ("%{end:usec}t", ["TIME.EPOCH.USEC:request.receive.time.end.usec",
+                      "TIME.EPOCH.USEC:request.receive.time.end.usec.last"]),
+    ("%<{end:usec}t", ["TIME.EPOCH.USEC:request.receive.time.end.usec.original"]),
+    ("%>{end:usec}t", ["TIME.EPOCH.USEC:request.receive.time.end.usec.last"]),
+    ("%{msec_frac}t Deprecated",
+     ["TIME.EPOCH:request.receive.time.begin.msec_frac"]),
+    ("%{msec_frac}t", ["TIME.EPOCH:request.receive.time.msec_frac",
+                       "TIME.EPOCH:request.receive.time.msec_frac.last"]),
+    ("%<{msec_frac}t", ["TIME.EPOCH:request.receive.time.msec_frac.original"]),
+    ("%>{msec_frac}t", ["TIME.EPOCH:request.receive.time.msec_frac.last"]),
+    ("%{begin:msec_frac}t",
+     ["TIME.EPOCH:request.receive.time.begin.msec_frac",
+      "TIME.EPOCH:request.receive.time.begin.msec_frac.last"]),
+    ("%<{begin:msec_frac}t",
+     ["TIME.EPOCH:request.receive.time.begin.msec_frac.original"]),
+    ("%>{begin:msec_frac}t",
+     ["TIME.EPOCH:request.receive.time.begin.msec_frac.last"]),
+    ("%{end:msec_frac}t",
+     ["TIME.EPOCH:request.receive.time.end.msec_frac",
+      "TIME.EPOCH:request.receive.time.end.msec_frac.last"]),
+    ("%<{end:msec_frac}t",
+     ["TIME.EPOCH:request.receive.time.end.msec_frac.original"]),
+    ("%>{end:msec_frac}t",
+     ["TIME.EPOCH:request.receive.time.end.msec_frac.last"]),
+    ("%{usec_frac}t Deprecated",
+     ["TIME.EPOCH.USEC_FRAC:request.receive.time.begin.usec_frac"]),
+    ("%{usec_frac}t",
+     ["TIME.EPOCH.USEC_FRAC:request.receive.time.usec_frac",
+      "TIME.EPOCH.USEC_FRAC:request.receive.time.usec_frac.last"]),
+    ("%<{usec_frac}t",
+     ["TIME.EPOCH.USEC_FRAC:request.receive.time.usec_frac.original"]),
+    ("%>{usec_frac}t",
+     ["TIME.EPOCH.USEC_FRAC:request.receive.time.usec_frac.last"]),
+    ("%{begin:usec_frac}t",
+     ["TIME.EPOCH.USEC_FRAC:request.receive.time.begin.usec_frac",
+      "TIME.EPOCH.USEC_FRAC:request.receive.time.begin.usec_frac.last"]),
+    ("%<{begin:usec_frac}t",
+     ["TIME.EPOCH.USEC_FRAC:request.receive.time.begin.usec_frac.original"]),
+    ("%>{begin:usec_frac}t",
+     ["TIME.EPOCH.USEC_FRAC:request.receive.time.begin.usec_frac.last"]),
+    ("%{end:usec_frac}t",
+     ["TIME.EPOCH.USEC_FRAC:request.receive.time.end.usec_frac",
+      "TIME.EPOCH.USEC_FRAC:request.receive.time.end.usec_frac.last"]),
+    ("%<{end:usec_frac}t",
+     ["TIME.EPOCH.USEC_FRAC:request.receive.time.end.usec_frac.original"]),
+    ("%>{end:usec_frac}t",
+     ["TIME.EPOCH.USEC_FRAC:request.receive.time.end.usec_frac.last"]),
+    ("%T", ["SECONDS:response.server.processing.time",
+            "SECONDS:response.server.processing.time.original"]),
+    ("%<T", ["SECONDS:response.server.processing.time.original"]),
+    ("%>T", ["SECONDS:response.server.processing.time.last"]),
+    ("%D Deprecated", ["MICROSECONDS:server.process.time"]),
+    ("%D", ["MICROSECONDS:response.server.processing.time",
+            "MICROSECONDS:response.server.processing.time.original"]),
+    ("%<D", ["MICROSECONDS:response.server.processing.time.original"]),
+    ("%>D", ["MICROSECONDS:response.server.processing.time.last"]),
+    ("%{us}T", ["MICROSECONDS:response.server.processing.time",
+                "MICROSECONDS:response.server.processing.time.original"]),
+    ("%<{us}T", ["MICROSECONDS:response.server.processing.time.original"]),
+    ("%>{us}T", ["MICROSECONDS:response.server.processing.time.last"]),
+    ("%{ms}T", ["MILLISECONDS:response.server.processing.time",
+                "MILLISECONDS:response.server.processing.time.original"]),
+    ("%<{ms}T", ["MILLISECONDS:response.server.processing.time.original"]),
+    ("%>{ms}T", ["MILLISECONDS:response.server.processing.time.last"]),
+    ("%{s}T", ["SECONDS:response.server.processing.time",
+               "SECONDS:response.server.processing.time.original"]),
+    ("%<{s}T", ["SECONDS:response.server.processing.time.original"]),
+    ("%>{s}T", ["SECONDS:response.server.processing.time.last"]),
+    ("%u", ["STRING:connection.client.user",
+            "STRING:connection.client.user.last"]),
+    ("%<u", ["STRING:connection.client.user.original"]),
+    ("%>u", ["STRING:connection.client.user.last"]),
+    ("%U", ["URI:request.urlpath", "URI:request.urlpath.original"]),
+    ("%<U", ["URI:request.urlpath.original"]),
+    ("%>U", ["URI:request.urlpath.last"]),
+    ("%v", ["STRING:connection.server.name.canonical",
+            "STRING:connection.server.name.canonical.last"]),
+    ("%<v", ["STRING:connection.server.name.canonical.original"]),
+    ("%>v", ["STRING:connection.server.name.canonical.last"]),
+    ("%V", ["STRING:connection.server.name",
+            "STRING:connection.server.name.last"]),
+    ("%<V", ["STRING:connection.server.name.original"]),
+    ("%>V", ["STRING:connection.server.name.last"]),
+    ("%X", ["HTTP.CONNECTSTATUS:response.connection.status",
+            "HTTP.CONNECTSTATUS:response.connection.status.last"]),
+    ("%<X", ["HTTP.CONNECTSTATUS:response.connection.status.original"]),
+    ("%>X", ["HTTP.CONNECTSTATUS:response.connection.status.last"]),
+    ("%I", ["BYTES:request.bytes", "BYTES:request.bytes.last"]),
+    ("%<I", ["BYTES:request.bytes.original"]),
+    ("%>I", ["BYTES:request.bytes.last"]),
+    ("%O", ["BYTES:response.bytes", "BYTES:response.bytes.last"]),
+    ("%<O", ["BYTES:response.bytes.original"]),
+    ("%>O", ["BYTES:response.bytes.last"]),
+    ("%S", ["BYTES:total.bytes", "BYTES:total.bytes.last"]),
+    ("%<S", ["BYTES:total.bytes.original"]),
+    ("%>S", ["BYTES:total.bytes.last"]),
+    ("%{cookie}i", ["HTTP.COOKIES:request.cookies",
+                    "HTTP.COOKIES:request.cookies.last"]),
+    ("%<{cookie}i", ["HTTP.COOKIES:request.cookies.original"]),
+    ("%>{cookie}i", ["HTTP.COOKIES:request.cookies.last"]),
+    ("%{set-cookie}o", ["HTTP.SETCOOKIES:response.cookies",
+                        "HTTP.SETCOOKIES:response.cookies.last"]),
+    ("%<{set-cookie}o", ["HTTP.SETCOOKIES:response.cookies.original"]),
+    ("%>{set-cookie}o", ["HTTP.SETCOOKIES:response.cookies.last"]),
+    ("%{user-agent}i", ["HTTP.USERAGENT:request.user-agent",
+                        "HTTP.USERAGENT:request.user-agent.last"]),
+    ("%<{user-agent}i", ["HTTP.USERAGENT:request.user-agent.original"]),
+    ("%>{user-agent}i", ["HTTP.USERAGENT:request.user-agent.last"]),
+    ("%{referer}i", ["HTTP.URI:request.referer",
+                     "HTTP.URI:request.referer.last"]),
+    ("%<{referer}i", ["HTTP.URI:request.referer.original"]),
+    ("%>{referer}i", ["HTTP.URI:request.referer.last"]),
+]
+
+
+@pytest.mark.parametrize(
+    "logformat,expected",
+    APACHE_FIELD_AVAILABILITY,
+    ids=[fmt for fmt, _ in APACHE_FIELD_AVAILABILITY],
+)
+def test_apache_all_fields_availability(logformat, expected):
+    possible = possible_paths(logformat)
+    for field_id in expected:
+        assert field_id in possible, (
+            f"Logformat >>>{logformat}<<< should produce {field_id}; "
+            f"instead we found: {possible}"
+        )
+
+
+def test_apache_deprecated_alias_values():
+    # ApacheHttpdAllFieldsTest.checkDeprecationMessage: the deprecated alias
+    # names still deliver values.
+    p = HttpdLoglineParser(MapRecord, "%b %D Deprecated")
+    p.add_parse_target(
+        "set_value",
+        ["BYTES:response.body.bytesclf", "MICROSECONDS:server.process.time"],
+    )
+    r = p.parse("1 2 Deprecated", MapRecord())
+    assert r.results["BYTES:response.body.bytesclf"] == "1"
+    assert r.results["MICROSECONDS:server.process.time"] == "2"
+
+
+# --------------------------------------------------------------------------
+# NGINX variable index sweep (NginxAllFieldsTest.java)
+# --------------------------------------------------------------------------
+
+NGINX_ALL_VARIABLES = [
+    "$arg_name", "$args", "$binary_remote_addr", "$body_bytes_sent",
+    "$bytes_received", "$bytes_sent", "$connection", "$connection_requests",
+    "$content_length", "$content_type", "$cookie_name", "$document_root",
+    "$document_uri", "$host", "$hostname", "$http_somename", "$https",
+    "$is_args", "$limit_rate", "$msec", "$nginx_version", "$pid", "$pipe",
+    "$protocol", "$proxy_protocol_addr", "$proxy_protocol_port",
+    "$query_string", "$realpath_root", "$remote_addr", "$remote_port",
+    "$remote_user", "$request", "$request_body", "$request_body_file",
+    "$request_completion", "$request_filename", "$request_id",
+    "$request_length", "$request_method", "$request_time", "$request_uri",
+    "$scheme", "$sent_http_somename", "$sent_trailer_somename",
+    "$server_addr", "$server_name", "$server_port", "$server_protocol",
+    "$session_time", "$status", "$tcpinfo_rtt", "$tcpinfo_rttvar",
+    "$tcpinfo_snd_cwnd", "$tcpinfo_rcv_space", "$time_iso8601",
+    "$time_local", "$secure_link", "$session_log_id", "$slice_range",
+    "$proxy_add_x_forwarded_for", "$proxy_host", "$proxy_port",
+    "$ssl_cipher", "$ssl_ciphers", "$ssl_client_cert",
+    "$ssl_client_escaped_cert", "$ssl_client_fingerprint",
+    "$ssl_client_i_dn", "$ssl_client_i_dn_legacy", "$ssl_client_raw_cert",
+    "$ssl_client_s_dn", "$ssl_client_s_dn_legacy", "$ssl_client_serial",
+    "$ssl_client_v_end", "$ssl_client_v_remain", "$ssl_client_v_start",
+    "$ssl_client_verify", "$ssl_curves", "$ssl_early_data",
+    "$ssl_preread_alpn_protocols", "$ssl_preread_protocol",
+    "$ssl_preread_server_name", "$ssl_protocol", "$ssl_server_name",
+    "$ssl_session_id", "$ssl_session_reused", "$upstream_addr",
+    "$upstream_bytes_received", "$upstream_bytes_sent",
+    "$upstream_cache_status", "$upstream_connect_time",
+    "$upstream_cookie_name", "$upstream_first_byte_time",
+    "$upstream_header_time", "$upstream_http_somename",
+    "$upstream_queue_time", "$upstream_response_length",
+    "$upstream_response_time", "$upstream_session_time", "$upstream_status",
+    "$upstream_trailer_somename", "$uri", "$uid_got", "$uid_reset",
+    "$uid_set", "$ancient_browser", "$modern_browser", "$msie",
+    "$connections_active", "$connections_reading", "$connections_waiting",
+    "$connections_writing", "$date_gmt", "$date_local",
+    "$fastcgi_path_info", "$fastcgi_script_name", "$geoip_area_code",
+    "$geoip_city", "$geoip_city_continent_code", "$geoip_city_country_code",
+    "$geoip_city_country_code3", "$geoip_city_country_name",
+    "$geoip_country_code", "$geoip_country_code3", "$geoip_country_name",
+    "$geoip_dma_code", "$geoip_latitude", "$geoip_longitude", "$geoip_org",
+    "$geoip_postal_code", "$geoip_region", "$geoip_region_name",
+    "$gzip_ratio", "$spdy", "$spdy_request_priority", "$http2",
+    "$invalid_referer", "$jwt_claim_foobar", "$jwt_header_foobar",
+    "$memcached_key", "$realip_remote_addr", "$realip_remote_port",
+    # kubernetes ingress log-format variables
+    "$the_real_ip", "$proxy_upstream_name", "$req_id", "$namespace",
+    "$ingress_name", "$service_name", "$service_port",
+]
+
+
+@pytest.mark.parametrize("variable", NGINX_ALL_VARIABLES)
+def test_nginx_variable_is_handled(variable):
+    # An unhandled variable falls into the UNKNOWN_NGINX_VARIABLE catch-all
+    # (CoreLogModule.java:481-486); every documented variable must not.
+    paths = possible_paths(f"# {variable} #")
+    for p in paths:
+        assert not p.startswith("UNKNOWN_NGINX_VARIABLE"), (
+            f"variable {variable} fell into the catch-all: {p}"
+        )
+
+
+def test_unknown_nginx_variable_fallback():
+    paths = possible_paths("# $totally_made_up_variable #")
+    assert "UNKNOWN_NGINX_VARIABLE:nginx.unknown.totally_made_up_variable" in paths
+
+    p = HttpdLoglineParser(MapRecord, "# $totally_made_up_variable #")
+    p.add_parse_target(
+        "set_value",
+        ["UNKNOWN_NGINX_VARIABLE:nginx.unknown.totally_made_up_variable"],
+    )
+    r = p.parse("# hello #", MapRecord())
+    assert (
+        r.results["UNKNOWN_NGINX_VARIABLE:nginx.unknown.totally_made_up_variable"]
+        == "hello"
+    )
+
+
+# --------------------------------------------------------------------------
+# Jetty quirk formats (JettyLogFormatParserTest.java)
+# --------------------------------------------------------------------------
+
+JETTY_FIELDS = [
+    "IP:connection.client.host",
+    "NUMBER:connection.client.logname",
+    "STRING:connection.client.user",
+    "TIME.STAMP:request.receive.time",
+    "TIME.DAY:request.receive.time.day",
+    "HTTP.FIRSTLINE:request.firstline",
+    "STRING:request.status.last",
+    "BYTES:response.body.bytes",
+    "HTTP.URI:request.referer",
+    "HTTP.USERAGENT:request.user-agent",
+    "MICROSECONDS:response.server.processing.time",
+]
+
+JETTY_LINES = [
+    # an extra space if the useragent is absent; two extra spaces if the
+    # user field is absent
+    '0.0.0.0 - x [24/Jul/2016:07:08:31 +0000] "GET http://[:1]/foo HTTP/1.1"'
+    ' 400 0 "http://other.site" "-"  8',
+    '0.0.0.0 -  -  [24/Jul/2016:07:08:31 +0000] "GET http://[:1]/foo HTTP/1.1"'
+    ' 400 0 "http://other.site" "-"  8',
+    '0.0.0.0 - x [24/Jul/2016:07:08:31 +0000] "GET http://[:1]/foo HTTP/1.1"'
+    ' 400 0 "http://other.site" "Mozilla/5.0 (dummy)" 8',
+    '0.0.0.0 -  -  [24/Jul/2016:07:08:31 +0000] "GET http://[:1]/foo HTTP/1.1"'
+    ' 400 0 "http://other.site" "Mozilla/5.0 (dummy)" 8',
+]
+
+
+def test_jetty_buggy_loglines():
+    parser = HttpdLoglineParser(
+        MapRecord,
+        "ENABLE JETTY FIX\n"
+        '%h %l %u %t "%r" %>s %b "%{Referer}i" "%{User-Agent}i" %D',
+    )
+    parser.add_parse_target("set_value", JETTY_FIELDS)
+
+    for line in JETTY_LINES:
+        r = parser.parse(line, MapRecord()).results
+        assert r["IP:connection.client.host"] == "0.0.0.0"
+        assert r["NUMBER:connection.client.logname"] is None
+        if r.get("STRING:connection.client.user") is not None:
+            assert r["STRING:connection.client.user"] == "x"
+        assert r["TIME.STAMP:request.receive.time"] == "24/Jul/2016:07:08:31 +0000"
+        assert r["TIME.DAY:request.receive.time.day"] == "24"
+        assert r["HTTP.FIRSTLINE:request.firstline"] == "GET http://[:1]/foo HTTP/1.1"
+        assert r["STRING:request.status.last"] == "400"
+        assert r["BYTES:response.body.bytes"] == "0"
+        assert r["HTTP.URI:request.referer"] == "http://other.site"
+        if r.get("HTTP.USERAGENT:request.user-agent") is not None:
+            assert r["HTTP.USERAGENT:request.user-agent"] == "Mozilla/5.0 (dummy)"
+        assert r["MICROSECONDS:response.server.processing.time"] == "8"
+
+
+# --------------------------------------------------------------------------
+# LogFormat embedded in JSON (JsonLogFormatTest.java)
+# --------------------------------------------------------------------------
+
+JSON_LOGFORMAT = (
+    '{"@timestamp":"%{%Y-%m-%dT%H:%M:%S %z}t",'
+    '"mod_proxy":{"x-forwarded-for":"%{X-Forwarded-For}i"},'
+    '"mod_headers":{"referer":"%{Referer}i","user-agent":"%{User-Agent}i",'
+    '"host":"%{Host}i"},'
+    '"mod_log":{"server_name":"%V","remote_logname":"%l","remote_user":"%u",'
+    '"first_request":"%r","last_request_status":"%>s",'
+    '"response_size_bytes":%B,"duration_usec":%D,"@version":1 }'
+)
+
+JSON_LOGLINE = (
+    '{"@timestamp":"2015-11-25T15:24:45 +0100",'
+    '"mod_proxy":{"x-forwarded-for":"-"},'
+    '"mod_headers":{"referer":"http://localhost/","user-agent":'
+    '"Mozilla/5.0 (X11; Linux x86_64) AppleWebKit/537.36 (KHTML, like Gecko) '
+    'Chrome/46.0.2490.86 Safari/537.36","host":"localhost"},'
+    '"mod_log":{"server_name":"localhost","remote_logname":"-",'
+    '"remote_user":"-","first_request":'
+    '"GET /noindex/css/bootstrap.min.css?a=b HTTP/1.1",'
+    '"last_request_status":"200","response_size_bytes":19341,'
+    '"duration_usec":657,"@version":1 }'
+)
+
+JSON_EXPECT_PRESENT = [
+    ("TIME.LOCALIZEDSTRING:request.receive.time", "2015-11-25T15:24:45 +0100"),
+    ("STRING:connection.server.name", "localhost"),
+    ("HTTP.URI:request.referer", "http://localhost/"),
+    ("HTTP.HEADER:request.header.host", "localhost"),
+    ("HTTP.FIRSTLINE:request.firstline",
+     "GET /noindex/css/bootstrap.min.css?a=b HTTP/1.1"),
+    ("HTTP.METHOD:request.firstline.method", "GET"),
+    ("HTTP.URI:request.firstline.uri", "/noindex/css/bootstrap.min.css?a=b"),
+    ("STRING:request.status.last", "200"),
+    ("BYTES:response.body.bytes", "19341"),
+    ("MICROSECONDS:response.server.processing.time", "657"),
+    ("HTTP.PATH:request.firstline.uri.path", "/noindex/css/bootstrap.min.css"),
+]
+
+
+def test_json_shaped_logformat():
+    parser = HttpdLoglineParser(MapRecord, JSON_LOGFORMAT)
+    fields = [f for f, _ in JSON_EXPECT_PRESENT] + [
+        "NUMBER:connection.client.logname",
+        "STRING:connection.client.user",
+        "HTTP.HEADER:request.header.x-forwarded-for",
+        "HTTP.USERAGENT:request.user-agent",
+        "HTTP.QUERYSTRING:request.firstline.uri.query",
+        "HTTP.PROTOCOL:request.firstline.protocol",
+        "HTTP.PROTOCOL.VERSION:request.firstline.protocol.version",
+    ]
+    parser.add_parse_target("set_value", fields)
+    r = parser.parse(JSON_LOGLINE, MapRecord()).results
+
+    for field_id, value in JSON_EXPECT_PRESENT:
+        assert r.get(field_id) == value, f"{field_id}: {r.get(field_id)!r}"
+    assert r["HTTP.PROTOCOL:request.firstline.protocol"] == "HTTP"
+    assert r["HTTP.PROTOCOL.VERSION:request.firstline.protocol.version"] == "1.1"
+    # '-' decodes to null
+    assert r["NUMBER:connection.client.logname"] is None
+    assert r["STRING:connection.client.user"] is None
+    assert r["HTTP.HEADER:request.header.x-forwarded-for"] is None

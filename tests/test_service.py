@@ -83,3 +83,32 @@ def test_parser_cache_shared_across_sessions(service):
         ) as client:
             client.parse(generate_combined_lines(5, seed=2))
     assert len(cache._parsers) == n_before  # same config -> same compiled parser
+
+
+def test_empty_batch_and_empty_line(service):
+    # count-prefixed LINES framing: [] is a real (empty) batch, not
+    # end-of-session, and an empty logline is a present-but-invalid row.
+    with ParseServiceClient(
+        service.host, service.port, "combined", FIELDS[:1]
+    ) as client:
+        t0 = client.parse([])
+        assert t0.num_rows == 0
+        t1 = client.parse([""])
+        assert t1.num_rows == 1
+        assert t1.column("__valid__").to_pylist() == [False]
+        # the session survives both
+        t2 = client.parse(generate_combined_lines(3, seed=7))
+        assert t2.num_rows == 3
+
+
+def test_embedded_newline_rejected(service):
+    with ParseServiceClient(
+        service.host, service.port, "combined", FIELDS[:1]
+    ) as client:
+        with pytest.raises(ValueError, match="cannot contain"):
+            client.parse(["a\nb"])
+
+
+def test_shutdown_before_start_does_not_hang():
+    svc = ParseService()
+    svc.shutdown()  # must not block on the never-started serve_forever loop
