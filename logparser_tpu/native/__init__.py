@@ -90,6 +90,31 @@ def _default_threads() -> int:
     return min(8, os.cpu_count() or 1)
 
 
+def _bucket(max_len: int, min_bucket: int, cap: int) -> int:
+    """The single bucket-length implementation (tpu.runtime.bucket_length
+    delegates here; this module stays jax-free).  Hybrid scheme balancing
+    padding waste against jit-recompile churn — each distinct L compiles its
+    own executor, so the bucket count must stay small:
+    power of two up to 128, multiples of 128 (the TPU lane width) up to 512,
+    multiples of 256 up to 1024, then powers of two up to cap.
+    That is ~8 shapes total instead of 32 for pure 128-multiples, while the
+    common access-log range (129..512 bytes) still pads to at most 127
+    wasted bytes per line."""
+    if max_len <= min_bucket:
+        return min_bucket
+    if max_len <= 128:
+        return 128 if min_bucket < 128 else min_bucket
+    if max_len <= 512:
+        size = -(-max_len // 128) * 128
+    elif max_len <= 1024:
+        size = -(-max_len // 256) * 256
+    else:
+        size = 2048
+        while size < max_len:
+            size *= 2
+    return min(size, cap)
+
+
 def encode_blob(
     data: bytes,
     line_len: int = 0,
@@ -98,7 +123,7 @@ def encode_blob(
     threads: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray, List[int]]:
     """Newline-delimited bytes -> (buf [B, L] uint8, lengths [B] int32,
-    overflow row indices).  L is the power-of-two bucket of the longest line
+    overflow row indices).  L is the length bucket of the longest line
     (<= cap) unless ``line_len`` pins it."""
     blob = np.frombuffer(data, dtype=np.uint8)
     lib = get_lib()
@@ -111,9 +136,7 @@ def encode_blob(
                 ctypes.byref(max_len))
     n = n_lines.value
     if line_len <= 0:
-        L = min_bucket
-        while L < max_len.value and L < cap:
-            L *= 2
+        L = _bucket(max_len.value, min_bucket, cap)
     else:
         L = line_len
     buf = np.zeros((max(n, 1), L), dtype=np.uint8)
@@ -139,9 +162,7 @@ def _encode_blob_numpy(
     lines = [ln[:-1] if ln.endswith(b"\r") else ln for ln in lines]
     max_len = max((len(r) for r in lines), default=1)
     if line_len <= 0:
-        L = min_bucket
-        while L < max_len and L < cap:
-            L *= 2
+        L = _bucket(max_len, min_bucket, cap)
     else:
         L = line_len
     buf = np.zeros((max(len(lines), 1), L), dtype=np.uint8)

@@ -26,11 +26,13 @@ DEFAULT_MAX_LINE_LEN = 4096
 
 def bucket_length(max_len: int, min_bucket: int = 64,
                   cap: int = DEFAULT_MAX_LINE_LEN) -> int:
-    """Smallest power-of-two bucket >= max_len (>= min_bucket, <= cap)."""
-    size = min_bucket
-    while size < max_len and size < cap:
-        size *= 2
-    return size
+    """Smallest bucket >= max_len (>= min_bucket, <= cap).  Finer buckets
+    than powers of two in the common range (316-byte lines pad to 384, not
+    512 — the [B, L] passes scale with padding) without exploding the number
+    of compiled shapes; see native._bucket, the single implementation."""
+    from ..native import _bucket
+
+    return _bucket(max_len, min_bucket, cap)
 
 
 def encode_batch(
