@@ -120,8 +120,12 @@ def main():
         "p99_batch_latency_ms": round(p99_ms, 2),
         "device_resident_lines_per_sec": round(device_resident, 1),
         "pipelined_end_to_end_lines_per_sec": round(pipelined, 1),
-        "end_to_end_note": "e2e is bottlenecked by this harness's ~25MB/s "
-                           "network tunnel to the chip, not by the framework",
+        # Only claim a transfer bottleneck when the measurements show one
+        # (on a PCIe-attached host the two rates converge).
+        **({"end_to_end_note":
+            "e2e is transfer-bound on this host's device attachment "
+            "(tunnel), not by the framework"}
+           if pipelined < 0.2 * device_resident else {}),
         "batch": BATCH,
         "fields": len(FIELDS),
         "pallas": parser.use_pallas,

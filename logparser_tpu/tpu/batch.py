@@ -9,7 +9,7 @@ int32-limb columns decoded on device and combined to int64 on the host.
 The split program AND all requested post-stages (numeric parse, timestamp ->
 epoch, first-line split) trace into ONE jitted function per parser — a single
 fused XLA computation per (B, L) shape bucket; batch and line length are both
-padded to power-of-two buckets so recompilation is bounded.
+padded to a bounded set of length buckets so recompilation is bounded.
 
 Multi-format parsers run EVERY registered format's split automaton in the
 same fused device computation and pick the per-line winner by registration

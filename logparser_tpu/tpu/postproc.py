@@ -179,6 +179,16 @@ def parse_apache_timestamp(
         & (b[:, 20] == np.uint8(ord(" ")))
         & ((b[:, 21] == np.uint8(ord("+"))) | (b[:, 21] == np.uint8(ord("-"))))
     )
+    # Digit-check every numeric byte explicitly.  day/hour/min/sec garbage is
+    # caught by the range bounds below, but year and tz-offset values are
+    # otherwise unbounded — without this, a non-digit byte yields different
+    # (both "ok") arithmetic under the uint8 jnp path vs the int32 Pallas
+    # path, and the host layout rejects such lines outright.
+    digits_ok = jnp.ones(buf.shape[0], dtype=bool)
+    for i in (0, 1, 7, 8, 9, 10, 12, 13, 15, 16, 18, 19, 22, 23, 24, 25):
+        digits_ok = digits_ok & (
+            (b[:, i] >= np.uint8(ord("0"))) & (b[:, i] <= np.uint8(ord("9")))
+        )
     # Day-in-month with leap years, so the device accepts exactly what the
     # host layout accepts (no silent wrong epochs bypassing the oracle).
     leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
@@ -197,7 +207,7 @@ def parse_apache_timestamp(
 
     days = _days_from_civil(year, month, day)
     sec_of_day = hour * 3600 + minute * 60 + second - offset_s
-    ok = width_ok & letters_ok & seps_ok & fields_ok
+    ok = width_ok & letters_ok & seps_ok & digits_ok & fields_ok
     # Combined on host: epoch_ms = (days * 86400 + sec_of_day) * 1000 (int64).
     return (days, sec_of_day), ok
 

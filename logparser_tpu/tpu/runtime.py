@@ -5,9 +5,9 @@ so the whole program jit-compiles to one fused XLA computation per
 (format, L) pair: no data-dependent Python control flow, static shapes,
 everything batched — the XLA-friendly shape of the problem.
 
-Line length handling: lines are padded into power-of-two length buckets
-(``encode_batch``) so recompilation is bounded and the MXU/VPU tiles stay
-dense.  Overlong lines overflow to the host oracle path.
+Line length handling: lines are padded into a small set of length buckets
+(``encode_batch``; 128-multiples in the common range, coarser above — see
+native._bucket) so recompilation is bounded and the VPU tiles stay dense.  Overlong lines overflow to the host oracle path.
 """
 from __future__ import annotations
 

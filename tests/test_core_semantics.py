@@ -1,0 +1,211 @@
+"""Core-engine semantics ported from the reference's parser-core suite:
+duplicate outputs (ParserDuplicateOutputTest), per-type routing of colliding
+paths (ParserTypeColissionTest), dissector management after start
+(ParserExceptionsTest testChangeAfterStart/testDropDissector*), field-id
+cleanup and null/empty output handling (TestBadAPIUsage)."""
+import pytest
+
+from logparser_tpu.core import Parser
+from logparser_tpu.core.casts import STRING_ONLY
+from logparser_tpu.core.dissector import Dissector, SimpleDissector
+from logparser_tpu.core.exceptions import MissingDissectorsException
+from logparser_tpu.core.fields import ParsedField
+
+
+class ListRecord:
+    """Collects every delivered value (duplicates preserved)."""
+
+    def __init__(self):
+        self.values = []
+
+    def add(self, name, value):
+        self.values.append((name, value))
+
+
+class _Emit(SimpleDissector):
+    """Emits a fixed value for STRING:output (ParserDuplicateOutputTest
+    Foo/BarDissector).  NOTE: the engine instantiates one phase per dissector
+    CLASS per node (reference Parser.java findDissectorInstance), so — as in
+    the reference suite — each registered dissector is its own class."""
+
+    value = ""
+
+    def __init__(self):
+        super().__init__("INPUT", {"STRING:output": STRING_ONLY})
+
+    def dissect_field(self, parsable, input_name, pf: ParsedField) -> None:
+        parsable.add_dissection(input_name, "STRING", "output", self.value)
+
+
+class FooDissector(_Emit):
+    value = "foo"
+
+
+class BarDissector(_Emit):
+    value = "bar"
+
+
+def test_duplicate_outputs_both_delivered():
+    # Two dissectors with the SAME input/output: you get BOTH values.
+    parser = Parser(ListRecord)
+    parser.add_dissector(FooDissector())
+    parser.add_dissector(BarDissector())
+    parser.set_root_type("INPUT")
+    parser.add_parse_target("add", ["STRING:output"])
+    record = parser.parse("SomeThing", ListRecord())
+    delivered = sorted(v for _, v in record.values)
+    assert delivered == ["bar", "foo"]
+
+
+class _Salt(Dissector):
+    """Appends a salt to its input and emits it under (output_type, name) —
+    the ParserTypeColissionTest TestDissector.  One subclass per registered
+    dissector, as in the reference (TestDissectorOne/Two/Sub*)."""
+
+    input_type = "INPUTTYPE"
+    output_type = ""
+    output_name = "output"
+    salt = ""
+
+    def get_input_type(self):
+        return self.input_type
+
+    def get_possible_output(self):
+        return [f"{self.output_type}:{self.output_name}"]
+
+    def prepare_for_dissect(self, input_name, output_name):
+        return STRING_ONLY
+
+    def get_new_instance(self):
+        return type(self)()
+
+    def dissect(self, parsable, input_name):
+        pf = parsable.get_parsable_field(self.input_type, input_name)
+        parsable.add_dissection(
+            input_name, self.output_type, self.output_name,
+            pf.value.get_string() + self.salt,
+        )
+
+
+class SaltOne(_Salt):
+    output_type, salt = "SOMETYPE", "+1"
+
+
+class SaltTwo(_Salt):
+    output_type, salt = "OTHERTYPE", "+2"
+
+
+class SaltSubOne(_Salt):
+    input_type, output_type, salt = "SOMETYPE", "SOMESUBTYPE", "+S1"
+
+
+class SaltSubTwo(_Salt):
+    input_type, output_type, salt = "OTHERTYPE", "OTHERSUBTYPE", "+S2"
+
+
+class SaltSubSubOne(_Salt):
+    input_type, output_type, salt = "SOMESUBTYPE", "SOMESUBSUBTYPE", "+SS1"
+
+
+class SaltSubSubTwo(_Salt):
+    input_type, output_type, salt = "OTHERSUBTYPE", "OTHERSUBSUBTYPE", "+SS2"
+
+
+def make_collision_parser():
+    # Same path "output" at every level, distinguished ONLY by type:
+    #   INPUTTYPE -> SOMETYPE:output (+1)  -> SOMESUBTYPE:output.output (+S1)
+    #             -> OTHERTYPE:output (+2) -> OTHERSUBTYPE:output.output (+S2)
+    # and one more level below each.
+    parser = Parser(ListRecord)
+    for cls in (SaltOne, SaltTwo, SaltSubOne, SaltSubTwo,
+                SaltSubSubOne, SaltSubSubTwo):
+        parser.add_dissector(cls())
+    parser.set_root_type("INPUTTYPE")
+    return parser
+
+
+def test_type_collision_routes_by_type():
+    parser = make_collision_parser()
+    parser.add_parse_target("add", [
+        "SOMETYPE:output",
+        "OTHERTYPE:output",
+        "SOMESUBTYPE:output.output",
+        "OTHERSUBTYPE:output.output",
+        "SOMESUBSUBTYPE:output.output.output",
+        "OTHERSUBSUBTYPE:output.output.output",
+    ])
+    record = parser.parse("Something", ListRecord())
+    got = dict(record.values)
+    assert got["SOMETYPE:output"] == "Something+1"
+    assert got["OTHERTYPE:output"] == "Something+2"
+    assert got["SOMESUBTYPE:output.output"] == "Something+1+S1"
+    assert got["OTHERSUBTYPE:output.output"] == "Something+2+S2"
+    assert got["SOMESUBSUBTYPE:output.output.output"] == "Something+1+S1+SS1"
+    assert got["OTHERSUBSUBTYPE:output.output.output"] == "Something+2+S2+SS2"
+    assert len(record.values) == 6
+
+
+def test_drop_dissector_then_missing():
+    # ParserExceptionsTest.testDropDissector1: dropping a needed dissector
+    # makes the requested field unreachable.
+    parser = make_collision_parser()
+    parser.add_parse_target("add", ["SOMETYPE:output"])
+    parser.drop_dissector(SaltOne)
+    with pytest.raises(MissingDissectorsException):
+        parser.parse("Something", ListRecord())
+
+
+def test_drop_then_readd_dissector():
+    # testDropDissector2: drop + re-add, discovery still works.
+    parser = make_collision_parser()
+    parser.drop_dissector(SaltOne)
+    parser.add_dissector(SaltOne())
+    assert "SOMETYPE:output" in parser.get_possible_paths()
+
+
+def test_change_after_start_allowed():
+    # testChangeAfterStart / testDropDissector3: mutating the dissector set
+    # after the first parse is allowed (the tree is reassembled lazily).
+    parser = make_collision_parser()
+    parser.add_parse_target("add", ["SOMETYPE:output"])
+    parser.parse("Something", ListRecord())
+    parser.add_dissector(FooDissector())        # no exception
+    parser.drop_dissector(FooDissector)         # no exception
+    record = parser.parse("Else", ListRecord())
+    assert ("SOMETYPE:output", "Else+1") in record.values
+
+
+def test_field_id_cleanup():
+    # TestBadAPIUsage.testFieldCleanup: TYPE uppercased, path lowercased,
+    # whitespace trimmed (Parser.java:681-691).
+    parser = Parser(ListRecord)
+    parser.add_dissector(FooDissector())
+    parser.set_root_type("INPUT")
+    parser.add_parse_target("add", ["  string : OUTPUT  ".replace(" ", "")])
+    record = parser.parse("x", ListRecord())
+    assert record.values == [("STRING:output", "foo")]
+
+
+class _EmitNullAndEmpty(SimpleDissector):
+    def __init__(self):
+        super().__init__("INPUT", {
+            "STRING:null": STRING_ONLY,
+            "STRING:empty": STRING_ONLY,
+        })
+
+    def dissect_field(self, parsable, input_name, pf):
+        parsable.add_dissection(input_name, "STRING", "null", None)
+        parsable.add_dissection(input_name, "STRING", "empty", "")
+
+
+def test_null_and_empty_outputs_delivered():
+    # TestBadAPIUsage.testNullOutputHandling/testEmptyOutputHandling: with
+    # the default ALWAYS policy both arrive.
+    parser = Parser(ListRecord)
+    parser.add_dissector(_EmitNullAndEmpty())
+    parser.set_root_type("INPUT")
+    parser.add_parse_target("add", ["STRING:null", "STRING:empty"])
+    record = parser.parse("x", ListRecord())
+    got = dict(record.values)
+    assert got["STRING:null"] is None
+    assert got["STRING:empty"] == ""

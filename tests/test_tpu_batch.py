@@ -316,3 +316,33 @@ class TestMultiFormat:
         assert res.valid[0]
         assert res.to_pylist("HTTP.HEADER:request.header.a")[0] == 'x" y'
         assert res.to_pylist("IP:connection.client.host")[0] == "1.2.3.4"
+
+
+class TestTimestampGarbageParity:
+    def test_nondigit_tz_rejected_identically_on_both_paths(self):
+        """A timestamp whose tz-offset contains a non-digit ('+/000') must be
+        rejected by the device program (routed to the oracle, which rejects
+        it too) under BOTH executors.  Under uint8 the '/' wraps positive and
+        under int32 it goes negative — without the explicit digit checks the
+        two paths would disagree while both claiming ok."""
+        line = (
+            '1.2.3.4 - - [01/Jan/2024:00:00:00 +/000] '
+            '"GET /x HTTP/1.1" 200 5 "-" "ua"'
+        )
+        good = (
+            '1.2.3.4 - - [01/Jan/2024:00:00:00 +0000] '
+            '"GET /x HTTP/1.1" 200 5 "-" "ua"'
+        )
+        fields = ["TIME.EPOCH:request.receive.time.epoch"]
+        results = []
+        for use_pallas in (False, True):
+            parser = TpuBatchParser("combined", fields, use_pallas=use_pallas)
+            res = parser.parse_batch([line, good])
+            results.append(
+                (list(res.valid), res.to_pylist(fields[0]))
+            )
+        assert results[0] == results[1]
+        valid, epochs = results[0]
+        assert not valid[0]            # garbage tz -> invalid line
+        assert valid[1]
+        assert epochs[1] == 1704067200000
