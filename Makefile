@@ -1,0 +1,21 @@
+# Dev entry points (the reference's Maven/devtools tier, L0).
+PY ?= python
+
+.PHONY: test test-fast bench native clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -x
+
+bench:
+	$(PY) bench.py
+
+# Build the C++ host tier (ctypes library); falls back to numpy when absent.
+native:
+	$(PY) -c "from logparser_tpu.native import native_available; print('native:', native_available())"
+
+clean:
+	rm -rf logparser_tpu/native/_build build dist *.egg-info
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -14,10 +14,11 @@ Three numbers are measured, pessimistic to optimistic:
   the chip is attached through a network tunnel whose ~25 MB/s H2D path is
   the bottleneck; a production host feeds the chip over PCIe at GB/s, so
   this number measures the harness, not the framework.
-- device-resident (the headline `value`): sustained kernel rate with input
-  already in HBM — the chip's parsing speed, i.e. loglines/sec/chip, what
-  multi-chip scaling multiplies and what the north-star target is stated
-  in.
+- device-resident (the headline `value`): marginal kernel rate with input
+  already in HBM, measured with the iteration loop inside jit so the
+  per-dispatch overhead of the device attachment is excluded — the chip's
+  parsing speed, i.e. loglines/sec/chip, what multi-chip scaling multiplies
+  and what the north-star target is stated in.
 
 NOTE on timing: jax.block_until_ready does not reliably wait on tunneled
 device attachments, so every measurement synchronizes via an explicit
@@ -94,15 +95,52 @@ def main():
         np.asarray(jax.device_get(out))
     pipelined = BATCH * ITERS / (time.perf_counter() - t0)
 
-    # 3) Device-resident kernel rate (input already in HBM).  Iterations are
-    #    queued back-to-back (XLA executes in order) and synced ONCE, so the
-    #    tunnel round-trip latency is paid once, not per iteration.
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(ITERS):
-        out = fn(jbuf, jlengths)
-    sync(out)
-    device_resident = BATCH * ITERS / (time.perf_counter() - t0)
+    # 3) Device-resident kernel rate (input already in HBM): marginal time
+    #    per batch with the iteration loop INSIDE jit, so per-dispatch
+    #    overhead (which on a tunneled attachment is ~15-60 ms, dwarfing the
+    #    ~1 ms kernel) is excluded.  A feedback dependency (one pad byte of
+    #    the next iteration's input depends on the previous result) defeats
+    #    loop-invariant hoisting, so every iteration really runs.
+    from functools import partial
+
+    import jax.numpy as jnp
+    from logparser_tpu.tpu import pipeline
+
+    units = parser.units
+    if parser.use_pallas:
+        # Measure the SAME executor the parser uses.
+        inner = pipeline.build_units_pallas_fn(units, BATCH, buf.shape[1])
+    else:
+        def inner(b, lengths):
+            return jnp.stack(pipeline.compute_units_rows(units, b, lengths))
+
+    @partial(jax.jit, static_argnums=2)
+    def loop_fn(buf, lengths, n):
+        def body(i, carry):
+            acc, b = carry
+            b = b.at[0, -1].set((acc & 0x7F).astype(jnp.uint8))
+            rows = inner(b, lengths)
+            return acc + rows[0, 0] + rows[-1, -1], b
+        acc, _ = jax.lax.fori_loop(0, n, body, (jnp.int32(0), buf))
+        return acc
+
+    def time_loop(n):
+        np.asarray(loop_fn(jbuf, jlengths, n))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(loop_fn(jbuf, jlengths, n))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    marginal_s = (time_loop(64) - time_loop(16)) / 48
+    if marginal_s <= 0:
+        marginal_s = (time_loop(64) - time_loop(16)) / 48  # one retry
+    if marginal_s <= 0:
+        # Noise swamped the marginal; report the conservative in-loop
+        # average rather than an absurd extrapolation.
+        marginal_s = time_loop(64) / 64
+    device_resident = BATCH / marginal_s
 
     # Host oracle baseline (per-line engine) on a sample.
     oracle = parser.oracle

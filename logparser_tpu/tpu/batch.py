@@ -62,13 +62,16 @@ _FieldPlan = FieldPlan
 
 
 def _default_use_pallas() -> bool:
+    """Default to the plain-XLA executor everywhere.  Measured on v5e
+    (L=384, combined, in-jit marginal rate so dispatch overhead is excluded):
+    XLA's own fusion of the masked-reduction pipeline runs ~6x faster than
+    the hand-written Pallas kernel (60M vs 10M lines/s/chip) — the workload
+    is exactly the elementwise+reduce shape XLA fuses best.  The kernel
+    remains available via LOGPARSER_TPU_PALLAS=1 or use_pallas=True."""
     env = os.environ.get("LOGPARSER_TPU_PALLAS")
     if env is not None:
         return env.strip().lower() not in ("0", "false", "no")
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:  # pragma: no cover
-        return False
+    return False
 
 
 class _CollectingRecord:

@@ -477,23 +477,35 @@ def assign_row_offsets(units: Sequence[FormatUnit]) -> int:
     return off
 
 
+def compute_units_rows(
+    units: Sequence[FormatUnit],
+    buf: jnp.ndarray,
+    lengths: jnp.ndarray,
+    shift_fn=shift_zero,
+) -> List[jnp.ndarray]:
+    """All formats' packed rows for one batch — the single executor body
+    shared by the jnp path, the Pallas kernel, and bench.py.
+
+    Keeps the byte buffer uint8 end-to-end: the [B, L] passes are HBM-bound
+    and every compare works on uint8 directly — an int32 up-cast would 4x
+    the traffic.  (Validity math stays correct under uint8 wraparound:
+    wrapped "negatives" land >= 230 and fail the <= 9 / < 26 digit and
+    letter range checks.)"""
+    rows: List[jnp.ndarray] = []
+    for i, u in enumerate(units):
+        rows.extend(compute_rows(
+            u.program, u.plans, u.layout, buf, lengths, shift_fn,
+            need_plausible=i < len(units) - 1,
+        ))
+    return rows
+
+
 def build_units_jnp_fn(units: Sequence[FormatUnit]):
     """Plain-XLA executor over all formats:
     (buf [B,L] uint8, lengths [B]) -> [sum K_i, B] int32."""
 
     def fn(buf: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
-        # Keep the byte buffer uint8 end-to-end: the [B, L] passes are
-        # HBM-bound and every compare works on uint8 directly — an int32
-        # up-cast would 4x the traffic.  (Validity math stays correct under
-        # uint8 wraparound: wrapped "negatives" land >= 230 and fail the
-        # <= 9 / < 26 digit and letter range checks.)
-        rows: List[jnp.ndarray] = []
-        for i, u in enumerate(units):
-            rows.extend(compute_rows(
-                u.program, u.plans, u.layout, buf, lengths, shift_zero,
-                need_plausible=i < len(units) - 1,
-            ))
-        return jnp.stack(rows)
+        return jnp.stack(compute_units_rows(units, buf, lengths))
 
     return jax.jit(fn)
 
@@ -527,14 +539,9 @@ def build_units_pallas_fn(units: Sequence[FormatUnit], B: int, L: int,
     def kernel(buf_ref, len_ref, out_ref):
         b32 = buf_ref[...].astype(jnp.int32)
         lengths = len_ref[...][:, 0]
-        off = 0
-        for ui, u in enumerate(units):
-            rows = compute_rows(u.program, u.plans, u.layout, b32, lengths,
-                                shift_wrap,
-                                need_plausible=ui < len(units) - 1)
-            for i, row in enumerate(rows):
-                out_ref[off + i, :] = row
-            off += len(rows)
+        rows = compute_units_rows(units, b32, lengths, shift_wrap)
+        for i, row in enumerate(rows):
+            out_ref[i, :] = row
 
     grid = (B // BB,)
     call = pl.pallas_call(

@@ -176,12 +176,12 @@ def test_change_after_start_allowed():
 
 
 def test_field_id_cleanup():
-    # TestBadAPIUsage.testFieldCleanup: TYPE uppercased, path lowercased,
-    # whitespace trimmed (Parser.java:681-691).
+    # TestBadAPIUsage.testFieldCleanup: TYPE uppercased, path lowercased
+    # (Parser.java:681-691 — case normalization only, no trimming).
     parser = Parser(ListRecord)
     parser.add_dissector(FooDissector())
     parser.set_root_type("INPUT")
-    parser.add_parse_target("add", ["  string : OUTPUT  ".replace(" ", "")])
+    parser.add_parse_target("add", ["string:OUTPUT"])
     record = parser.parse("x", ListRecord())
     assert record.values == [("STRING:output", "foo")]
 
