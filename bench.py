@@ -120,7 +120,10 @@ def main():
             acc, b = carry
             b = b.at[0, -1].set((acc & 0x7F).astype(jnp.uint8))
             rows = inner(b, lengths)
-            return acc + rows[0, 0] + rows[-1, -1], b
+            # Consume EVERY row: keeping only a couple of elements alive
+            # would let XLA dead-code-eliminate the untouched per-field
+            # extraction rows and inflate the measured rate.
+            return acc + jnp.sum(rows), b
         acc, _ = jax.lax.fori_loop(0, n, body, (jnp.int32(0), buf))
         return acc
 

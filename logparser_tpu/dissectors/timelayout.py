@@ -292,16 +292,18 @@ class TimeLayout:
         hour = fields.get("hour")
         if hour is None and "clock_hour" in fields:
             ch = fields["clock_hour"]
-            if ch == 24:
+            if ch in (0, 24):
+                # Java's SMART resolver special-cases BOTH 0 and 24 for
+                # CLOCK_HOUR_OF_DAY as midnight (jdk Parsed.resolveTimeLenient
+                # accepts 0 explicitly in SMART mode) — so `%H` parsing of
+                # "00:xx:xx" succeeds in the reference.
                 hour = 0
-            elif ch == 0:
-                # Java CLOCK_HOUR_OF_DAY range is 1-24 (SMART resolver maps
-                # only 24 -> 0); 0 is invalid.
-                raise TimestampParseError(
-                    f"Invalid value for ClockHourOfDay: 0 in '{original}'"
-                )
-            else:
+            elif 1 <= ch <= 23:
                 hour = ch
+            else:
+                raise TimestampParseError(
+                    f"Invalid value for ClockHourOfDay: {ch} in '{original}'"
+                )
         if hour is None and "hour12" in fields:
             h12 = fields["hour12"]
             ampm = fields.get("ampm", 0)

@@ -64,8 +64,8 @@ _FieldPlan = FieldPlan
 def _default_use_pallas() -> bool:
     """Default to the plain-XLA executor everywhere.  Measured on v5e
     (L=384, combined, in-jit marginal rate so dispatch overhead is excluded):
-    XLA's own fusion of the masked-reduction pipeline runs ~6x faster than
-    the hand-written Pallas kernel (60M vs 10M lines/s/chip) — the workload
+    XLA's own fusion of the masked-reduction pipeline runs ~4.5x faster than
+    the hand-written Pallas kernel (~45M vs ~10M lines/s/chip) — the workload
     is exactly the elementwise+reduce shape XLA fuses best.  The kernel
     remains available via LOGPARSER_TPU_PALLAS=1 or use_pallas=True."""
     env = os.environ.get("LOGPARSER_TPU_PALLAS")

@@ -484,13 +484,12 @@ def compute_units_rows(
     shift_fn=shift_zero,
 ) -> List[jnp.ndarray]:
     """All formats' packed rows for one batch — the single executor body
-    shared by the jnp path, the Pallas kernel, and bench.py.
-
-    Keeps the byte buffer uint8 end-to-end: the [B, L] passes are HBM-bound
-    and every compare works on uint8 directly — an int32 up-cast would 4x
-    the traffic.  (Validity math stays correct under uint8 wraparound:
-    wrapped "negatives" land >= 230 and fail the <= 9 / < 26 digit and
-    letter range checks.)"""
+    shared by the jnp path (uint8 buf), the Pallas kernel (int32 buf +
+    shift_wrap), and bench.py.  Every compare and range check is correct
+    under BOTH dtypes: uint8 wraparound "negatives" land >= 230 and int32
+    gives true negatives, and each fails the <= 9 / < 26 digit and letter
+    range checks identically (the timestamp parser digit-checks every
+    numeric byte explicitly for exactly this reason)."""
     rows: List[jnp.ndarray] = []
     for i, u in enumerate(units):
         rows.extend(compute_rows(
@@ -505,6 +504,9 @@ def build_units_jnp_fn(units: Sequence[FormatUnit]):
     (buf [B,L] uint8, lengths [B]) -> [sum K_i, B] int32."""
 
     def fn(buf: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+        # buf stays uint8 end-to-end here: the [B, L] passes are HBM-bound
+        # and every compare works on uint8 directly — an int32 up-cast
+        # would 4x the traffic.
         return jnp.stack(compute_units_rows(units, buf, lengths))
 
     return jax.jit(fn)

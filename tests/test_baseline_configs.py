@@ -1,0 +1,161 @@
+"""Bit-exactness sweep across all five BASELINE.md benchmark configs
+(SURVEY §7.8): every field of every line produced by the batch/TPU path must
+equal the per-line host oracle, for
+
+  1. Apache ``combined``
+  2. Apache ``combinedio`` with a custom ``%{strftime}t`` timestamp
+  3. NGINX log_format with request-line/URI sub-dissectors
+  4. ``combined`` + GeoIP2 City/ASN dissector chain
+  5. a mixed Apache+NGINX multi-format stream
+
+Runs on the CPU mesh (conftest); the same code path executes on TPU.
+"""
+import os
+
+import pytest
+
+from logparser_tpu.httpd import HttpdLoglineParser
+from logparser_tpu.tools.demolog import generate_combined_lines
+from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+TEST_DATA = "/root/reference/GeoIP2-TestData/test-data"
+CITY_MMDB = os.path.join(TEST_DATA, "GeoIP2-City-Test.mmdb")
+ASN_MMDB = os.path.join(TEST_DATA, "GeoLite2-ASN-Test.mmdb")
+
+N = 256
+
+
+def assert_batch_matches_oracle(parser: TpuBatchParser, lines, fields):
+    # BatchResult accessors, not Arrow: pyarrow is an optional extra and
+    # this suite must run on a minimal install.
+    result = parser.parse_batch(lines)
+    valid = list(result.valid)
+    columns = {f: result.to_pylist(f) for f in fields}
+
+    oracle = parser.oracle
+    n_valid = 0
+    for i, line in enumerate(lines):
+        try:
+            rec = oracle.parse(line, _CollectingRecord())
+            expected = rec.values
+            ok = True
+        except Exception:
+            expected, ok = {}, False
+        assert valid[i] == ok, f"line {i}: valid={valid[i]} oracle_ok={ok}"
+        if not ok:
+            continue
+        n_valid += 1
+        for f in fields:
+            got = columns[f][i]
+            want = expected.get(f)
+            if isinstance(got, int) and want is not None:
+                want = int(want)
+            assert got == want, f"line {i} field {f}: {got!r} != {want!r}"
+    assert n_valid > N // 2  # the corpus must actually exercise the fields
+
+
+class TestBaselineConfigs:
+    def test_config1_combined(self):
+        fields = [
+            "IP:connection.client.host",
+            "TIME.EPOCH:request.receive.time.epoch",
+            "HTTP.METHOD:request.firstline.method",
+            "HTTP.PATH:request.firstline.uri.path",
+            "STRING:request.status.last",
+            "BYTES:response.body.bytes",
+            "HTTP.USERAGENT:request.user-agent",
+        ]
+        p = TpuBatchParser("combined", fields)
+        assert_batch_matches_oracle(
+            p, generate_combined_lines(N, seed=11, garbage_fraction=0.05),
+            fields,
+        )
+
+    def test_config2_combinedio_strftime(self):
+        # combinedio with the timestamp spelled as an explicit strftime
+        # pattern — exercises the StrfTimeStampDissector path end to end.
+        log_format = (
+            '%h %l %u [%{%d/%b/%Y:%H:%M:%S %z}t] "%r" %>s %b '
+            '"%{Referer}i" "%{User-Agent}i" %I %O'
+        )
+        fields = [
+            "IP:connection.client.host",
+            "TIME.EPOCH:request.receive.time.epoch",
+            "TIME.YEAR:request.receive.time.year",
+            "STRING:request.status.last",
+            "BYTES:request.bytes",
+            "BYTES:response.bytes",
+        ]
+        base = generate_combined_lines(N, seed=12)
+        lines = [f"{ln} {100 + i} {5000 + i}" for i, ln in enumerate(base)]
+        p = TpuBatchParser(log_format, fields)
+        assert_batch_matches_oracle(p, lines, fields)
+
+    def test_config3_nginx(self):
+        log_format = (
+            '$remote_addr - $remote_user [$time_local] "$request" $status '
+            '$body_bytes_sent "$http_referer" "$http_user_agent"'
+        )
+        fields = [
+            "IP:connection.client.host",
+            "TIME.STAMP:request.receive.time",
+            "HTTP.METHOD:request.firstline.method",
+            "HTTP.PATH:request.firstline.uri.path",
+            "HTTP.QUERYSTRING:request.firstline.uri.query",
+            "STRING:request.status.last",
+            "BYTES:response.body.bytes",
+        ]
+        p = TpuBatchParser(log_format, fields)
+        assert_batch_matches_oracle(
+            p, generate_combined_lines(N, seed=13), fields
+        )
+
+    @pytest.mark.skipif(
+        not os.path.exists(CITY_MMDB), reason="GeoIP2 test data unavailable"
+    )
+    def test_config4_geoip_chain(self):
+        from logparser_tpu.geoip import GeoIPASNDissector, GeoIPCityDissector
+
+        fields = [
+            "IP:connection.client.host",
+            "STRING:connection.client.host.country.name",
+            "STRING:connection.client.host.city.name",
+            "ASN:connection.client.host.asn.number",
+            "STRING:request.status.last",
+        ]
+        # Mix IPs known to the test databases with random ones.
+        lines = generate_combined_lines(N, seed=14)
+        known = ["81.2.69.142", "2.125.160.216", "89.160.20.112", "1.128.0.0"]
+        lines = [
+            ln if i % 3 else known[i % len(known)] + ln[ln.index(" "):]
+            for i, ln in enumerate(lines)
+        ]
+        p = TpuBatchParser(
+            "combined", fields,
+            extra_dissectors=[
+                GeoIPCityDissector(CITY_MMDB), GeoIPASNDissector(ASN_MMDB),
+            ],
+        )
+        assert_batch_matches_oracle(p, lines, fields)
+
+    def test_config5_multiformat_mixed(self):
+        fmt_a = "combined"
+        fmt_b = "%h %l %u %t \"%r\" %>s %b"   # common
+        fields = [
+            "IP:connection.client.host",
+            "STRING:request.status.last",
+            "BYTES:response.body.bytes",
+            "HTTP.METHOD:request.firstline.method",
+        ]
+        combined = generate_combined_lines(N // 2, seed=15)
+
+        def to_common(ln):
+            # combined = common + ' "ref" "ua"' — cut the two quoted tails
+            cut = ln.rindex(' "', 0, ln.rindex(' "'))
+            return ln[:cut]
+
+        common = [to_common(ln) for ln in generate_combined_lines(N // 2, seed=16)]
+        lines = [v for pair in zip(combined, common) for v in pair]
+        p = TpuBatchParser(fmt_a + "\n" + fmt_b, fields)
+        assert len(p.units) == 2
+        assert_batch_matches_oracle(p, lines, fields)
