@@ -509,3 +509,180 @@ def test_json_shaped_logformat():
     assert r["NUMBER:connection.client.logname"] is None
     assert r["STRING:connection.client.user"] is None
     assert r["HTTP.HEADER:request.header.x-forwarded-for"] is None
+
+
+# --------------------------------------------------------------------------
+# Edge cases (EdgeCasesTest.java)
+# --------------------------------------------------------------------------
+
+
+def test_invalid_firstline_edge_case():
+    # A TLS handshake ("\x16\x03\x01") logged as the request line: the line
+    # still parses; the firstline itself is delivered raw and its
+    # method/uri/protocol sub-fields are simply absent.
+    log_format = (
+        '%a %{Host}i %u %t "%r" %>s %O "%{Referer}i" "%{User-Agent}i" '
+        "%{Content-length}i %P %A"
+    )
+    line = (
+        '1.2.3.4 - - [03/Apr/2017:03:27:28 -0600] "\\x16\\x03\\x01" 404 419 '
+        '"-" "-" - 115052 5.6.7.8'
+    )
+    parser = HttpdLoglineParser(MapRecord, log_format)
+    fields = [
+        "IP:connection.client.ip",
+        "IP:connection.server.ip",
+        "TIME.EPOCH:request.receive.time.last.epoch",
+        "STRING:connection.client.user",
+        "TIME.STAMP:request.receive.time.last",
+        "TIME.DATE:request.receive.time.last.date",
+        "TIME.TIME:request.receive.time.last.time",
+        "NUMBER:connection.server.child.processid",
+        "BYTES:response.bytes",
+        "STRING:request.status.last",
+        "HTTP.USERAGENT:request.user-agent",
+        "HTTP.HEADER:request.header.host",
+        "HTTP.HEADER:request.header.content-length",
+        "HTTP.URI:request.referer",
+        "HTTP.FIRSTLINE:request.firstline",
+        "HTTP.METHOD:request.firstline.method",
+        "HTTP.URI:request.firstline.uri",
+        "HTTP.PROTOCOL:request.firstline.protocol",
+    ]
+    parser.add_parse_target("set_value", fields)
+    r = parser.parse(line, MapRecord()).results
+
+    assert r["IP:connection.client.ip"] == "1.2.3.4"
+    assert r["IP:connection.server.ip"] == "5.6.7.8"
+    assert r["TIME.EPOCH:request.receive.time.last.epoch"] == "1491211648000"
+    assert r["STRING:connection.client.user"] is None       # present AND null
+    assert r["TIME.STAMP:request.receive.time.last"] == "03/Apr/2017:03:27:28 -0600"
+    assert r["TIME.DATE:request.receive.time.last.date"] == "2017-04-03"
+    assert r["TIME.TIME:request.receive.time.last.time"] == "03:27:28"
+    assert r["NUMBER:connection.server.child.processid"] == "115052"
+    assert r["BYTES:response.bytes"] == "419"
+    assert r["STRING:request.status.last"] == "404"
+    assert r["HTTP.USERAGENT:request.user-agent"] is None
+    assert r["HTTP.HEADER:request.header.host"] is None
+    assert r["HTTP.HEADER:request.header.content-length"] is None
+    assert r["HTTP.URI:request.referer"] is None
+    assert r["HTTP.FIRSTLINE:request.firstline"] == "\\x16\\x03\\x01"
+    # unparsable firstline -> sub-fields absent entirely
+    assert "HTTP.METHOD:request.firstline.method" not in r
+    assert "HTTP.URI:request.firstline.uri" not in r
+    assert "HTTP.PROTOCOL:request.firstline.protocol" not in r
+
+
+def test_mixed_format_registration_no_error():
+    # EdgeCasesTest.checkErrorLogging: registering Apache + NGINX formats,
+    # duplicates, and an undeterminable format must not raise.
+    from logparser_tpu.httpd.format_dissector import HttpdLogFormatDissector
+
+    d = HttpdLogFormatDissector()
+    d.add_log_format("%t")
+    d.add_multiple_log_formats("%a\n%b\n%c")
+    d.add_log_format("%b")                   # duplicate
+    d.add_log_format("$remote_addr")
+    d.add_multiple_log_formats("$time_local\n$body_bytes_sent\n$status")
+    d.add_log_format("$body_bytes_sent")     # duplicate
+    d.add_log_format("blup")                 # undeterminable -> logged only
+
+
+# --------------------------------------------------------------------------
+# Multi-line (= multi-format) parser (MultiLineHttpdLogParserTest.java)
+# --------------------------------------------------------------------------
+
+ML_FIELDS = [
+    "IP:connection.client.host",
+    "TIME.STAMP:request.receive.time",
+    "TIME.SECOND:request.receive.time.second",
+    "STRING:request.status.last",
+    "BYTESCLF:response.body.bytes",
+    "HTTP.URI:request.firstline.uri",
+    "HTTP.URI:request.referer",
+    "HTTP.USERAGENT:request.user-agent",
+]
+
+ML_FORMAT_1 = '%h %t "%r" %>s %b "%{Referer}i"'
+ML_LINE_1 = (
+    '127.0.0.1 [31/Dec/2012:23:49:41 +0100] "GET /foo HTTP/1.1" 200 '
+    '1213 "http://localhost/index.php?mies=wim"'
+)
+ML_FORMAT_2 = '%h %t "%r" %>s "%{User-Agent}i"'
+ML_LINE_2 = (
+    '127.0.0.2 [31/Dec/2012:23:49:42 +0100] "GET /foo HTTP/1.1" 404 '
+    '"Mozilla/5.0 (X11; Linux i686 on x86_64; rv:11.0) Gecko/20100101 '
+    'Firefox/11.0"'
+)
+
+
+def test_multi_line_logformat_alternating():
+    # One parser, two formats (blank lines in the format block are ignored);
+    # lines of either format parse correctly in any order, repeatedly.
+    parser = HttpdLoglineParser(
+        MapRecord, ML_FORMAT_1 + "\n\n" + ML_FORMAT_2 + "\n\n"
+    )
+    parser.add_parse_target("set_value", ML_FIELDS)
+
+    def check1():
+        r = parser.parse(ML_LINE_1, MapRecord()).results
+        assert r["IP:connection.client.host"] == "127.0.0.1"
+        assert r["TIME.STAMP:request.receive.time"] == "31/Dec/2012:23:49:41 +0100"
+        assert r["HTTP.URI:request.firstline.uri"] == "/foo"
+        assert r["STRING:request.status.last"] == "200"
+        assert r["BYTESCLF:response.body.bytes"] == "1213"
+        assert r["HTTP.URI:request.referer"] == "http://localhost/index.php?mies=wim"
+        assert r.get("HTTP.USERAGENT:request.user-agent") is None
+
+    def check2():
+        r = parser.parse(ML_LINE_2, MapRecord()).results
+        assert r["IP:connection.client.host"] == "127.0.0.2"
+        assert r["TIME.STAMP:request.receive.time"] == "31/Dec/2012:23:49:42 +0100"
+        assert r["STRING:request.status.last"] == "404"
+        assert r.get("BYTESCLF:response.body.bytes") is None
+        assert r["HTTP.USERAGENT:request.user-agent"].startswith("Mozilla/5.0")
+
+    for _ in range(3):
+        check1(); check1(); check2(); check2()
+
+
+# --------------------------------------------------------------------------
+# NGINX $-variables embedded in a JSON template (NginxLogFormatJsonTest.java)
+# --------------------------------------------------------------------------
+
+
+def test_nginx_json_shaped_logformat():
+    log_format = (
+        '{ "message":"$request_uri","client": "$remote_addr",'
+        '"auth": "$remote_user", "bytes": "$body_bytes_sent", '
+        '"time_in_sec": "$request_time", "response": "$status", '
+        '"verb":"$request_method","referrer": "$http_referer", '
+        '"site":"$http_host","httpversion":"$server_protocol",'
+        '"logtype":"accesslog","agent": "$http_user_agent" }'
+    )
+    line = (
+        '{ "message":"/one/two/tool.git/info/refs?service=upload-pack",'
+        '"client": "10.11.12.13","auth": "-", "bytes": "178", '
+        '"time_in_sec": "0.000", "response": "301", "verb":"GET",'
+        '"referrer": "-", "site":"some.thing.example.com",'
+        '"httpversion":"HTTP/1.1","logtype":"accesslog",'
+        '"agent": "git/1.9.5.msysgit.0" }'
+    )
+    parser = HttpdLoglineParser(MapRecord, log_format)
+    fields = [
+        "URI:request.firstline.uri",
+        "IP:connection.client.host",
+        "BYTES:response.body.bytes",
+        "STRING:request.status.last",
+        "HTTP.METHOD:request.method",
+        "HTTP.HEADER:request.header.host",
+        "HTTP.USERAGENT:request.user-agent",
+    ]
+    present = parser.get_possible_paths()
+    targets = [f for f in fields if f in present]
+    assert len(targets) >= 5, (fields, present)
+    parser.add_parse_target("set_value", targets)
+    r = parser.parse(line, MapRecord()).results
+    assert r["IP:connection.client.host"] == "10.11.12.13"
+    assert r["BYTES:response.body.bytes"] == "178"
+    assert r["STRING:request.status.last"] == "301"
