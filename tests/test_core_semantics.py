@@ -209,3 +209,71 @@ def test_null_and_empty_outputs_delivered():
     got = dict(record.values)
     assert got["STRING:null"] is None
     assert got["STRING:empty"] == ""
+
+
+# --------------------------------------------------------------------------
+# Bidirectional type converters (convert/ValueConvertTest.java): two
+# dissectors forming a SECONDS <-> MILLISECONDS cycle must both deliver,
+# whichever direction is registered first, without looping.
+# --------------------------------------------------------------------------
+
+from logparser_tpu.core.casts import STRING_OR_LONG
+from logparser_tpu.testing import DissectorTester
+
+
+class SecondsToMilliseconds(SimpleDissector):
+    def __init__(self):
+        super().__init__("SECONDS", {"MILLISECONDS:": STRING_OR_LONG})
+
+    def dissect_field(self, parsable, input_name, pf):
+        parsable.add_dissection(
+            input_name, "MILLISECONDS", "", pf.value.get_long() * 1000
+        )
+
+
+class MillisecondsToSeconds(SimpleDissector):
+    def __init__(self):
+        super().__init__("MILLISECONDS", {"SECONDS:": STRING_OR_LONG})
+
+    def dissect_field(self, parsable, input_name, pf):
+        parsable.add_dissection(
+            input_name, "SECONDS", "", pf.value.get_long() // 1000
+        )
+
+
+def test_type_conversion_seconds_first():
+    (
+        DissectorTester.create()
+        .with_dissector(SecondsToMilliseconds())
+        .with_dissector(MillisecondsToSeconds())
+        .with_path_prefix("something")
+        .with_input("12345")   # seconds, because that dissector is first
+        .expect("SECONDS:something", "12345")
+        .expect("MILLISECONDS:something", "12345000")
+        .check_expectations()
+    )
+
+
+def test_type_conversion_milliseconds_first():
+    (
+        DissectorTester.create()
+        .with_dissector(MillisecondsToSeconds())
+        .with_dissector(SecondsToMilliseconds())
+        .with_path_prefix("something")
+        .with_input("12345000")   # milliseconds, because that one is first
+        .expect("SECONDS:something", "12345")
+        .expect("MILLISECONDS:something", "12345000")
+        .check_expectations()
+    )
+
+
+def test_type_conversion_possible_fields():
+    (
+        DissectorTester.create()
+        .with_dissector(MillisecondsToSeconds())
+        .with_dissector(SecondsToMilliseconds())
+        .with_path_prefix("something")
+        .expect_possible("MILLISECONDS:something")
+        .expect_possible("SECONDS:something")
+        .check_expectations()
+    )

@@ -670,19 +670,25 @@ def test_nginx_json_shaped_logformat():
     )
     parser = HttpdLoglineParser(MapRecord, log_format)
     fields = [
-        "URI:request.firstline.uri",
+        "HTTP.URI:request.firstline.uri",
+        "HTTP.PATH:request.firstline.uri.path",
         "IP:connection.client.host",
         "BYTES:response.body.bytes",
         "STRING:request.status.last",
-        "HTTP.METHOD:request.method",
+        "HTTP.METHOD:request.firstline.method",
         "HTTP.HEADER:request.header.host",
         "HTTP.USERAGENT:request.user-agent",
     ]
-    present = parser.get_possible_paths()
-    targets = [f for f in fields if f in present]
-    assert len(targets) >= 5, (fields, present)
-    parser.add_parse_target("set_value", targets)
+    parser.add_parse_target("set_value", fields)
     r = parser.parse(line, MapRecord()).results
+    assert (
+        r["HTTP.URI:request.firstline.uri"]
+        == "/one/two/tool.git/info/refs?service=upload-pack"
+    )
+    assert r["HTTP.PATH:request.firstline.uri.path"] == "/one/two/tool.git/info/refs"
+    assert r["HTTP.METHOD:request.firstline.method"] == "GET"
     assert r["IP:connection.client.host"] == "10.11.12.13"
     assert r["BYTES:response.body.bytes"] == "178"
     assert r["STRING:request.status.last"] == "301"
+    assert r["HTTP.HEADER:request.header.host"] == "some.thing.example.com"
+    assert r["HTTP.USERAGENT:request.user-agent"] == "git/1.9.5.msysgit.0"
