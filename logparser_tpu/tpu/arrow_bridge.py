@@ -7,9 +7,10 @@ equivalent of that record-delivery surface: span columns gather straight from
 the [B, L] byte buffer into a StringArray, numeric columns become int64 with
 a null bitmap, wildcard columns become map<string,string>.
 
-Zero-copy note: span gathering must touch Python per row for string assembly;
-pyarrow's builders do the heavy lifting in C++.  Numeric columns go through
-numpy with no per-row Python.
+Zero-copy note: device span columns build the StringArray from numpy-gathered
+(offsets, bytes) buffers wrapped zero-copy — no per-row Python.  Only the
+fallback path (host-override rows, wildcard maps, non-UTF-8 data) goes
+through ``to_pylist``'s per-row decode.  Numeric columns are pure numpy.
 """
 from __future__ import annotations
 
@@ -22,6 +23,59 @@ if TYPE_CHECKING:  # pragma: no cover
     from .batch import BatchResult
 
 _NUMERIC_KINDS = {"long", "long_clf_null", "long_clf_zero", "epoch"}
+
+
+def _spans_to_string_array(result: "BatchResult", col) -> Optional[Any]:
+    """Vectorized span -> pa.StringArray: one flat gather from the [B, L]
+    byte buffer via offsets built with cumsum/repeat.  Returns None when the
+    gathered bytes are not valid UTF-8 (caller falls back to the per-row
+    decode with errors="replace")."""
+    import pyarrow as pa
+
+    B = result.lines_read
+    if B == 0:
+        return pa.array([], type=pa.string())
+    L = result.buf.shape[1]
+    starts = np.asarray(col["starts"][:B], dtype=np.int64)
+    ends = np.asarray(col["ends"][:B], dtype=np.int64)
+    ok = (
+        np.asarray(result.valid[:B]).astype(bool)
+        & np.asarray(col["ok"][:B]).astype(bool)
+    )
+    buf = result.buf[:B]
+    first = buf[np.arange(B), np.minimum(starts, L - 1)]
+    # decode_extracted_value semantics: a lone '-' is null.
+    is_dash = ok & ((ends - starts) == 1) & (first == np.uint8(ord("-")))
+    valid = ok & ~is_dash
+
+    lens = np.where(valid, ends - starts, 0).astype(np.int64)
+    offsets64 = np.zeros(B + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets64[1:])
+    offsets = offsets64.astype(np.int32)
+    total = int(offsets64[-1])
+    row_base = np.arange(B, dtype=np.int64) * L + starts
+    # One repeat, not two: element j of row i sits at buf_flat[row_base[i]+j]
+    # and lands at data[offsets[i]+j], so the per-element shift is constant
+    # within a row.
+    idx = np.repeat(row_base - offsets64[:-1], lens) + np.arange(
+        total, dtype=np.int64
+    )
+    data = np.ascontiguousarray(buf).reshape(-1)[idx]
+
+    null_bitmap = np.packbits(valid, bitorder="little")
+    # pa.py_buffer wraps the numpy arrays zero-copy (buffer protocol);
+    # .tobytes() here would duplicate the data buffer per batch.
+    arr = pa.StringArray.from_buffers(
+        B,
+        pa.py_buffer(offsets),
+        pa.py_buffer(data),
+        pa.py_buffer(null_bitmap),
+    )
+    try:
+        arr.validate(full=True)  # UTF-8 check happens here
+    except pa.ArrowInvalid:
+        return None
+    return arr
 
 
 def _column_to_arrow(result: "BatchResult", field_id: str):
@@ -50,6 +104,15 @@ def _column_to_arrow(result: "BatchResult", field_id: str):
                 values[row] = v
                 mask[row] = False
         return pa.array(values[:B], type=pa.int64(), mask=mask[:B])
+
+    # Device span columns with no host overrides: build the StringArray
+    # straight from (offsets, gathered bytes) with numpy — no per-row
+    # Python.  Falls through to the slow path for override rows (host
+    # fallback), wildcard maps, and non-UTF-8 data.
+    if kind == "span" and not field_id.endswith(".*") and not overrides:
+        arr = _spans_to_string_array(result, col)
+        if arr is not None:
+            return arr
 
     # Host-delivered / span columns: type from the materialized values
     # (host-path numerics — e.g. dissector-produced numbers like GeoIP

@@ -67,3 +67,25 @@ def test_ipc_roundtrip(parser):
     assert table.num_rows == 32
     again = table_to_ipc_bytes(table)
     assert table_from_ipc_bytes(again).equals(table)
+
+
+def test_span_fast_path_edge_cases():
+    """Vectorized span->StringArray: dash-null, empty, invalid rows, and the
+    non-UTF-8 fallback to per-row errors='replace' decoding."""
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    p = TpuBatchParser("combined", ["HTTP.USERAGENT:request.user-agent"])
+    lines = [
+        b'1.2.3.4 - - [01/Jan/2026:10:00:00 +0000] "GET /x HTTP/1.1" 200 5 "-" "ua1"',
+        b'1.2.3.4 - - [01/Jan/2026:10:00:00 +0000] "GET /x HTTP/1.1" 200 5 "-" "-"',
+        b"garbage that does not parse",
+        b'1.2.3.4 - - [01/Jan/2026:10:00:00 +0000] "GET /x HTTP/1.1" 200 5 "-" "a\xffb"',
+    ]
+    res = p.parse_batch(lines)
+    table = res.to_arrow(include_validity=True)
+    col = table.column("HTTP.USERAGENT:request.user-agent").to_pylist()
+    assert col == res.to_pylist("HTTP.USERAGENT:request.user-agent")
+    assert col[0] == "ua1"
+    assert col[1] is None          # '-' -> null
+    assert col[2] is None          # invalid line
+    assert col[3] == "a�b"    # non-UTF8 -> replacement char via fallback
