@@ -220,8 +220,12 @@ class TpuBatchParser:
         self.host_fields = [
             fid for fid, p in self.plan_by_id.items() if p.kind == "host"
         ]
+        # Casts for EVERY requested field: any field can take the host path
+        # on some line (host-only fields always; device fields when the
+        # line's winning format resolves them as host — e.g. multi-producer
+        # fields like `%B ... %b` — or when the line goes to the oracle).
         self._host_casts = {
-            fid: self.oracle.get_casts(fid) for fid in self.host_fields
+            fid: self.oracle.get_casts(fid) for fid in self.requested
         }
         # Per-unit: fields the oracle must supply for lines won by that unit
         # (host under it, or a kind-group mismatch with the merged column).
@@ -287,30 +291,54 @@ class TpuBatchParser:
         )
 
     def _resolve(self, program: DeviceProgram, field_id: str) -> _FieldPlan:
+        """Map one requested field to its device plan — or "host" when the
+        field has MORE THAN ONE producer in the dissector graph.  With
+        multiple producers (e.g. `%B ... %b`: the direct BYTESCLF token plus
+        the ConvertNumberIntoCLF edge from the BYTES token both feed
+        BYTESCLF:response.body.bytes) the oracle delivers every value in
+        graph order and the record keeps the last; a single-token device
+        plan would silently pick one — so such fields go to the oracle."""
         ftype, _, path = field_id.partition(":")
+        candidates: List[_FieldPlan] = []
         for tok in program.tokens:
             for out_type, out_name in tok.outputs:
                 if out_name == path:
                     if out_type == ftype:
                         if tok.charset == CS_DIGITS:
-                            return _FieldPlan(field_id, "long", tok.index)
-                        if tok.charset == CS_CLF_DIGITS:
-                            return _FieldPlan(field_id, "long_clf_null", tok.index)
-                        return _FieldPlan(field_id, "span", tok.index)
-                    # CLF -> number translator edge (BYTESCLF token, BYTES asked)
-                    if out_type == "BYTESCLF" and ftype == "BYTES":
-                        return _FieldPlan(field_id, "long_clf_zero", tok.index)
+                            kind = "long"
+                        elif tok.charset == CS_CLF_DIGITS:
+                            kind = "long_clf_null"
+                        else:
+                            kind = "span"
+                        candidates.append(_FieldPlan(field_id, kind, tok.index))
+                    elif out_type == "BYTESCLF" and ftype == "BYTES":
+                        # CLF -> number translator edge
+                        candidates.append(
+                            _FieldPlan(field_id, "long_clf_zero", tok.index)
+                        )
+                    elif out_type == "BYTES" and ftype == "BYTESCLF":
+                        # number -> CLF translator edge (0 -> null): a real
+                        # producer in the oracle graph; not device-modeled.
+                        candidates.append(_FieldPlan(field_id, "host"))
                 elif path.startswith(out_name + "."):
                     suffix = path[len(out_name) + 1 :]
                     if out_type == "TIME.STAMP" and ftype == "TIME.EPOCH" and suffix == "epoch":
-                        return _FieldPlan(field_id, "epoch", tok.index)
-                    if out_type == "HTTP.FIRSTLINE":
+                        candidates.append(_FieldPlan(field_id, "epoch", tok.index))
+                    elif out_type == "HTTP.FIRSTLINE":
                         if ftype == "HTTP.METHOD" and suffix == "method":
-                            return _FieldPlan(field_id, "fl_method", tok.index)
-                        if ftype == "HTTP.URI" and suffix == "uri":
-                            return _FieldPlan(field_id, "fl_uri", tok.index)
-                        if ftype == "HTTP.PROTOCOL_VERSION" and suffix == "protocol":
-                            return _FieldPlan(field_id, "fl_protocol", tok.index)
+                            candidates.append(
+                                _FieldPlan(field_id, "fl_method", tok.index)
+                            )
+                        elif ftype == "HTTP.URI" and suffix == "uri":
+                            candidates.append(
+                                _FieldPlan(field_id, "fl_uri", tok.index)
+                            )
+                        elif ftype == "HTTP.PROTOCOL_VERSION" and suffix == "protocol":
+                            candidates.append(
+                                _FieldPlan(field_id, "fl_protocol", tok.index)
+                            )
+        if len(candidates) == 1 and candidates[0].kind != "host":
+            return candidates[0]
         return _FieldPlan(field_id, "host")
 
     # ------------------------------------------------------------------
@@ -463,7 +491,11 @@ class TpuBatchParser:
                 return None
             # Numeric coercion follows the kind of the format that won the
             # line (a field can be numeric under one format and a plain
-            # string under another); unknown winner -> merged kind.
+            # string under another); unknown winner -> merged kind.  A
+            # winner that resolves the field as "host" (multi-producer)
+            # falls through to the casts-based dispatch below — the
+            # reference types such values by the producing dissector's
+            # casts, not by another format's device plan.
             kind = (
                 self.units[winner_index].plan_for(fid).kind
                 if winner_index >= 0

@@ -12,6 +12,7 @@ from logparser_tpu.core.exceptions import DissectionFailure
 from logparser_tpu.httpd import HttpdLoglineParser
 from logparser_tpu.tools.demolog import generate_combined_lines
 from logparser_tpu.tpu import TpuBatchParser
+from logparser_tpu.tpu.batch import _CollectingRecord
 from logparser_tpu.tpu.program import compile_device_program
 from logparser_tpu.tpu.runtime import encode_batch, run_program
 
@@ -346,3 +347,35 @@ class TestTimestampGarbageParity:
         assert not valid[0]            # garbage tz -> invalid line
         assert valid[1]
         assert epochs[1] == 1704067200000
+
+
+class TestMultiProducerFields:
+    def test_duplicate_producers_route_to_oracle(self):
+        """`%B ... %b` + translators gives BYTES/BYTESCLF two producers; the
+        device must not silently pick one — the oracle's last-delivered
+        value wins (graph order), typed by the producing casts."""
+        p = TpuBatchParser("%B %b", ["BYTES:response.body.bytes",
+                                     "BYTESCLF:response.body.bytes"])
+        r = p.parse_batch(["123 456", "77 -"])
+        assert list(r.valid) == [True, True]
+        for fid in ("BYTES:response.body.bytes", "BYTESCLF:response.body.bytes"):
+            got = r.to_pylist(fid)
+            want = []
+            for line in ["123 456", "77 -"]:
+                rec = p.oracle.parse(line, _CollectingRecord())
+                v = rec.values.get(fid)
+                want.append(int(v) if v is not None else None)
+            assert got == want, (fid, got, want)
+
+    def test_multiformat_winner_host_field_stays_numeric(self):
+        """A field that is multi-producer (host) under format 0 but
+        device-numeric under format 1 must come out int64 for BOTH formats'
+        lines (coercion follows the oracle casts, not another format's
+        device plan)."""
+        p = TpuBatchParser("%B %b\n%B", ["BYTES:response.body.bytes"])
+        r = p.parse_batch(["123 123", "77", "0 -"])
+        vals = r.to_pylist("BYTES:response.body.bytes")
+        assert vals == [123, 77, 0]
+        assert all(isinstance(v, int) for v in vals)
+        t = r.to_arrow()
+        assert str(t.column("BYTES:response.body.bytes").type) == "int64"
