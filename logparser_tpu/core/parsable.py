@@ -33,6 +33,9 @@ class Parsable:
         self.useful_intermediates: Set[str] = parser.get_useful_intermediate_fields()
         self._cache: Dict[str, ParsedField] = {}
         self.to_be_parsed: Set[ParsedField] = set()
+        # Exact needed ids actually delivered to the record (drives the
+        # last-chance converter pass in Parser._run).
+        self.delivered: Set[str] = set()
 
     def set_root_dissection(self, root_type: str, value: Union[str, Value]) -> None:
         pf = ParsedField(root_type, "", value)  # the root name is an empty string
@@ -76,6 +79,7 @@ class Parsable:
             self.to_be_parsed.add(pf)
 
         if needed_name in self.needed:
+            self.delivered.add(needed_name)
             self.parser.store(self.record, needed_name, needed_name, value)
 
         if needed_wildcard in self.needed:

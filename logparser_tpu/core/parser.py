@@ -150,6 +150,7 @@ class Parser:
         self._useful_intermediates: Set[str] = set()
         self._located_targets: Set[str] = set()
         self._needed_frozen: Optional[FrozenSet[str]] = None
+        self._last_chance: Dict[str, Tuple[str, Any]] = {}
 
         if record_class is not None:
             for name in dir(record_class):
@@ -366,7 +367,30 @@ class Parser:
             if missing:
                 raise MissingDissectorsException("\n".join(sorted(missing)))
         self._needed_frozen = frozenset(self.targets.keys())
+        self._prepare_last_chance_converters(available)
         self._assembled = True
+
+    def _prepare_last_chance_converters(
+        self, available: List[_DissectorPhase]
+    ) -> None:
+        """Precompute the per-needed-id converter candidates for the
+        last-chance pass (see _last_chance_converters): one prepared,
+        stateless instance per (needed id), casts registered HERE so parse()
+        never mutates shared parser state."""
+        self._last_chance: Dict[str, Tuple[str, Any]] = {}
+        for nid in self._needed_frozen:
+            if nid.endswith("*"):
+                continue
+            ftype, _, path = nid.partition(":")
+            for phase in available:
+                if phase.output_type != ftype or phase.name != "":
+                    continue
+                instance = phase.instance.get_new_instance()
+                self.casts_of_targets.setdefault(
+                    nid, instance.prepare_for_dissect(path, path)
+                )
+                self._last_chance[nid] = (phase.input_type, instance)
+                break
 
     def _find_useful_dissectors(
         self,
@@ -480,7 +504,31 @@ class Parser:
                 for phase in self._compiled.get(pf.id, ()):
                     phase.instance.dissect(parsable, pf.name)
             to_be_parsed = set(parsable.to_be_parsed)
+        self._last_chance_converters(parsable)
         return parsable
+
+    def _last_chance_converters(self, parsable: Parsable) -> None:
+        """Deliver needed ids the compiled tree missed but a pure type
+        converter can still produce from the cache.
+
+        The compile guard (`out_id not in _compiled`) wires only ONE
+        direction of a converter cycle — necessary for parse termination —
+        so with two producers of the same path under different types (e.g.
+        `%B ... %b` across two LogFormats plus the CLF<->number
+        translators), the direction a given line needs may be the one that
+        lost the compile race.  This one-shot, non-recursive pass applies a
+        whole-path converter phase (name == "") to a cached field of the
+        same path; it cannot loop and is a no-op when everything was
+        delivered."""
+        candidates = self._last_chance
+        if not candidates:
+            return
+        for nid, (input_type, instance) in candidates.items():
+            if nid in parsable.delivered:
+                continue
+            _, _, path = nid.partition(":")
+            if parsable.get_parsable_field(input_type, path) is not None:
+                instance.dissect(parsable, path)
 
     # ------------------------------------------------------------------
     # store (setter dispatch)
@@ -641,4 +689,5 @@ class Parser:
         state["_useful_intermediates"] = set()
         state["_located_targets"] = set()
         state["_needed_frozen"] = None
+        state["_last_chance"] = {}
         return state

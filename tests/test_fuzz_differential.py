@@ -75,19 +75,36 @@ LINES_PER_FORMAT = 40
 GARBAGE = ["", "complete garbage", '"-', "\\x16\\x03", "a b c d e f g h i"]
 
 
-def make_case(seed):
-    rng = random.Random(seed)
-    k = rng.randint(3, min(8, len(TOKEN_POOL)))
+def _one_format(rng, k_min=3, k_max=8):
+    k = rng.randint(k_min, min(k_max, len(TOKEN_POOL)))
     picks = rng.sample(TOKEN_POOL, k)
     rng.shuffle(picks)
-    log_format = " ".join(tok for tok, _, _ in picks)
-    fields = sorted({f for _, fs, _ in picks for f in fs})
+    return picks
+
+
+def _line_for(picks, rng):
+    return " ".join(gen(rng) for _, _, gen in picks)
+
+
+def make_case(seed):
+    """Even seeds: one format.  Odd seeds: TWO formats in one parser (the
+    multi-format winner/coercion machinery) with lines of both shapes."""
+    rng = random.Random(seed)
+    format_picks = [_one_format(rng)]
+    if seed % 2:
+        format_picks.append(_one_format(rng, k_min=2, k_max=5))
+    log_format = "\n".join(
+        " ".join(tok for tok, _, _ in picks) for picks in format_picks
+    )
+    fields = sorted({
+        f for picks in format_picks for _, fs, _ in picks for f in fs
+    })
     lines = []
     for i in range(LINES_PER_FORMAT):
         if i % 13 == 7:
             lines.append(rng.choice(GARBAGE))
         else:
-            lines.append(" ".join(gen(rng) for _, _, gen in picks))
+            lines.append(_line_for(rng.choice(format_picks), rng))
     return log_format, fields, lines
 
 

@@ -51,7 +51,7 @@ def test_checked_in_demolog_parses():
     (1% generated hostile) and bit-exact vs the oracle on a sample."""
     import os
 
-    from logparser_tpu.tpu.batch import TpuBatchParser
+    from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
 
     path = os.path.join(
         os.path.dirname(__file__), "..", "examples",
@@ -70,3 +70,20 @@ def test_checked_in_demolog_parses():
     assert sum(valid) >= int(0.98 * len(lines))
     ips = res.to_pylist("IP:connection.client.host")
     assert ips[0] == "7.140.125.58"
+    # bit-exactness vs the oracle on a strided sample
+    epochs = res.to_pylist("TIME.EPOCH:request.receive.time.epoch")
+    statuses = res.to_pylist("STRING:request.status.last")
+    for i in range(0, len(lines), 173):
+        try:
+            want = parser.oracle.parse(
+                lines[i].decode("utf-8"), _CollectingRecord()
+            ).values
+            ok = True
+        except Exception:
+            want, ok = {}, False
+        assert valid[i] == ok
+        if not ok:
+            continue
+        assert ips[i] == want["IP:connection.client.host"]
+        assert epochs[i] == int(want["TIME.EPOCH:request.receive.time.epoch"])
+        assert statuses[i] == want["STRING:request.status.last"]
