@@ -389,6 +389,7 @@ class Parser:
                 self.casts_of_targets.setdefault(
                     nid, instance.prepare_for_dissect(path, path)
                 )
+                instance.prepare_for_run()  # full SPI lifecycle, like any phase
                 self._last_chance[nid] = (phase.input_type, instance)
                 break
 

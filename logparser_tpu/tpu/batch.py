@@ -192,6 +192,33 @@ class TpuBatchParser:
         self.oracle.add_parse_target("set_value", list(self.requested))
         self.oracle.assemble_dissectors()
 
+        # Whole-path type-converter edges (translators with an empty output
+        # name), transitively closed: every (T1 -> T2) pair means a token
+        # emitting T1:path is a PRODUCER of T2:path in the oracle graph.
+        # _resolve must count those or multi-producer fields (e.g.
+        # $time_local + $msec both feeding TIME.EPOCH:...epoch) would be
+        # silently claimed by one device route.
+        edges = set()
+        for d in self.oracle.all_dissectors:
+            try:
+                outs = d.get_possible_output()
+            except Exception:  # pragma: no cover — defensive
+                continue
+            for o in outs:
+                out_type, _, name = o.partition(":")
+                if name == "":
+                    edges.add((d.get_input_type(), out_type))
+        closed = set(edges)
+        changed = True
+        while changed:
+            changed = False
+            for a, b in list(closed):
+                for c, dst in edges:
+                    if c == b and (a, dst) not in closed:
+                        closed.add((a, dst))
+                        changed = True
+        self._converter_edges = closed
+
         # Device programs: one FormatUnit per registered format, in
         # registration order (SURVEY §7.7 "run k format automata, pick the
         # per-line winner").  Only the compilable PREFIX of the format list
@@ -312,12 +339,12 @@ class TpuBatchParser:
                             kind = "span"
                         candidates.append(_FieldPlan(field_id, kind, tok.index))
                     elif out_type == "BYTESCLF" and ftype == "BYTES":
-                        # CLF -> number translator edge
+                        # CLF -> number translator edge (device-modeled)
                         candidates.append(
                             _FieldPlan(field_id, "long_clf_zero", tok.index)
                         )
-                    elif out_type == "BYTES" and ftype == "BYTESCLF":
-                        # number -> CLF translator edge (0 -> null): a real
+                    elif (out_type, ftype) in self._converter_edges:
+                        # Any other whole-path converter edge: a real
                         # producer in the oracle graph; not device-modeled.
                         candidates.append(_FieldPlan(field_id, "host"))
                 elif path.startswith(out_name + "."):

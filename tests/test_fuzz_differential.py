@@ -75,6 +75,48 @@ LINES_PER_FORMAT = 40
 GARBAGE = ["", "complete garbage", '"-', "\\x16\\x03", "a b c d e f g h i"]
 
 
+def assert_device_matches_oracle(log_format, fields, lines, label):
+    parser = TpuBatchParser(log_format, fields)
+    result = parser.parse_batch(lines)
+    valid = list(result.valid)
+    columns = {f: result.to_pylist(f) for f in fields}
+
+    oracle = parser.oracle
+    n_checked = 0
+    for i, line in enumerate(lines):
+        try:
+            expected = oracle.parse(line, _CollectingRecord()).values
+            ok = True
+        except Exception:
+            expected, ok = {}, False
+        assert valid[i] == ok, (
+            f"{label} line {i}: batch valid={valid[i]} oracle ok={ok}\n"
+            f"  format: {log_format}\n  line:   {line!r}"
+        )
+        if not ok:
+            continue
+        for f in fields:
+            got, want = columns[f][i], expected.get(f)
+            if isinstance(got, int) and want is not None:
+                want = int(want)
+            assert got == want, (
+                f"{label} line {i} field {f}: {got!r} != {want!r}\n"
+                f"  format: {log_format}\n  line:   {line!r}"
+            )
+            n_checked += 1
+    assert n_checked > 0
+
+
+def _make_lines(format_picks, rng):
+    lines = []
+    for i in range(LINES_PER_FORMAT):
+        if i % 13 == 7:
+            lines.append(rng.choice(GARBAGE))
+        else:
+            lines.append(_line_for(rng.choice(format_picks), rng))
+    return lines
+
+
 def _one_format(rng, k_min=3, k_max=8):
     k = rng.randint(k_min, min(k_max, len(TOKEN_POOL)))
     picks = rng.sample(TOKEN_POOL, k)
@@ -99,44 +141,73 @@ def make_case(seed):
     fields = sorted({
         f for picks in format_picks for _, fs, _ in picks for f in fs
     })
-    lines = []
-    for i in range(LINES_PER_FORMAT):
-        if i % 13 == 7:
-            lines.append(rng.choice(GARBAGE))
-        else:
-            lines.append(_line_for(rng.choice(format_picks), rng))
-    return log_format, fields, lines
+    return log_format, fields, _make_lines(format_picks, rng)
 
 
 @pytest.mark.parametrize("seed", range(N_FORMATS))
 def test_random_format_device_matches_oracle(seed):
     log_format, fields, lines = make_case(1000 + seed)
-    parser = TpuBatchParser(log_format, fields)
-    result = parser.parse_batch(lines)
-    valid = list(result.valid)
-    columns = {f: result.to_pylist(f) for f in fields}
+    assert_device_matches_oracle(log_format, fields, lines, f"seed={seed}")
 
-    oracle = parser.oracle
-    n_checked = 0
-    for i, line in enumerate(lines):
-        try:
-            expected = oracle.parse(line, _CollectingRecord()).values
-            ok = True
-        except Exception:
-            expected, ok = {}, False
-        assert valid[i] == ok, (
-            f"seed={seed} line {i}: batch valid={valid[i]} oracle ok={ok}\n"
-            f"  format: {log_format}\n  line:   {line!r}"
-        )
-        if not ok:
-            continue
-        for f in fields:
-            got, want = columns[f][i], expected.get(f)
-            if isinstance(got, int) and want is not None:
-                want = int(want)
-            assert got == want, (
-                f"seed={seed} line {i} field {f}: {got!r} != {want!r}\n"
-                f"  format: {log_format}\n  line:   {line!r}"
-            )
-            n_checked += 1
-    assert n_checked > 0
+
+# --------------------------------------------------------------------------
+# NGINX $-variable fuzzing (same contract, the other dialect)
+# --------------------------------------------------------------------------
+
+NGINX_POOL = [
+    ("$remote_addr", ["IP:connection.client.host"],
+     lambda rng: f"{rng.randint(1, 223)}.{rng.randint(0, 255)}"
+                 f".{rng.randint(0, 255)}.{rng.randint(1, 254)}"),
+    ("$remote_user", ["STRING:connection.client.user"],
+     lambda rng: rng.choice(["-", "bob", "x123"])),
+    ("[$time_local]", ["TIME.EPOCH:request.receive.time.epoch"],
+     lambda rng: "[%02d/%s/%04d:%02d:%02d:%02d %s]" % (
+         rng.randint(1, 28),
+         rng.choice(["Jan", "Mar", "Jul", "Nov"]),
+         rng.randint(1995, 2035),
+         rng.randint(0, 23), rng.randint(0, 59), rng.randint(0, 59),
+         rng.choice(["+0000", "-0800", "+0200"]),
+     )),
+    ('"$request"', ["HTTP.FIRSTLINE:request.firstline",
+                    "HTTP.METHOD:request.firstline.method"],
+     lambda rng: '"%s %s HTTP/1.1"' % (
+         rng.choice(["GET", "POST"]),
+         rng.choice(["/", "/a?b=c", "/x%20y", "/?q=%C3%A9"]),
+     )),
+    ("$status", ["STRING:request.status.last"],
+     lambda rng: rng.choice(["200", "404", "502"])),
+    ("$body_bytes_sent", ["BYTES:response.body.bytes"],
+     lambda rng: str(rng.randint(0, 10**10))),
+    ("$bytes_sent", ["BYTES:response.bytes"],
+     lambda rng: str(rng.randint(0, 10**7))),
+    ("$request_length", ["BYTES:request.bytes"],
+     lambda rng: str(rng.randint(10, 9999))),
+    ("$connection", ["NUMBER:connection.serial_number"],
+     lambda rng: rng.choice(["-", str(rng.randint(1, 10**6))])),
+    ('"$http_referer"', ["HTTP.URI:request.referer"],
+     lambda rng: rng.choice(['"-"', '"http://e.com/"', '"https://a.b/c?d=e"'])),
+    ('"$http_user_agent"', ["HTTP.USERAGENT:request.user-agent"],
+     lambda rng: rng.choice(['"-"', '"curl/8"', '"Mozilla/5.0 (weird)"'])),
+    ("$server_port", ["PORT:connection.server.port"],
+     lambda rng: str(rng.randint(1, 65535))),
+    ("$pipe", ["STRING:connection.nginx.pipe"],
+     lambda rng: rng.choice([".", "p"])),
+    ("$msec", ["TIME.EPOCH:request.receive.time.epoch"],
+     lambda rng: f"{rng.randint(10**8, 2 * 10**9)}.{rng.randint(0, 999):03d}"),
+]
+
+
+def make_nginx_case(seed):
+    rng = random.Random(seed)
+    k = rng.randint(3, min(8, len(NGINX_POOL)))
+    picks = rng.sample(NGINX_POOL, k)
+    rng.shuffle(picks)
+    log_format = " ".join(tok for tok, _, _ in picks)
+    fields = sorted({f for _, fs, _ in picks for f in fs})
+    return log_format, fields, _make_lines([picks], rng)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_nginx_format_device_matches_oracle(seed):
+    log_format, fields, lines = make_nginx_case(5000 + seed)
+    assert_device_matches_oracle(log_format, fields, lines, f"nginx-seed={seed}")
