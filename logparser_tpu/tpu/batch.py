@@ -200,10 +200,10 @@ class TpuBatchParser:
         # silently claimed by one device route.
         edges = set()
         for d in self.oracle.all_dissectors:
-            try:
-                outs = d.get_possible_output()
-            except Exception:  # pragma: no cover — defensive
-                continue
+            # No try/except: a dissector whose get_possible_output() raises
+            # would silently drop its converter edge, letting a device plan
+            # claim a multi-producer field — fail loudly at construction.
+            outs = d.get_possible_output()
             for o in outs:
                 out_type, _, name = o.partition(":")
                 if name == "":

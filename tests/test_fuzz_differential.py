@@ -117,9 +117,9 @@ def _make_lines(format_picks, rng):
     return lines
 
 
-def _one_format(rng, k_min=3, k_max=8):
-    k = rng.randint(k_min, min(k_max, len(TOKEN_POOL)))
-    picks = rng.sample(TOKEN_POOL, k)
+def _one_format(rng, pool=TOKEN_POOL, k_min=3, k_max=8):
+    k = rng.randint(k_min, min(k_max, len(pool)))
+    picks = rng.sample(pool, k)
     rng.shuffle(picks)
     return picks
 
@@ -199,9 +199,7 @@ NGINX_POOL = [
 
 def make_nginx_case(seed):
     rng = random.Random(seed)
-    k = rng.randint(3, min(8, len(NGINX_POOL)))
-    picks = rng.sample(NGINX_POOL, k)
-    rng.shuffle(picks)
+    picks = _one_format(rng, pool=NGINX_POOL)
     log_format = " ".join(tok for tok, _, _ in picks)
     fields = sorted({f for _, fs, _ in picks for f in fs})
     return log_format, fields, _make_lines([picks], rng)
