@@ -209,3 +209,47 @@ def make_nginx_case(seed):
 def test_random_nginx_format_device_matches_oracle(seed):
     log_format, fields, lines = make_nginx_case(5000 + seed)
     assert_device_matches_oracle(log_format, fields, lines, f"nginx-seed={seed}")
+
+
+# --------------------------------------------------------------------------
+# Wildcard (ragged) outputs: random query strings through STRING:...query.*
+# --------------------------------------------------------------------------
+
+
+def _rand_query(rng):
+    n = rng.randint(0, 5)
+    parts = []
+    for _ in range(n):
+        k = rng.choice(["a", "b", "aap", "UTM_src", "q-1", "empty"])
+        v = rng.choice(["", "1", "x%20y", "caf%C3%A9", "50%-off", "a%26b"])
+        parts.append(k if rng.random() < 0.15 else f"{k}={v}")
+    return "?" + "&".join(parts) if parts else ""
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_wildcard_query_fuzz(seed):
+    rng = random.Random(9000 + seed)
+    wildcard = "STRING:request.firstline.uri.query.*"
+    fields = [wildcard, "HTTP.METHOD:request.firstline.method"]
+    lines = [
+        '1.2.3.4 - - [01/Jan/2026:10:00:00 +0000] "GET /p%s HTTP/1.1" '
+        '200 5 "-" "ua"' % _rand_query(rng)
+        for _ in range(30)
+    ]
+    parser = TpuBatchParser("combined", fields)
+    result = parser.parse_batch(lines)
+    got_maps = result.to_pylist(wildcard)
+    methods = result.to_pylist("HTTP.METHOD:request.firstline.method")
+    assert methods == ["GET"] * len(lines)
+    prefix = wildcard[:-1]
+    for i, line in enumerate(lines):
+        rec = parser.oracle.parse(line, _CollectingRecord())
+        want = {
+            k[len(prefix):]: v
+            for k, v in rec.values.items()
+            if k.startswith(prefix)
+        }
+        got = got_maps[i] or {}
+        assert dict(got) == want, (
+            f"seed={seed} line {i}: {got!r} != {want!r}\n  line: {line!r}"
+        )
