@@ -377,7 +377,7 @@ class Parser:
         last-chance pass (see _last_chance_converters): one prepared,
         stateless instance per (needed id), casts registered HERE so parse()
         never mutates shared parser state."""
-        self._last_chance: Dict[str, Tuple[str, Any]] = {}
+        self._last_chance: Dict[str, List[Tuple[str, Any]]] = {}
         for nid in self._needed_frozen:
             if nid.endswith("*"):
                 continue
@@ -385,13 +385,17 @@ class Parser:
             for phase in available:
                 if phase.output_type != ftype or phase.name != "":
                     continue
+                # Keep EVERY candidate (not just the first): two converters
+                # with different input types can produce the same needed
+                # type, and which input is cached depends on the line.
                 instance = phase.instance.get_new_instance()
                 self.casts_of_targets.setdefault(
                     nid, instance.prepare_for_dissect(path, path)
                 )
                 instance.prepare_for_run()  # full SPI lifecycle, like any phase
-                self._last_chance[nid] = (phase.input_type, instance)
-                break
+                self._last_chance.setdefault(nid, []).append(
+                    (phase.input_type, instance)
+                )
 
     def _find_useful_dissectors(
         self,
@@ -524,12 +528,14 @@ class Parser:
         candidates = self._last_chance
         if not candidates:
             return
-        for nid, (input_type, instance) in candidates.items():
+        for nid, options in candidates.items():
             if nid in parsable.delivered:
                 continue
             _, _, path = nid.partition(":")
-            if parsable.get_parsable_field(input_type, path) is not None:
-                instance.dissect(parsable, path)
+            for input_type, instance in options:
+                if parsable.get_parsable_field(input_type, path) is not None:
+                    instance.dissect(parsable, path)
+                    break
 
     # ------------------------------------------------------------------
     # store (setter dispatch)

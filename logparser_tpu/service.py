@@ -131,16 +131,23 @@ class _ParserCache:
                 if parser is not None:
                     self._parsers.move_to_end(key)
             if parser is None:
-                parser = TpuBatchParser(
-                    config["log_format"],
-                    list(config["fields"]),
-                    timestamp_format=config.get("timestamp_format"),
-                )
-                with self._lock:
-                    self._parsers[key] = parser
-                    while len(self._parsers) > self._max_entries:
-                        self._parsers.popitem(last=False)
-                    self._building.pop(key, None)
+                try:
+                    parser = TpuBatchParser(
+                        config["log_format"],
+                        list(config["fields"]),
+                        timestamp_format=config.get("timestamp_format"),
+                    )
+                    with self._lock:
+                        self._parsers[key] = parser
+                        while len(self._parsers) > self._max_entries:
+                            self._parsers.popitem(last=False)
+                finally:
+                    # Failed builds must also drop the per-key build lock:
+                    # the parser LRU is bounded but _building is not, and a
+                    # long-lived sidecar fed many invalid configs would
+                    # otherwise grow it without bound.
+                    with self._lock:
+                        self._building.pop(key, None)
             return parser
 
 
@@ -182,6 +189,11 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 if len(lines_frame) < 4:
                     raise ValueError("LINES frame shorter than its count header")
                 (count,) = struct.unpack(">I", lines_frame[:4])
+                if count == 0 and len(lines_frame) > 4:
+                    raise ValueError(
+                        "LINES frame declared 0 lines but carries "
+                        f"{len(lines_frame) - 4} payload bytes"
+                    )
                 lines = lines_frame[4:].split(b"\n") if count else []
                 if len(lines) != count:
                     raise ValueError(

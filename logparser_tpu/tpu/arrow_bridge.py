@@ -51,8 +51,12 @@ def _spans_to_string_array(result: "BatchResult", col) -> Optional[Any]:
     lens = np.where(valid, ends - starts, 0).astype(np.int64)
     offsets64 = np.zeros(B + 1, dtype=np.int64)
     np.cumsum(lens, out=offsets64[1:])
-    offsets = offsets64.astype(np.int32)
     total = int(offsets64[-1])
+    if total > np.iinfo(np.int32).max:
+        # int32 StringArray offsets would wrap; don't rely on validate()
+        # catching it after the full gather — take the fallback path now.
+        return None
+    offsets = offsets64.astype(np.int32)
     row_base = np.arange(B, dtype=np.int64) * L + starts
     # One repeat, not two: element j of row i sits at buf_flat[row_base[i]+j]
     # and lands at data[offsets[i]+j], so the per-element shift is constant
