@@ -22,7 +22,6 @@ import numpy as np
 if TYPE_CHECKING:  # pragma: no cover
     from .batch import BatchResult
 
-_NUMERIC_KINDS = {"long", "long_clf_null", "long_clf_zero", "epoch"}
 
 
 def _spans_to_string_array(result: "BatchResult", col) -> Optional[Any]:
@@ -43,10 +42,9 @@ def _spans_to_string_array(result: "BatchResult", col) -> Optional[Any]:
         & np.asarray(col["ok"][:B]).astype(bool)
     )
     buf = result.buf[:B]
-    first = buf[np.arange(B), np.minimum(starts, L - 1)]
-    # decode_extracted_value semantics: a lone '-' is null.
-    is_dash = ok & ((ends - starts) == 1) & (first == np.uint8(ord("-")))
-    valid = ok & ~is_dash
+    # Device-computed null bit: CLF '-' token captures and undelivered URI
+    # parts (decode_extracted_value semantics live in the device pipeline).
+    valid = ok & ~np.asarray(col["null"][:B]).astype(bool)
 
     lens = np.where(valid, ends - starts, 0).astype(np.int64)
     offsets64 = np.zeros(B + 1, dtype=np.int64)
@@ -65,6 +63,16 @@ def _spans_to_string_array(result: "BatchResult", col) -> Optional[Any]:
         total, dtype=np.int64
     )
     data = np.ascontiguousarray(buf).reshape(-1)[idx]
+    amp = col.get("amp")
+    if amp is not None and amp[:B].any():
+        # ?& query normalization: a leading '?' renders as '&'.
+        first_pos = offsets64[:-1]
+        swap = (
+            valid & np.asarray(amp[:B]).astype(bool) & (lens > 0)
+        )
+        swap_at = first_pos[swap]
+        swap_at = swap_at[data[swap_at] == np.uint8(ord("?"))]
+        data[swap_at] = np.uint8(ord("&"))
 
     null_bitmap = np.packbits(valid, bitorder="little")
     # pa.py_buffer wraps the numpy arrays zero-copy (buffer protocol);
@@ -90,7 +98,7 @@ def _column_to_arrow(result: "BatchResult", field_id: str):
     overrides = result._overrides.get(field_id, {})
     B = result.lines_read
 
-    if kind in _NUMERIC_KINDS and not any(
+    if kind == "numeric" and not any(
         isinstance(v, (str, dict)) for v in overrides.values()
     ):
         values = np.asarray(col["values"], dtype=np.int64).copy()
@@ -112,8 +120,15 @@ def _column_to_arrow(result: "BatchResult", field_id: str):
     # Device span columns with no host overrides: build the StringArray
     # straight from (offsets, gathered bytes) with numpy — no per-row
     # Python.  Falls through to the slow path for override rows (host
-    # fallback), wildcard maps, and non-UTF-8 data.
-    if kind == "span" and not field_id.endswith(".*") and not overrides:
+    # fallback), rows needing URI micro-materialization (`fix`), wildcard
+    # maps, and non-UTF-8 data.
+    fix = col.get("fix")
+    if (
+        kind == "span"
+        and not field_id.endswith(".*")
+        and not overrides
+        and (fix is None or not fix[: result.lines_read].any())
+    ):
         arr = _spans_to_string_array(result, col)
         if arr is not None:
             return arr

@@ -53,9 +53,7 @@ from .program import (
     compile_device_program,
 )
 from .runtime import encode_batch
-from . import postproc
-
-_NUMERIC_KINDS = {"long", "long_clf_null", "long_clf_zero", "epoch"}
+from . import postproc, timefields
 
 # Back-compat alias (plan resolution lives here; packing in pipeline.py).
 _FieldPlan = FieldPlan
@@ -74,6 +72,20 @@ def _default_use_pallas() -> bool:
     return False
 
 
+def _fix_uri_part(value: str, mode: str) -> str:
+    """Per-row URI micro-materialization for device `fix` rows: the exact
+    host repair semantics, applied to one sub-span instead of re-parsing
+    the whole line (HttpUriDissector.java:166-167 %-repair; java.net.URI
+    path decode).  The %-repair runs twice like the host (overlaps)."""
+    from ..dissectors.uri import _BAD_ESCAPE_PATTERN, _percent_decode
+
+    value = _BAD_ESCAPE_PATTERN.sub(r"%25\1", value)
+    value = _BAD_ESCAPE_PATTERN.sub(r"%25\1", value)
+    if mode == "path":
+        value = _percent_decode(value)
+    return value
+
+
 class _CollectingRecord:
     """Host-fallback record capturing every delivered value by field id."""
 
@@ -88,7 +100,11 @@ class BatchResult:
     """Columnar parse result over one batch."""
 
     def __init__(self, lines, buf, lengths, valid, columns, overrides, good, bad,
-                 format_index=None):
+                 format_index=None, oracle_rows=0):
+        # Lines the host oracle had to visit (device-invalid lines plus
+        # lines whose winning format left requested fields device-unresolved)
+        # — the number bench.py reports as oracle_fraction.
+        self.oracle_rows = oracle_rows
         self._lines = lines
         self.buf = buf                  # np [B, L] uint8
         self.lengths = lengths
@@ -130,20 +146,30 @@ class BatchResult:
             if not self.valid[i] or not col["ok"][i]:
                 out.append(None)
                 continue
-            if kind in _NUMERIC_KINDS:
+            if kind == "numeric":
                 if col["null"][i]:
                     # Per-line CLF-zero semantics: the format that won the
                     # line decides whether '-' means 0 or null.
                     out.append(0 if col["null_zero"][i] else None)
                 else:
                     out.append(int(col["values"][i]))
+            elif kind == "obj":
+                v = col["values"][i]
+                out.append(v.item() if isinstance(v, np.generic) else v)
             else:
+                if col["null"][i]:
+                    # Device-computed null: CLF '-' token captures and
+                    # undelivered URI parts.
+                    out.append(None)
+                    continue
                 start, end = int(col["starts"][i]), int(col["ends"][i])
                 raw = bytes(self.buf[i, start:end])
-                if raw == b"-":
-                    out.append(None)  # decode_extracted_value: '-' -> null
-                else:
-                    out.append(raw.decode("utf-8", errors="replace"))
+                if col.get("amp") is not None and col["amp"][i] and raw[:1] == b"?":
+                    raw = b"&" + raw[1:]  # the ?& query normalization
+                value = raw.decode("utf-8", errors="replace")
+                if col.get("fix") is not None and col["fix"][i]:
+                    value = _fix_uri_part(value, col["fix_mode"])
+                out.append(value)
         return out
 
     def to_dict(self) -> Dict[str, List[Any]]:
@@ -192,32 +218,31 @@ class TpuBatchParser:
         self.oracle.add_parse_target("set_value", list(self.requested))
         self.oracle.assemble_dissectors()
 
-        # Whole-path type-converter edges (translators with an empty output
-        # name), transitively closed: every (T1 -> T2) pair means a token
-        # emitting T1:path is a PRODUCER of T2:path in the oracle graph.
-        # _resolve must count those or multi-producer fields (e.g.
-        # $time_local + $msec both feeding TIME.EPOCH:...epoch) would be
-        # silently claimed by one device route.
-        edges = set()
+        # Consumer registry for device plan resolution: every non-root
+        # dissector, keyed by input type, deduped per class in registration
+        # order (mirroring the engine's one-instance-per-class-per-node rule
+        # in Parser._find_useful_dissectors).  _resolve chases token outputs
+        # through this registry, so EVERY producer of a requested field is
+        # counted — fields with more than one producer in the oracle graph
+        # (e.g. $time_local + $msec both feeding TIME.EPOCH:...epoch) must
+        # resolve to "host": the oracle delivers every value in graph order
+        # and the record keeps the last, which a single device route would
+        # silently break.
+        fmt_root = self.oracle.all_dissectors[0]
+        self._consumers: Dict[str, List[Any]] = {}
+        seen_consumer = set()
         for d in self.oracle.all_dissectors:
-            # No try/except: a dissector whose get_possible_output() raises
-            # would silently drop its converter edge, letting a device plan
-            # claim a multi-producer field — fail loudly at construction.
-            outs = d.get_possible_output()
-            for o in outs:
-                out_type, _, name = o.partition(":")
-                if name == "":
-                    edges.add((d.get_input_type(), out_type))
-        closed = set(edges)
-        changed = True
-        while changed:
-            changed = False
-            for a, b in list(closed):
-                for c, dst in edges:
-                    if c == b and (a, dst) not in closed:
-                        closed.add((a, dst))
-                        changed = True
-        self._converter_edges = closed
+            if d is fmt_root:
+                continue
+            # No try/except around get_possible_output(): a raising
+            # dissector would silently drop producer edges, letting a
+            # device plan claim a multi-producer field — fail loudly.
+            d.get_possible_output()
+            key = (d.get_input_type(), type(d))
+            if key in seen_consumer:
+                continue
+            seen_consumer.add(key)
+            self._consumers.setdefault(d.get_input_type(), []).append(d)
 
         # Device programs: one FormatUnit per registered format, in
         # registration order (SURVEY §7.7 "run k format automata, pick the
@@ -300,12 +325,14 @@ class TpuBatchParser:
         return _FieldPlan(field_id, "host")
 
     @staticmethod
-    def _kind_group(kind: str) -> str:
-        """Merge group: kinds in the same group share column arrays."""
-        if kind in ("span", "fl_method", "fl_uri", "fl_protocol"):
+    def _plan_group(plan: _FieldPlan) -> str:
+        """Merge group: plans in the same group share column arrays."""
+        if plan.kind == "span":
             return "span"
-        if kind in _NUMERIC_KINDS:  # long variants + epoch
+        if plan.kind in ("long", "secmillis"):
             return "numeric"
+        if plan.kind == "ts":
+            return "numeric" if timefields.is_numeric_output(plan.comp) else "obj"
         return "host"
 
     def _unit_decodable(self, unit: FormatUnit, field_id: str) -> bool:
@@ -313,60 +340,177 @@ class TpuBatchParser:
         merged = self.plan_by_id[field_id]
         if merged.kind == "host":
             return False
-        return self._kind_group(unit.plan_for(field_id).kind) == self._kind_group(
-            merged.kind
+        return self._plan_group(unit.plan_for(field_id)) == self._plan_group(
+            merged
         )
 
+    # -- device plan resolution ----------------------------------------
+
     def _resolve(self, program: DeviceProgram, field_id: str) -> _FieldPlan:
-        """Map one requested field to its device plan — or "host" when the
-        field has MORE THAN ONE producer in the dissector graph.  With
-        multiple producers (e.g. `%B ... %b`: the direct BYTESCLF token plus
-        the ConvertNumberIntoCLF edge from the BYTES token both feed
+        """Map one requested field to its device plan by chasing every
+        token output through the consumer registry (the device-compiler
+        mirror of Parser._find_useful_dissectors).
+
+        A field is device-resolvable only when EXACTLY ONE chase path
+        produces it and every step of that path is device-modeled.  With
+        multiple producers (e.g. `%B ... %b`: the direct BYTESCLF token
+        plus the ConvertNumberIntoCLF edge from the BYTES token both feed
         BYTESCLF:response.body.bytes) the oracle delivers every value in
-        graph order and the record keeps the last; a single-token device
+        graph order and the record keeps the last; a single-path device
         plan would silently pick one — so such fields go to the oracle."""
         ftype, _, path = field_id.partition(":")
         candidates: List[_FieldPlan] = []
         for tok in program.tokens:
             for out_type, out_name in tok.outputs:
-                if out_name == path:
-                    if out_type == ftype:
-                        if tok.charset == CS_DIGITS:
-                            kind = "long"
-                        elif tok.charset == CS_CLF_DIGITS:
-                            kind = "long_clf_null"
-                        else:
-                            kind = "span"
-                        candidates.append(_FieldPlan(field_id, kind, tok.index))
-                    elif out_type == "BYTESCLF" and ftype == "BYTES":
-                        # CLF -> number translator edge (device-modeled)
-                        candidates.append(
-                            _FieldPlan(field_id, "long_clf_zero", tok.index)
-                        )
-                    elif (out_type, ftype) in self._converter_edges:
-                        # Any other whole-path converter edge: a real
-                        # producer in the oracle graph; not device-modeled.
-                        candidates.append(_FieldPlan(field_id, "host"))
-                elif path.startswith(out_name + "."):
-                    suffix = path[len(out_name) + 1 :]
-                    if out_type == "TIME.STAMP" and ftype == "TIME.EPOCH" and suffix == "epoch":
-                        candidates.append(_FieldPlan(field_id, "epoch", tok.index))
-                    elif out_type == "HTTP.FIRSTLINE":
-                        if ftype == "HTTP.METHOD" and suffix == "method":
-                            candidates.append(
-                                _FieldPlan(field_id, "fl_method", tok.index)
-                            )
-                        elif ftype == "HTTP.URI" and suffix == "uri":
-                            candidates.append(
-                                _FieldPlan(field_id, "fl_uri", tok.index)
-                            )
-                        elif ftype == "HTTP.PROTOCOL_VERSION" and suffix == "protocol":
-                            candidates.append(
-                                _FieldPlan(field_id, "fl_protocol", tok.index)
-                            )
+                candidates.extend(
+                    self._chase(
+                        field_id, ftype, path, tok, out_type, out_name,
+                        vctx=("", "", 1), steps=(), device_ok=True,
+                        depth=6, visited=frozenset(),
+                    )
+                )
         if len(candidates) == 1 and candidates[0].kind != "host":
             return candidates[0]
         return _FieldPlan(field_id, "host")
+
+    def _terminal_plan(
+        self, field_id: str, tok, vctx, steps, device_ok
+    ) -> _FieldPlan:
+        """Build the plan for a chase path that reached the requested field.
+        vctx = (parse, null_mode, scale) accumulated value conversions."""
+        if not device_ok:
+            return _FieldPlan(field_id, "host")
+        parse, null_mode, scale = vctx
+        if parse == "":
+            # No value conversion: a raw (sub-)span.  Direct token captures
+            # with a numeric charset deliver typed int64 (the reference
+            # types them via Casts at the setter).
+            if steps:
+                return _FieldPlan(field_id, "span", tok.index, steps)
+            if tok.charset == CS_DIGITS:
+                return _FieldPlan(field_id, "long", tok.index)
+            if tok.charset == CS_CLF_DIGITS:
+                return _FieldPlan(
+                    field_id, "long", tok.index, null_mode="dash_null"
+                )
+            return _FieldPlan(field_id, "span", tok.index)
+        return _FieldPlan(
+            field_id, parse, tok.index, steps, null_mode=null_mode, scale=scale
+        )
+
+    def _step_spec(self, d, oname: str, vctx, steps, device_ok):
+        """How consumer dissector `d` transforms a chase path for output
+        `oname`.  Returns (kind, new_vctx, new_steps, new_device_ok, comp,
+        meta) where kind is "value" (value-level), "span" (span transform)
+        or "ts" (terminal timestamp component)."""
+        from ..dissectors.firstline import HttpFirstLineDissector
+        from ..dissectors.strftime_stamp import StrfTimeStampDissector
+        from ..dissectors.timestamp import TimeStampDissector
+        from ..dissectors.uri import HttpUriDissector
+        from ..dissectors.translate import (
+            ConvertCLFIntoNumber,
+            ConvertMillisecondsIntoMicroseconds,
+            ConvertNumberIntoCLF,
+            ConvertSecondsWithMillisStringDissector,
+        )
+        from .timeparse import compile_layout_for_device
+
+        parse, null_mode, scale = vctx
+        if isinstance(d, ConvertCLFIntoNumber) and parse == "":
+            return ("value", ("long", "dash_zero", scale), steps, device_ok)
+        if isinstance(d, ConvertNumberIntoCLF) and parse == "":
+            return ("value", ("long", "zero_null", scale), steps, device_ok)
+        if isinstance(d, ConvertSecondsWithMillisStringDissector) and parse == "":
+            return ("value", ("secmillis", "", scale), steps, device_ok)
+        if isinstance(d, ConvertMillisecondsIntoMicroseconds):
+            new_parse = parse or "long"
+            return ("value", (new_parse, null_mode, scale * 1000), steps, device_ok)
+        if isinstance(d, HttpFirstLineDissector) and parse == "":
+            part = {"method": "method", "uri": "uri", "protocol": "protocol"}.get(
+                oname
+            )
+            if part is not None:
+                return ("span", vctx, steps + (("fl", part),), device_ok)
+        if isinstance(d, HttpUriDissector) and parse == "":
+            if oname in (
+                "protocol", "userinfo", "host", "port", "path", "query", "ref"
+            ):
+                return ("span", vctx, steps + (("uri", oname),), device_ok)
+        if isinstance(d, (TimeStampDissector, StrfTimeStampDissector)) and parse == "":
+            if oname in timefields.DEVICE_COMPONENTS:
+                inner = (
+                    d.timestamp_dissector
+                    if isinstance(d, StrfTimeStampDissector)
+                    else d
+                )
+                try:
+                    dl = compile_layout_for_device(inner.get_layout())
+                except ValueError:
+                    dl = None  # pattern the layout compiler rejects: host
+                if dl is not None:
+                    return ("ts", vctx, steps, device_ok, oname, dl)
+            return ("ts", vctx, steps, False, oname, None)
+        # Not device-modeled: the path still counts as a producer.
+        return ("value", vctx, steps, False)
+
+    def _chase(
+        self, field_id, ftype, path, tok, t, name,
+        vctx, steps, device_ok, depth, visited,
+    ) -> List[_FieldPlan]:
+        """All ways field (t:name) — reachable from `tok` via `steps` and
+        `vctx` — leads to the requested (ftype:path).  Device plans where
+        every step is modeled; "host" placeholders otherwise (they count
+        toward the multi-producer guard)."""
+        plans: List[_FieldPlan] = []
+        if t == ftype and name == path:
+            plans.append(self._terminal_plan(field_id, tok, vctx, steps, device_ok))
+            return plans
+        if depth == 0 or (t, name) in visited:
+            return plans
+        visited = visited | {(t, name)}
+        relevant = name == "" or path == name or path.startswith(name + ".")
+        if not relevant:
+            return plans
+        for d in self._consumers.get(t, ()):
+            for out in d.get_possible_output():
+                ot, _, oname = out.partition(":")
+                if oname == "*":
+                    # Wildcard outputs (query-string/cookies): any requested
+                    # path under this prefix is produced here.
+                    if ot == ftype and path.startswith(name + "."):
+                        plans.append(_FieldPlan(field_id, "host"))
+                    continue
+                if oname == "":
+                    new_name = name
+                else:
+                    new_name = name + "." + oname if name else oname
+                if not (path == new_name or path.startswith(new_name + ".")):
+                    continue
+                spec = self._step_spec(d, oname, vctx, steps, device_ok)
+                kind = spec[0]
+                if kind == "ts":
+                    _, nctx, nsteps, ndev, comp, dl = spec
+                    if path == new_name and ot == ftype:
+                        if ndev:
+                            plans.append(_FieldPlan(
+                                field_id, "ts", tok.index, nsteps,
+                                comp=comp, meta=dl,
+                            ))
+                        else:
+                            plans.append(_FieldPlan(field_id, "host"))
+                    # ts outputs are terminal values; nothing deeper.
+                    continue
+                _, nctx, nsteps, ndev = spec
+                if path == new_name and ot == ftype:
+                    plans.append(
+                        self._terminal_plan(field_id, tok, nctx, nsteps, ndev)
+                    )
+                else:
+                    plans.extend(self._chase(
+                        field_id, ftype, path, tok, ot, new_name,
+                        nctx, nsteps, ndev, depth - 1, visited,
+                    ))
+        return plans
 
     # ------------------------------------------------------------------
 
@@ -436,9 +580,27 @@ class TpuBatchParser:
         # a multi-ms batch) so a tracer enabled mid-batch still records real
         # durations; trace.add() itself no-ops when disabled.
         t_columns = time.perf_counter()
+        ts_cache: Dict[tuple, tuple] = {}
+
+        def unit_ts(u: FormatUnit, ui: int, plan: _FieldPlan):
+            """Decoded timestamp component bundle, cached per (unit, token,
+            steps) so N requested outputs of one timestamp decode it once."""
+            from .pipeline import ts_group_key
+
+            key = (ui, ts_group_key(plan))
+            got = ts_cache.get(key)
+            if got is None:
+                block = packed[u.row_offset : u.row_offset + u.layout.n_rows]
+                comp, ok = u.layout.get_ts_components(block, plan)
+                # Third element: the derive() memo sharing epoch/UTC/ISO
+                # intermediates across this bundle's requested outputs.
+                got = ({k: v[:B] for k, v in comp.items()}, ok[:B], {})
+                ts_cache[key] = got
+            return got
+
         for fid in self.requested:
             merged = self.plan_by_id[fid]
-            group = self._kind_group(merged.kind)
+            group = self._plan_group(merged)
             if packed is None or group == "host":
                 columns[fid] = {
                     "kind": "span",
@@ -454,11 +616,28 @@ class TpuBatchParser:
                     "starts": np.zeros(B, dtype=np.int32),
                     "ends": np.zeros(B, dtype=np.int32),
                     "ok": np.zeros(B, dtype=bool),
+                    "null": np.zeros(B, dtype=bool),
+                    "amp": np.zeros(B, dtype=bool),
+                    "fix": np.zeros(B, dtype=bool),
+                    # Which per-row micro-materialization `fix` rows need:
+                    # the final uri chain step decides (path: %-repair +
+                    # percent-decode; query: %-repair only).
+                    "fix_mode": (
+                        merged.steps[-1][1]
+                        if merged.steps and merged.steps[-1][0] == "uri"
+                        else ""
+                    ),
+                }
+            elif group == "obj":
+                col = {
+                    "kind": "obj",
+                    "values": np.full(B, None, dtype=object),
+                    "ok": np.zeros(B, dtype=bool),
                     "null": zeros_null,
                 }
             else:
                 col = {
-                    "kind": merged.kind,
+                    "kind": "numeric",
                     "values": np.zeros(B, dtype=np.int64),
                     "null": np.zeros(B, dtype=bool),
                     "null_zero": np.zeros(B, dtype=bool),
@@ -480,34 +659,38 @@ class TpuBatchParser:
                     col["ok"] = np.where(
                         sel, unit_get(u, fid, "ok") != 0, col["ok"]
                     )
-                elif plan.kind == "epoch":
-                    col["values"] = np.where(
-                        sel,
-                        postproc.combine_epoch(
-                            unit_get(u, fid, "days"), unit_get(u, fid, "sec")
-                        ),
-                        col["values"],
+                    col["null"] = np.where(
+                        sel, unit_get(u, fid, "null") != 0, col["null"]
                     )
-                    col["ok"] = np.where(
-                        sel, unit_get(u, fid, "ok") != 0, col["ok"]
+                    col["amp"] = np.where(
+                        sel, unit_get(u, fid, "amp") != 0, col["amp"]
                     )
-                else:  # long variants
+                    col["fix"] = np.where(
+                        sel, unit_get(u, fid, "fix") != 0, col["fix"]
+                    )
+                elif plan.kind == "ts":
+                    comp, ok, memo = unit_ts(u, ui, plan)
+                    values = timefields.derive(comp, plan.comp, memo)
+                    col["values"] = np.where(sel, values, col["values"])
+                    col["ok"] = np.where(sel, ok, col["ok"])
+                else:  # long / secmillis
                     is_null = unit_get(u, fid, "null") != 0
-                    col["values"] = np.where(
-                        sel,
-                        postproc.combine_long_limbs(
-                            unit_get(u, fid, "hi"),
-                            unit_get(u, fid, "lo"),
-                            unit_get(u, fid, "lo_digits"),
-                            is_null,
-                        ),
-                        col["values"],
+                    values = postproc.combine_long_limbs(
+                        unit_get(u, fid, "hi"),
+                        unit_get(u, fid, "lo"),
+                        unit_get(u, fid, "lo_digits"),
+                        is_null,
                     )
+                    if plan.scale != 1:
+                        values = values * plan.scale
+                    if plan.null_mode == "zero_null":
+                        is_null = is_null | (values == 0)
+                    col["values"] = np.where(sel, values, col["values"])
                     col["null"] = np.where(sel, is_null, col["null"])
                     col["ok"] = np.where(
                         sel, unit_get(u, fid, "ok") != 0, col["ok"]
                     )
-                    if plan.kind == "long_clf_zero":
+                    if plan.null_mode == "dash_zero":
                         col["null_zero"] = np.where(sel, True, col["null_zero"])
             columns[fid] = col
         trace.add("columns", time.perf_counter() - t_columns, items=B)
@@ -523,12 +706,12 @@ class TpuBatchParser:
             # falls through to the casts-based dispatch below — the
             # reference types such values by the producing dissector's
             # casts, not by another format's device plan.
-            kind = (
-                self.units[winner_index].plan_for(fid).kind
+            plan = (
+                self.units[winner_index].plan_for(fid)
                 if winner_index >= 0
-                else self.plan_by_id[fid].kind
+                else self.plan_by_id[fid]
             )
-            if kind in _NUMERIC_KINDS:
+            if self._plan_group(plan) == "numeric":
                 try:
                     return int(value)
                 except (TypeError, ValueError):
@@ -597,7 +780,7 @@ class TpuBatchParser:
         good = int(B - bad)
         return BatchResult(
             list(lines), buf[:B], lengths[:B], valid, columns, overrides,
-            good, bad, format_index=winner[:B],
+            good, bad, format_index=winner[:B], oracle_rows=len(need_oracle),
         )
 
     def _run_oracle(self, line: Union[bytes, str]) -> Optional[Dict[str, Any]]:

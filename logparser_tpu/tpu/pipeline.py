@@ -31,18 +31,36 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import postproc
+from . import postproc, timeparse
 from .program import CS_ANY, DeviceProgram
 
 
-@dataclass
+@dataclass(frozen=True)
 class FieldPlan:
-    """How one requested field is produced on device ('host' = oracle-only)."""
+    """How one requested field is produced on device ('host' = oracle-only).
+
+    A plan is a token capture plus a chain of span-transform ``steps``
+    (the device analogues of sub-dissectors: first-line split, URI split,
+    ...) ending in a terminal decode ``kind``:
+
+    - ``span``      the final sub-span itself (string field)
+    - ``long``      digit span -> int64 (null_mode handles the CLF '-' and
+                    zero<->null converter semantics)
+    - ``secmillis`` "sec.millis" decimal span -> epoch millis
+    - ``ts``        fixed-layout timestamp -> component bundle; ``comp``
+                    names the requested output (epoch/year/.../monthname)
+                    and ``meta`` carries the DeviceTimeLayout
+    - ``host``      oracle-only
+    """
 
     field_id: str                 # cleaned "TYPE:path"
-    kind: str                     # span | long | long_clf_null | long_clf_zero
-    #                             | epoch | fl_method | fl_uri | fl_protocol | host
+    kind: str                     # span | long | secmillis | ts | host
     token_index: int = -1
+    steps: Tuple[Tuple[str, str], ...] = ()   # e.g. (("fl", "uri"),)
+    comp: str = ""                # ts output name
+    meta: object = None           # ts: DeviceTimeLayout
+    null_mode: str = ""           # "" | dash_null | dash_zero | zero_null
+    scale: int = 1                # value multiplier (ms -> us converters)
 
 
 # ---------------------------------------------------------------------------
@@ -50,14 +68,7 @@ class FieldPlan:
 # ---------------------------------------------------------------------------
 
 
-def shift_zero(x: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Left-shift columns by k, zero-filling the tail (plain-XLA path)."""
-    if k <= 0:
-        return x
-    B, L = x.shape
-    if k >= L:
-        return jnp.zeros_like(x)
-    return jnp.concatenate([x[:, k:], jnp.zeros((B, k), x.dtype)], axis=1)
+from .postproc import shift_zero  # the shared zero-fill shift primitive
 
 
 def shift_wrap(x: jnp.ndarray, k: int) -> jnp.ndarray:
@@ -274,14 +285,24 @@ def compute_split(
 # ---------------------------------------------------------------------------
 
 _SPAN_BITS = 13          # start / len each; supports L up to 8191
-_SPAN_KINDS = ("span", "fl_method", "fl_uri", "fl_protocol")
 
 Slot = Tuple[int, int, int]   # (row, shift, bits); bits=0 -> full int32 row
 
 
+def ts_group_key(plan: FieldPlan) -> str:
+    """All ts plans over the same token+steps share one component bundle."""
+    return f"@ts:{plan.token_index}:{plan.steps!r}"
+
+
 @dataclass
 class PackedLayout:
-    """Bit-slot map for the packed [K, B] int32 output (row 0 = validity)."""
+    """Bit-slot map for the packed [K, B] int32 output (row 0 = validity).
+
+    Timestamp component bundles are shared: every ``ts`` plan on the same
+    (token, steps) maps to one ``@ts:...`` slot group with rows
+    ``c1`` (year|month|day|hour), ``c2`` (minute|second|milli), ``off``
+    (raw UTC offset seconds) and an ``ok`` bit.
+    """
 
     slots: Dict[str, Dict[str, Slot]] = dataclass_field(default_factory=dict)
     n_rows: int = 1
@@ -289,20 +310,28 @@ class PackedLayout:
     @classmethod
     def for_plans(cls, plans: Sequence[FieldPlan]) -> "PackedLayout":
         layout = cls()
-        aux_needs: List[Tuple[str, str, int]] = []  # (field_id, comp, bits)
+        aux_needs: List[Tuple[str, str, int]] = []  # (slot_key, comp, bits)
         for plan in plans:
             kind = plan.kind
             if kind == "host":
                 continue
-            if kind in _SPAN_KINDS:
+            if kind == "span":
                 r = layout.n_rows
                 layout.n_rows += 1
                 layout.slots[plan.field_id] = {
                     "start": (r, 0, _SPAN_BITS),
                     "len": (r, _SPAN_BITS, _SPAN_BITS),
                     "ok": (r, 2 * _SPAN_BITS, 1),
+                    # null: the value is absent/None (CLF '-' on direct
+                    # token captures; undelivered URI parts).  amp: the
+                    # span's leading '?' renders as '&' (query
+                    # normalization).  fix: the row needs per-row host
+                    # micro-materialization (%-repair / path decode).
+                    "null": (r, 2 * _SPAN_BITS + 1, 1),
+                    "amp": (r, 2 * _SPAN_BITS + 2, 1),
+                    "fix": (r, 2 * _SPAN_BITS + 3, 1),
                 }
-            elif kind in ("long", "long_clf_null", "long_clf_zero"):
+            elif kind in ("long", "secmillis"):
                 rhi, rlo = layout.n_rows, layout.n_rows + 1
                 layout.n_rows += 2
                 layout.slots[plan.field_id] = {
@@ -314,14 +343,17 @@ class PackedLayout:
                     (plan.field_id, "null", 1),
                     (plan.field_id, "lo_digits", 4),
                 ]
-            elif kind == "epoch":
-                rd, rs = layout.n_rows, layout.n_rows + 1
-                layout.n_rows += 2
-                layout.slots[plan.field_id] = {
-                    "days": (rd, 0, 0),
-                    "sec": (rs, 0, 0),
-                }
-                aux_needs.append((plan.field_id, "ok", 1))
+            elif kind == "ts":
+                key = ts_group_key(plan)
+                if key not in layout.slots:
+                    r = layout.n_rows
+                    layout.n_rows += 3
+                    layout.slots[key] = {
+                        "c1": (r, 0, 0),
+                        "c2": (r + 1, 0, 0),
+                        "off": (r + 2, 0, 0),
+                    }
+                    aux_needs.append((key, "ok", 1))
             else:  # pragma: no cover
                 raise AssertionError(kind)
         # Pack aux bits into shared meta rows (30 usable bits per row: the
@@ -347,6 +379,28 @@ class PackedLayout:
             return col
         return (col >> shift) & ((1 << bits) - 1)
 
+    def get_ts_components(self, packed: np.ndarray, plan: FieldPlan):
+        """Decode a ts plan's shared component bundle -> (components, ok).
+
+        Bit layout written by compute_rows: c1 = year | month<<14 | day<<18
+        | hour<<23; c2 = minute | second<<6 | milli<<12; off = raw int32.
+        """
+        key = ts_group_key(plan)
+        c1 = self.get(packed, key, "c1")
+        c2 = self.get(packed, key, "c2")
+        comp = {
+            "year": (c1 & 0x3FFF).astype(np.int64),
+            "month": ((c1 >> 14) & 0xF).astype(np.int64),
+            "day": ((c1 >> 18) & 0x1F).astype(np.int64),
+            "hour": ((c1 >> 23) & 0x1F).astype(np.int64),
+            "minute": (c2 & 0x3F).astype(np.int64),
+            "second": ((c2 >> 6) & 0x3F).astype(np.int64),
+            "milli": ((c2 >> 12) & 0x3FF).astype(np.int64),
+            "offset_seconds": self.get(packed, key, "off").astype(np.int64),
+        }
+        ok = self.get(packed, key, "ok") != 0
+        return comp, ok
+
 
 def compute_rows(
     program: DeviceProgram,
@@ -366,11 +420,10 @@ def compute_rows(
     starts, ends, valid, plausible = compute_split(
         program, b32, lengths, shift_fn, need_plausible
     )
-    extract = None if shift_fn is shift_zero else make_extract(shift_fn)
+    extract_fn = make_extract(shift_fn) if shift_fn is not shift_zero else None
+    extract = extract_fn or postproc.gather_span_bytes
 
     rows: List[Optional[jnp.ndarray]] = [None] * layout.n_rows
-    fl_cache: Dict[int, Dict[str, jnp.ndarray]] = {}
-    ones = jnp.ones(B, dtype=jnp.int32)
 
     def put(fid: str, comp: str, val: jnp.ndarray) -> None:
         row, shift, bits = layout.slots[fid][comp]
@@ -379,55 +432,156 @@ def compute_rows(
             v = (v & ((1 << bits) - 1)) << shift
         rows[row] = v if rows[row] is None else (rows[row] | v)
 
-    def put_span(fid: str, s, e, ok) -> None:
+    def put_span(fid: str, s, e, ok, null=None, amp=None, fix=None) -> None:
         put(fid, "start", s)
         put(fid, "len", e - s)
-        put(fid, "ok", ok)
+        put(fid, "ok", jnp.where(ok, 1, 0))
+        if null is not None:
+            put(fid, "null", jnp.where(null, 1, 0))
+        if amp is not None:
+            put(fid, "amp", jnp.where(amp, 1, 0))
+        if fix is not None:
+            put(fid, "fix", jnp.where(fix, 1, 0))
 
+    # ---- span-transform chains (device sub-dissectors) ----------------
+    # chain(token, steps) -> (start, end, ok, null, amp); each prefix is
+    # computed once.  Steps may also constrain LINE validity (a URI the
+    # repair chain would rewrite must send the whole line to the oracle,
+    # which re-applies the exact repair semantics).
+    fl_cache: Dict[tuple, Dict[str, jnp.ndarray]] = {}
+    uri_cache: Dict[tuple, Dict[str, jnp.ndarray]] = {}
+    chain_cache: Dict[tuple, tuple] = {}
+    line_constraints: List[jnp.ndarray] = []
+    false_b = jnp.zeros(B, dtype=bool)
+
+    def run_step(step: Tuple[str, str], s, e, ok, cache_key):
+        name, part = step
+        if name == "fl":
+            fl = fl_cache.get(cache_key)
+            if fl is None:
+                fl = postproc.split_firstline(
+                    b32, lengths, s, e, extract=extract_fn
+                )
+                fl_cache[cache_key] = fl
+            if part == "protocol":
+                step_ok = fl["ok"] & fl["has_protocol"]
+                return (fl["proto_start"], fl["proto_end"], ok & step_ok,
+                        false_b, false_b, false_b)
+            return (
+                fl[f"{part}_start"], fl[f"{part}_end"], ok & fl["ok"],
+                false_b, false_b, false_b,
+            )
+        if name == "uri":
+            uri = uri_cache.get(cache_key)
+            if uri is None:
+                uri = postproc.split_uri_fast(
+                    b32, s, e, extract=extract, shift_fn=shift_fn
+                )
+                uri_cache[cache_key] = uri
+                # Repair-needing URIs fail the line (unless the chain
+                # already produced nothing to repair).
+                line_constraints.append(uri["ok"] | ~ok)
+            step_ok = ok & uri["ok"]
+            if part == "path":
+                return (
+                    uri["path_start"], uri["path_end"], step_ok,
+                    uri["empty"], false_b, uri["path_fix"],
+                )
+            if part == "query":
+                return (
+                    uri["query_start"], uri["query_end"], step_ok,
+                    uri["empty"], uri["query_amp"], uri["query_fix"],
+                )
+            # protocol/userinfo/host/port/ref: never delivered on the
+            # relative fast path -> null span.
+            return s, s, step_ok, jnp.ones(B, dtype=bool), false_b, false_b
+        raise AssertionError(step)  # pragma: no cover
+
+    def chain_spans(token_index: int, steps):
+        key = (token_index, steps)
+        got = chain_cache.get(key)
+        if got is not None:
+            return got
+        if steps:
+            s, e, ok, _, _, _ = chain_spans(token_index, steps[:-1])
+            s, e, ok, null, amp, fix = run_step(
+                steps[-1], s, e, ok, key[:1] + steps[:-1]
+            )
+        else:
+            s, e = starts[token_index], ends[token_index]
+            ok = jnp.ones(B, dtype=bool)
+            null = amp = fix = None
+        chain_cache[key] = (s, e, ok, null, amp, fix)
+        return s, e, ok, null, amp, fix
+
+    ts_done = set()
     for plan in plans:
         if plan.kind == "host":
             continue
-        t_start = starts[plan.token_index]
-        t_end = ends[plan.token_index]
+        s, e, chain_ok, null, amp, fix = chain_spans(plan.token_index, plan.steps)
         if plan.kind == "span":
-            put_span(plan.field_id, t_start, t_end, ones)
-        elif plan.kind in ("long", "long_clf_null", "long_clf_zero"):
-            (hi, lo, lo_digits), is_null, ok = postproc.parse_long_spans(
-                b32, t_start, t_end, clf=plan.kind != "long", extract=extract
-            )
+            if not plan.steps:
+                # Direct token capture: CLF '-' means null
+                # (decode_extracted_value, ApacheHttpdLogFormatDissector
+                # :176-178 / NginxHttpdLogFormatDissector :107-119).
+                first = extract(b32, s, 1)[:, 0]
+                null = ((e - s) == 1) & (first == np.uint8(ord("-")))
+            put_span(plan.field_id, s, e, chain_ok, null, amp, fix)
+        elif plan.kind in ("long", "secmillis"):
+            if plan.kind == "secmillis":
+                (hi, lo, lo_digits), is_null, ok = postproc.parse_secmillis_spans(
+                    b32, s, e, extract=extract_fn
+                )
+            else:
+                (hi, lo, lo_digits), is_null, ok = postproc.parse_long_spans(
+                    b32, s, e,
+                    clf=plan.null_mode in ("dash_null", "dash_zero"),
+                    extract=extract_fn,
+                )
             put(plan.field_id, "hi", hi)
             put(plan.field_id, "lo", lo)
             put(plan.field_id, "lo_digits", lo_digits)
             put(plan.field_id, "ok", jnp.where(ok, 1, 0))
             put(plan.field_id, "null", jnp.where(is_null, 1, 0))
-        elif plan.kind == "epoch":
-            (days, sec), ok = postproc.parse_apache_timestamp(
-                b32, t_start, t_end, extract=extract
+            if not plan.steps:
+                # Direct token numerics: the split charset admitted the
+                # span, so a decode failure (>18 digits, malformed
+                # sec.millis) is exactly a case the host path types
+                # differently or rejects — route the line to the oracle.
+                valid = valid & (ok | ~chain_ok)
+            if plan.null_mode == "zero_null":
+                # ConvertNumberIntoCLF compares the STRING to "0": a span
+                # with leading zeros ("00", "007") passes through verbatim
+                # on the host, which the int64 column cannot represent —
+                # those rows go to the oracle.  After this exclusion,
+                # value==0 is exactly span=="0".
+                first = extract(b32, s, 1)[:, 0]
+                leading_zero = ((e - s) > 1) & (first == np.uint8(ord("0")))
+                valid = valid & ~(leading_zero & chain_ok)
+        elif plan.kind == "ts":
+            if ts_group_key(plan) in ts_done:
+                continue
+            ts_done.add(ts_group_key(plan))
+            comp, ok = timeparse.parse_device_timestamp(
+                b32, s, e, plan.meta, extract
             )
-            put(plan.field_id, "days", days)
-            put(plan.field_id, "sec", sec)
-            put(plan.field_id, "ok", jnp.where(ok, 1, 0))
+            key = ts_group_key(plan)
+            put(key, "c1",
+                comp["year"] | (comp["month"] << 14) | (comp["day"] << 18)
+                | (comp["hour"] << 23))
+            put(key, "c2",
+                comp["minute"] | (comp["second"] << 6) | (comp["milli"] << 12))
+            put(key, "off", comp["offset_seconds"])
+            put(key, "ok", jnp.where(ok, 1, 0))
             # A timestamp the host layout rejects raises DissectionFailure
-            # there, failing the whole line — mirror that: route the line to
-            # the oracle (which will reject it identically).
-            valid = valid & ok
-        elif plan.kind in ("fl_method", "fl_uri", "fl_protocol"):
-            if plan.token_index not in fl_cache:
-                fl_cache[plan.token_index] = postproc.split_firstline(
-                    b32, lengths, t_start, t_end, extract=extract
-                )
-            fl = fl_cache[plan.token_index]
-            part = plan.kind[3:]
-            if part == "protocol":
-                ok = fl["ok"] & fl["has_protocol"]
-                s, e = fl["proto_start"], fl["proto_end"]
-            else:
-                ok = fl["ok"]
-                s, e = fl[f"{part}_start"], fl[f"{part}_end"]
-            put_span(plan.field_id, s, e, jnp.where(ok, 1, 0))
+            # there, failing the whole line — mirror that: route the line
+            # to the oracle (which will reject it identically).
+            valid = valid & (ok | ~chain_ok)
         else:  # pragma: no cover
             raise AssertionError(plan.kind)
 
+    for constraint in line_constraints:
+        valid = valid & constraint
     row0 = jnp.where(valid, 1, 0).astype(jnp.int32)
     if plausible is not None:
         row0 = row0 | (jnp.where(plausible, 2, 0).astype(jnp.int32))

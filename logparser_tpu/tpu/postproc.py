@@ -3,13 +3,16 @@
 These replace the reference's per-line sub-dissectors on the hot path:
 - :func:`parse_long_spans` — digit spans -> int64 (CLF '-' aware), replacing
   Value.getLong / ConvertCLFIntoNumber.
-- :func:`parse_apache_timestamp` — ``dd/MMM/yyyy:HH:mm:ss ZZ`` spans ->
-  epoch millis, replacing TimeStampDissector's formatter parse for the fixed
-  Apache layout (TimeStampDissector.java:404-424).  Fixed offsets + a month
-  name lookup table + days-from-civil integer math: pure VPU arithmetic.
+- :func:`parse_secmillis_spans` — ``"1483455396.639"`` decimal spans ->
+  epoch-millis limbs, replacing ConvertSecondsWithMillisStringDissector
+  (nginx ``$msec``/``$request_time``).
 - :func:`split_firstline` — "GET /x HTTP/1.1" spans -> method/uri/protocol
   sub-spans (HttpFirstLineDissector.java:59-63 semantics: first space, last
   space, protocol validated as ``HTTP/``).
+
+Timestamp layouts are handled generically by ``tpu/timeparse.py`` (any
+fixed-width TimeLayout compiles to a device program); the epoch/derived
+output math happens host-side in ``tpu/timefields.py``.
 """
 from __future__ import annotations
 
@@ -22,10 +25,18 @@ import jax.numpy as jnp
 
 MAX_LONG_DIGITS = 18
 
-# Month names; matched via (l0*26 + l1)*26 + l2 hash compares in
-# parse_apache_timestamp.
-_MONTHS = ["jan", "feb", "mar", "apr", "may", "jun",
-           "jul", "aug", "sep", "oct", "nov", "dec"]
+
+def shift_zero(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Left-shift columns by k, zero-filling the tail.  The single shared
+    zero-fill shift primitive (pipeline re-exports it; the Pallas path
+    substitutes the lane-roll variant, which differs only in bytes past the
+    span end — every consumer masks those)."""
+    if k <= 0:
+        return x
+    B, L = x.shape
+    if k >= L:
+        return jnp.zeros_like(x)
+    return jnp.concatenate([x[:, k:], jnp.zeros((B, k), x.dtype)], axis=1)
 
 
 def _pad_cols(x: jnp.ndarray, w: int) -> jnp.ndarray:
@@ -109,115 +120,162 @@ def combine_long_limbs(hi, lo, lo_digits, is_null) -> np.ndarray:
     return value
 
 
-def _days_from_civil(y: jnp.ndarray, m: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
-    """Days since 1970-01-01 (proleptic Gregorian), vectorized int32/64."""
-    y = y - (m <= 2)
-    era = jnp.floor_divide(jnp.where(y >= 0, y, y - 399), 400)
-    yoe = y - era * 400
-    mp = jnp.mod(m + 9, 12)
-    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
-    doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100) + doy
-    return era * 146097 + doe - 719468
+def parse_secmillis_spans(
+    buf: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+    extract=None,
+) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """``"<seconds>.<3-digit millis>"`` spans -> epoch-millis int64 limbs.
 
-
-def _two_digits(b: jnp.ndarray, i: int) -> jnp.ndarray:
-    return (
-        (b[:, i] - np.uint8(ord("0"))).astype(jnp.int32) * 10
-        + (b[:, i + 1] - np.uint8(ord("0"))).astype(jnp.int32)
-    )
-
-
-def parse_apache_timestamp(
-    buf: jnp.ndarray, start: jnp.ndarray, end: jnp.ndarray, extract=None
-) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
-    """``dd/MMM/yyyy:HH:mm:ss +ZZZZ`` spans -> ((days, sec_of_day), ok).
-
-    Layout offsets: dd=0..1 /  MMM=3..5 / yyyy=7..10 : HH=12 : mm=15 : ss=18
-    ' ' sign=21 offHH=22 offMM=24.
+    The digit string with the dot removed IS the epoch-millis value
+    ("1483455396.639" -> 1483455396639), so this reuses the hi/lo limb
+    scheme of :func:`parse_long_spans`: returns ((hi, lo, lo_digits),
+    is_null, ok) with is_null always False.  ok requires the exact
+    ``[0-9]+\\.[0-9]{3}`` shape the host regex/converter accepts
+    (ConvertSecondsWithMillisStringDissector semantics).
     """
     extract = extract or gather_span_bytes
-    b = extract(buf, start, 26)
-    width_ok = (end - start) == 26
+    B = buf.shape[0]
+    w = end - start
+    # Up to 18 total digits + the dot.
+    bytes_ = extract(buf, start, MAX_LONG_DIGITS + 1)
+    nd = w - 1  # digit count (dot removed)
 
-    day = _two_digits(b, 0)
-    lower = b | np.uint8(0x20)
-    l0 = (lower[:, 3] - np.uint8(ord("a"))).astype(jnp.int32)
-    l1 = (lower[:, 4] - np.uint8(ord("a"))).astype(jnp.int32)
-    l2 = (lower[:, 5] - np.uint8(ord("a"))).astype(jnp.int32)
-    letters_ok = (
-        (l0 >= 0) & (l0 < 26) & (l1 >= 0) & (l1 < 26) & (l2 >= 0) & (l2 < 26)
-    )
-    # 12 vector compares instead of a table gather (TPU gathers are slow).
-    h = (l0 * 26 + l1) * 26 + l2
-    month = jnp.zeros(buf.shape[0], dtype=jnp.int32)
-    for m, name in enumerate(_MONTHS, start=1):
-        hm = ((ord(name[0]) - 97) * 26 + (ord(name[1]) - 97)) * 26 + (
-            ord(name[2]) - 97
+    hi = jnp.zeros(B, dtype=jnp.int32)
+    lo = jnp.zeros(B, dtype=jnp.int32)
+    digits_ok = jnp.ones(B, dtype=bool)
+    dot_ok = jnp.zeros(B, dtype=bool)
+    for i in range(MAX_LONG_DIGITS + 1):
+        in_span = i < w
+        is_dot = i == (w - 4)
+        d = (bytes_[:, i] - np.uint8(ord("0"))).astype(jnp.int32)
+        is_digit = (d >= 0) & (d <= 9)
+        digits_ok = digits_ok & (~in_span | is_dot | is_digit)
+        dot_ok = dot_ok | (
+            is_dot & (bytes_[:, i] == np.uint8(ord(".")))
         )
-        month = jnp.where(h == hm, m, month)
+        # Digit index with the dot removed: i before the dot, i-1 after.
+        j = jnp.where(i < (w - 4), i, i - 1)
+        take = in_span & ~is_dot
+        is_lo = take & (j >= (nd - 9))
+        is_hi = take & ~is_lo
+        hi = jnp.where(is_hi, hi * 10 + d, hi)
+        lo = jnp.where(is_lo, lo * 10 + d, lo)
 
-    year = (
-        (b[:, 7] - np.uint8(ord("0"))).astype(jnp.int32) * 1000
-        + (b[:, 8] - np.uint8(ord("0"))).astype(jnp.int32) * 100
-        + _two_digits(b, 9)
+    ok = (
+        (w >= 5)                       # at least one second digit + ".mmm"
+        & (nd <= MAX_LONG_DIGITS)
+        & digits_ok
+        & dot_ok
     )
-    hour = _two_digits(b, 12)
-    minute = _two_digits(b, 15)
-    second = _two_digits(b, 18)
+    is_null = jnp.zeros(B, dtype=bool)
+    return (hi, lo, jnp.minimum(nd, 9)), is_null, ok
 
-    sign = jnp.where(b[:, 21] == np.uint8(ord("-")), -1, 1).astype(jnp.int32)
-    off_h = _two_digits(b, 22)
-    off_m = _two_digits(b, 24)
-    offset_s = sign * (off_h * 3600 + off_m * 60)
 
-    seps_ok = (
-        (b[:, 2] == np.uint8(ord("/")))
-        & (b[:, 6] == np.uint8(ord("/")))
-        & (b[:, 11] == np.uint8(ord(":")))
-        & (b[:, 14] == np.uint8(ord(":")))
-        & (b[:, 17] == np.uint8(ord(":")))
-        & (b[:, 20] == np.uint8(ord(" ")))
-        & ((b[:, 21] == np.uint8(ord("+"))) | (b[:, 21] == np.uint8(ord("-"))))
+def split_uri_fast(
+    buf: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+    extract=None,
+    shift_fn=None,
+) -> Dict[str, jnp.ndarray]:
+    """Fast-path URI split: relative, repair-free URIs -> sub-spans.
+
+    Mirrors HttpUriDissector (dissectors/uri.py; HttpUriDissector.java:52-63)
+    for the common case — a path-relative URI that the repair chain would
+    pass through unchanged.  ``clean`` is False whenever ANY repair stage
+    could fire; such lines must be re-parsed by the host oracle (the caller
+    folds ``clean`` into line validity).  Conditions checked:
+
+    - no byte the URIUtil encode step would %-escape (control, space, DEL,
+      0xFF, ``{}|\\^[]`<>"``),
+    - no ``#`` (fragment handling, =#/#&/double-# artifacts rewrite),
+    - no ``;`` (sound over-approximation of the HTML-entity unescape:
+      every entity needs a ``;``),
+    - at most one ``?``, and only as the first query-separator occurrence
+      (otherwise the ?->& normalization rewrites bytes inside the span),
+    - leading ``/`` (absolute URLs take the authority-parsing host path).
+
+    Percent signs do NOT force the oracle: they only flag per-row host
+    micro-materialization (orders of magnitude cheaper than a full oracle
+    re-parse).  ``path_fix`` marks rows whose path contains ``%`` (the host
+    delivers the path percent-DECODED, and bad escapes are first repaired
+    to ``%25``); ``query_fix`` marks rows whose query contains a bad escape
+    (repaired to ``%25``; well-formed query escapes are delivered raw).
+    The ``%``-repair inserts only the digits ``25``, so it cannot create or
+    destroy separators — span boundaries are unaffected.
+
+    An empty span is clean: every output is null (the host dissector
+    delivers nothing).  The query span keeps its leading separator byte;
+    when that byte is ``?`` the host delivers it as ``&`` (the ?&
+    normalization) — the ``amp`` flag tells the materializer to swap it.
+    """
+    extract = extract or gather_span_bytes
+    B, L = buf.shape
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
+    in_span = (pos >= start[:, None]) & (pos < end[:, None])
+    width = end - start
+    empty = width == 0
+
+    is_q = (buf == np.uint8(ord("?"))) & in_span
+    is_amp = (buf == np.uint8(ord("&"))) & in_span
+    first_sep = jnp.min(
+        jnp.where(is_q | is_amp, pos, L), axis=1
+    ).astype(jnp.int32)
+    first_sep = jnp.minimum(first_sep, end)
+
+    # Encode-set membership (the complement of URIUtil's allowed set).
+    # Everything >= 0x7F is excluded too: the host chain passes raw
+    # high bytes through byte-to-latin-1 (mojibake-preserving), which a
+    # UTF-8 span decode cannot reproduce — those rows take the oracle.
+    bad = (buf <= np.uint8(0x20)) | (buf >= np.uint8(0x7F))
+    for ch in b'{}|\\^[]`<>"':
+        bad = bad | (buf == np.uint8(ch))
+    bad = bad | (buf == np.uint8(ord("#"))) | (buf == np.uint8(ord(";")))
+    clean = ~jnp.any(bad & in_span, axis=1)
+
+    # '?' discipline: at most one, and only at the first separator.
+    q_count = jnp.sum(jnp.where(is_q, 1, 0), axis=1)
+    first_q = jnp.min(jnp.where(is_q, pos, L), axis=1).astype(jnp.int32)
+    clean = clean & (
+        (q_count == 0) | ((q_count == 1) & (first_q == first_sep))
     )
-    # Digit-check every numeric byte explicitly.  day/hour/min/sec garbage is
-    # caught by the range bounds below, but year and tz-offset values are
-    # otherwise unbounded — without this, a non-digit byte yields different
-    # (both "ok") arithmetic under the uint8 jnp path vs the int32 Pallas
-    # path, and the host layout rejects such lines outright.
-    digits_ok = jnp.ones(buf.shape[0], dtype=bool)
-    for i in (0, 1, 7, 8, 9, 10, 12, 13, 15, 16, 18, 19, 22, 23, 24, 25):
-        digits_ok = digits_ok & (
-            (b[:, i] >= np.uint8(ord("0"))) & (b[:, i] <= np.uint8(ord("9")))
+
+    # '%' handling: flags per-row host micro-materialization, not oracle.
+    is_pct = (buf == np.uint8(ord("%"))) & in_span
+    shift = shift_fn or shift_zero
+    nxt1 = shift(buf, 1)
+    nxt2 = shift(buf, 2)
+
+    def _is_hex(x):
+        return (
+            ((x >= np.uint8(ord("0"))) & (x <= np.uint8(ord("9"))))
+            | ((x >= np.uint8(ord("a"))) & (x <= np.uint8(ord("f"))))
+            | ((x >= np.uint8(ord("A"))) & (x <= np.uint8(ord("F"))))
         )
-    # Day-in-month with leap years, so the device accepts exactly what the
-    # host layout accepts (no silent wrong epochs bypassing the oracle).
-    leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
-    thirty = (month == 4) | (month == 6) | (month == 9) | (month == 11)
-    dim = jnp.where(thirty, 30, jnp.where(month == 2, jnp.where(leap, 29, 28), 31))
-    fields_ok = (
-        (month >= 1)
-        & (day >= 1)
-        & (day <= dim)
-        & (hour <= 23)
-        & (minute <= 59)
-        & (second <= 60)
-    )
-    # Leap second: the host layout clamps 60 -> 59 (java.time SMART).
-    second = jnp.minimum(second, 59)
 
-    days = _days_from_civil(year, month, day)
-    sec_of_day = hour * 3600 + minute * 60 + second - offset_s
-    ok = width_ok & letters_ok & seps_ok & digits_ok & fields_ok
-    # Combined on host: epoch_ms = (days * 86400 + sec_of_day) * 1000 (int64).
-    return (days, sec_of_day), ok
+    pct_bad = is_pct & ~(_is_hex(nxt1) & _is_hex(nxt2) & (pos + 2 < end[:, None]))
+    path_fix = jnp.any(is_pct & (pos < first_sep[:, None]), axis=1)
+    query_fix = jnp.any(pct_bad & (pos >= first_sep[:, None]), axis=1)
 
+    lead = extract(buf, start, 1)[:, 0]
+    relative = (~empty) & (lead == np.uint8(ord("/")))
+    ok = clean & (relative | empty)
 
-def combine_epoch(days, sec_of_day) -> np.ndarray:
-    """Host-side combine -> epoch milliseconds int64 numpy column."""
-    return (
-        np.asarray(days, dtype=np.int64) * 86400
-        + np.asarray(sec_of_day, dtype=np.int64)
-    ) * 1000
+    zero_span = start
+    has_query = (~empty) & (first_sep < end)
+    return {
+        "ok": ok,
+        "empty": empty,
+        "path_start": jnp.where(empty, zero_span, start),
+        "path_end": jnp.where(empty, zero_span, first_sep),
+        "query_start": jnp.where(empty, zero_span, first_sep),
+        "query_end": jnp.where(empty, zero_span, end),
+        "query_amp": has_query,
+        "path_fix": path_fix,
+        "query_fix": query_fix,
+    }
 
 
 def split_firstline(

@@ -1,0 +1,308 @@
+"""Device-side generic fixed-layout timestamp parsing.
+
+The host engine compiles every timestamp pattern (java.time subset or
+strftime) into a :class:`~logparser_tpu.dissectors.timelayout.TimeLayout` —
+a flat item list.  This module compiles the *fixed-width subset* of those
+layouts one step further, into a :class:`DeviceTimeLayout` whose every item
+sits at a static byte offset, and executes it over ``[B]`` spans of a
+``[B, L]`` byte batch as pure vector arithmetic (the TPU replacement for
+TimeStampDissector.java:404-424's per-line ``DateTimeFormatter.parse``).
+
+Device-compilable layouts: every item fixed-width (numeric fields with
+min==max width, 3-letter month/day names, am/pm, literals), with at most one
+variable-width item — the UTC-offset — in tail position (``ZZ`` accepts
+``+HHMM``/``+HH:MM`` and ``XXX`` accepts ``Z``/``+HH:MM``; both are
+distinguishable by total span width, so a trailing zone stays vectorizable).
+This covers the Apache default ``dd/MMM/yyyy:HH:mm:ss ZZ``, nginx
+``$time_iso8601`` (``yyyy-MM-dd'T'HH:mm:ssXXX``), and the fixed-width
+strftime family (``%d/%b/%Y:%H:%M:%S %z``, ``%Y-%m-%d %H:%M:%S``, ...).
+Everything else (variable month names, zone *names* needing tzdata/DST,
+week-based dates) stays on the host oracle.
+
+Validation discipline: the device must never accept a span the host layout
+rejects (a false-accept would bypass the oracle with a wrong value).  Every
+digit is range-checked, literals compare case-insensitively exactly like
+``TimeLayout.parse``, month/day names must be table members, and
+day-in-month honors leap years.  Device-stricter is fine — a rejected line
+falls back to the oracle, which re-applies the exact host semantics.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..dissectors.timelayout import (
+    DAYS_SHORT,
+    MONTHS_SHORT,
+    TimeLayout,
+)
+
+# Zones that are a fixed UTC offset year-round (no DST), so a layout whose
+# default_zone is one of these still compiles to constant offset arithmetic.
+_FIXED_OFFSET_ZONES = {"UTC": 0, "GMT": 0, "Z": 0, "UT": 0, "Etc/UTC": 0}
+
+
+@dataclass(frozen=True)
+class _DevItem:
+    kind: str        # lit | num | monthname | dayname | ampm
+    offset: int      # byte offset from span start
+    width: int
+    field: str = ""  # for num
+    text: bytes = b""  # for lit
+
+
+@dataclass
+class DeviceTimeLayout:
+    """A TimeLayout resolved to fixed byte offsets (device-executable)."""
+
+    items: Tuple[_DevItem, ...]
+    prefix_width: int              # total width of the fixed items
+    tail: str                      # "" | "offset" | "offset_colon"
+    default_offset_seconds: int    # applied when tail == ""
+
+    @property
+    def max_width(self) -> int:
+        return self.prefix_width + (6 if self.tail else 0)
+
+
+# Numeric layout fields the device models, with their post-parse range
+# checks applied in parse_device_timestamp.
+_NUM_FIELDS = {
+    "year", "year2", "month", "day", "hour", "clock_hour", "hour12",
+    "minute", "second", "milli",
+}
+
+
+def compile_layout_for_device(layout: TimeLayout) -> Optional[DeviceTimeLayout]:
+    """TimeLayout -> DeviceTimeLayout, or None when any item is outside the
+    fixed-width subset (caller keeps the field on the host oracle)."""
+    items: List[_DevItem] = []
+    offset = 0
+    tail = ""
+    n = len(layout.items)
+    for idx, it in enumerate(layout.items):
+        kind = it[0]
+        if kind == "lit":
+            text = it[1].encode("utf-8", errors="strict")
+            items.append(_DevItem("lit", offset, len(text), text=text))
+            offset += len(text)
+        elif kind == "num":
+            _, field, minw, maxw, space_pad = it
+            if space_pad or minw != maxw or field not in _NUM_FIELDS:
+                return None
+            items.append(_DevItem("num", offset, minw, field=field))
+            offset += minw
+        elif kind == "text":
+            _, field, style = it
+            if field == "monthname" and style == "short":
+                items.append(_DevItem("monthname", offset, 3))
+                offset += 3
+            elif field == "dayname" and style == "short":
+                items.append(_DevItem("dayname", offset, 3))
+                offset += 3
+            elif field == "ampm":
+                items.append(_DevItem("ampm", offset, 2))
+                offset += 2
+            else:
+                return None  # full names are variable-width
+        elif kind in ("offset", "offset_colon"):
+            if idx != n - 1:
+                return None  # variable width is only decodable at the tail
+            tail = kind
+        else:  # zonetext and anything new: host-only
+            return None
+
+    default_offset = 0
+    if not tail:
+        zone = layout.default_zone
+        if zone is not None and zone not in _FIXED_OFFSET_ZONES:
+            return None  # DST zones need tzdata; host-only
+        default_offset = _FIXED_OFFSET_ZONES.get(zone or "UTC", 0)
+
+    fields = {i.field for i in items if i.kind == "num"}
+    has_month = "month" in fields or any(i.kind == "monthname" for i in items)
+    if not ((("year" in fields) or ("year2" in fields)) and has_month
+            and "day" in fields):
+        return None  # incomplete date resolves through host paths
+    return DeviceTimeLayout(tuple(items), offset, tail, default_offset)
+
+
+# ---------------------------------------------------------------------------
+# Execution
+# ---------------------------------------------------------------------------
+
+
+def _name_hash(name: str) -> int:
+    a, b, c = (ord(ch) - 97 for ch in name.lower()[:3])
+    return (a * 26 + b) * 26 + c
+
+
+def parse_device_timestamp(
+    buf: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+    dl: DeviceTimeLayout,
+    extract,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Execute a DeviceTimeLayout over [B] spans.
+
+    Returns (components, ok): components has int32 arrays
+    ``year month day hour minute second milli offset_seconds`` (local wall
+    clock + UTC offset; epoch math happens host-side in int64).
+    """
+    B = buf.shape[0]
+    b = extract(buf, start, dl.max_width)
+    width = end - start
+    ok = width >= dl.prefix_width
+
+    zeros = jnp.zeros(B, dtype=jnp.int32)
+    comp: Dict[str, jnp.ndarray] = {}
+
+    def digits(off: int, w: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        val = zeros
+        good = jnp.ones(B, dtype=bool)
+        for i in range(off, off + w):
+            d = (b[:, i] - np.uint8(ord("0"))).astype(jnp.int32)
+            good = good & (d >= 0) & (d <= 9)
+            val = val * 10 + d
+        return val, good
+
+    lower = b | np.uint8(0x20)
+    month_from_name = None
+    for it in dl.items:
+        if it.kind == "lit":
+            for i, byte in enumerate(it.text):
+                col = it.offset + i
+                if ord("a") <= (byte | 0x20) <= ord("z"):
+                    ok = ok & (lower[:, col] == np.uint8(byte | 0x20))
+                else:
+                    ok = ok & (b[:, col] == np.uint8(byte))
+        elif it.kind == "num":
+            val, good = digits(it.offset, it.width)
+            ok = ok & good
+            comp[it.field] = val
+        elif it.kind == "monthname":
+            l0 = (lower[:, it.offset] - np.uint8(ord("a"))).astype(jnp.int32)
+            l1 = (lower[:, it.offset + 1] - np.uint8(ord("a"))).astype(jnp.int32)
+            l2 = (lower[:, it.offset + 2] - np.uint8(ord("a"))).astype(jnp.int32)
+            letters = (
+                (l0 >= 0) & (l0 < 26) & (l1 >= 0) & (l1 < 26)
+                & (l2 >= 0) & (l2 < 26)
+            )
+            h = (l0 * 26 + l1) * 26 + l2
+            month = zeros
+            for m, name in enumerate(MONTHS_SHORT, start=1):
+                month = jnp.where(h == _name_hash(name), m, month)
+            ok = ok & letters & (month >= 1)
+            month_from_name = month
+        elif it.kind == "dayname":
+            l0 = (lower[:, it.offset] - np.uint8(ord("a"))).astype(jnp.int32)
+            l1 = (lower[:, it.offset + 1] - np.uint8(ord("a"))).astype(jnp.int32)
+            l2 = (lower[:, it.offset + 2] - np.uint8(ord("a"))).astype(jnp.int32)
+            letters = (
+                (l0 >= 0) & (l0 < 26) & (l1 >= 0) & (l1 < 26)
+                & (l2 >= 0) & (l2 < 26)
+            )
+            h = (l0 * 26 + l1) * 26 + l2
+            known = jnp.zeros(B, dtype=bool)
+            for name in DAYS_SHORT:
+                known = known | (h == _name_hash(name))
+            # The parsed value is validated but unused (the host resolver
+            # ignores dayofweek too).
+            ok = ok & letters & known
+        elif it.kind == "ampm":
+            c0 = lower[:, it.offset]
+            c1 = lower[:, it.offset + 1]
+            is_am = c0 == np.uint8(ord("a"))
+            is_pm = c0 == np.uint8(ord("p"))
+            ok = ok & (is_am | is_pm) & (c1 == np.uint8(ord("m")))
+            comp["ampm"] = jnp.where(is_pm, 1, 0)
+        else:  # pragma: no cover
+            raise AssertionError(it.kind)
+
+    # ---- tail zone ----------------------------------------------------
+    p = dl.prefix_width
+    if dl.tail == "offset":
+        # ZZ: [+-]HHMM (w==5) or [+-]HH:MM (w==6).
+        tail_w = width - p
+        colon = tail_w == 6
+        sign_b = b[:, p]
+        sign = jnp.where(sign_b == np.uint8(ord("-")), -1, 1).astype(jnp.int32)
+        sign_ok = (sign_b == np.uint8(ord("+"))) | (sign_b == np.uint8(ord("-")))
+        oh, oh_ok = digits(p + 1, 2)
+        m_nc, m_nc_ok = digits(p + 3, 2)
+        m_c, m_c_ok = digits(p + 4, 2)
+        om = jnp.where(colon, m_c, m_nc)
+        om_ok = jnp.where(colon, m_c_ok & (b[:, p + 3] == np.uint8(ord(":"))),
+                          m_nc_ok)
+        ok = ok & ((tail_w == 5) | colon) & sign_ok & oh_ok & om_ok
+        comp["offset_seconds"] = sign * (oh * 3600 + om * 60)
+    elif dl.tail == "offset_colon":
+        # XXX: 'Z' (w==1) or [+-]HH:MM (w==6).
+        tail_w = width - p
+        is_z = (tail_w == 1) & (lower[:, p] == np.uint8(ord("z")))
+        sign_b = b[:, p]
+        sign = jnp.where(sign_b == np.uint8(ord("-")), -1, 1).astype(jnp.int32)
+        sign_ok = (sign_b == np.uint8(ord("+"))) | (sign_b == np.uint8(ord("-")))
+        oh, oh_ok = digits(p + 1, 2)
+        om, om_ok = digits(p + 4, 2)
+        full_ok = (
+            (tail_w == 6) & sign_ok & oh_ok & om_ok
+            & (b[:, p + 3] == np.uint8(ord(":")))
+        )
+        ok = ok & (is_z | full_ok)
+        comp["offset_seconds"] = jnp.where(is_z, 0, sign * (oh * 3600 + om * 60))
+    else:
+        ok = ok & (width == p)
+        comp["offset_seconds"] = jnp.full(B, dl.default_offset_seconds,
+                                          dtype=jnp.int32)
+
+    # ---- resolve components (mirrors TimeLayout._resolve) -------------
+    year = comp.get("year")
+    if year is None:
+        year = 2000 + comp["year2"]
+    month = comp.get("month", month_from_name)
+    day = comp["day"]
+
+    hour = comp.get("hour")
+    if hour is None and "clock_hour" in comp:
+        ch = comp["clock_hour"]
+        # SMART resolver: 0 and 24 both mean midnight; 25+ is invalid.
+        ok = ok & (ch <= 24)
+        hour = jnp.where(ch == 24, 0, ch)
+    if hour is None and "hour12" in comp:
+        hour = (comp["hour12"] % 12) + 12 * comp.get("ampm", zeros)
+    if hour is None:
+        hour = zeros
+    minute = comp.get("minute", zeros)
+    second = comp.get("second", zeros)
+    milli = comp.get("milli", zeros)
+
+    # Range checks = what datetime() construction enforces on the host.
+    leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
+    thirty = (month == 4) | (month == 6) | (month == 9) | (month == 11)
+    dim = jnp.where(thirty, 30,
+                    jnp.where(month == 2, jnp.where(leap, 29, 28), 31))
+    ok = (
+        ok
+        & (year >= 1) & (month >= 1) & (month <= 12)
+        & (day >= 1) & (day <= dim)
+        & (hour <= 23) & (minute <= 59) & (second <= 60) & (milli <= 999)
+        # datetime.timezone only admits offsets strictly inside +-24h.
+        & (jnp.abs(comp["offset_seconds"]) < 86400)
+    )
+    second = jnp.minimum(second, 59)  # leap second: SMART clamps 60 -> 59
+
+    return (
+        {
+            "year": year, "month": month, "day": day, "hour": hour,
+            "minute": minute, "second": second, "milli": milli,
+            "offset_seconds": comp["offset_seconds"],
+        },
+        ok,
+    )

@@ -52,3 +52,83 @@ def test_sequence_parallel_matches_single(program, batch):
     np.testing.assert_array_equal(np.asarray(out["valid"]), np.asarray(ref["valid"]))
     np.testing.assert_array_equal(np.asarray(out["starts"]), np.asarray(ref["starts"]))
     np.testing.assert_array_equal(np.asarray(out["ends"]), np.asarray(ref["ends"]))
+
+
+# ---------------------------------------------------------------------------
+# Boundary-adversarial SP cases: the halo exchange and global-min resolution
+# must hold when separators straddle shard edges, lines are shorter than one
+# shard, and the last shard is pure padding.
+# ---------------------------------------------------------------------------
+
+
+def _encode(lines, line_len):
+    buf, lengths, overflow = encode_batch(lines, line_len=line_len)
+    assert not overflow
+    return buf, lengths
+
+
+def _assert_sp_matches(program, buf, lengths, n_data=2, n_seq=4):
+    ref = run_program(program, buf, lengths)
+    mesh = make_mesh(n_data=n_data, n_seq=n_seq)
+    runner = sequence_parallel_runner(program, mesh, l_total=buf.shape[1])
+    out = runner(buf, lengths)
+    for key in ("valid", "starts", "ends"):
+        np.testing.assert_array_equal(
+            np.asarray(out[key]), np.asarray(ref[key]), err_msg=key
+        )
+    return out
+
+
+@pytest.fixture(scope="module")
+def sep3_program():
+    # " - " between tokens: a 3-byte separator (halo width 2).
+    return compile_device_program(
+        ApacheHttpdLogFormatDissector("%h - %u - %{Referer}i")
+    )
+
+
+class TestSequenceParallelBoundaries:
+    def test_multibyte_separator_straddles_every_offset(self, sep3_program):
+        # L=64, n_seq=4 -> shard width 16.  Slide a 3-byte separator across
+        # both shard edges (positions 14..17) by padding the first token.
+        lines = []
+        for pad in range(12, 20):
+            host = "h" * pad
+            lines.append(f"{host} - user{pad % 7} - ref/{pad}")
+        buf, lengths = _encode(lines, 64)
+        _assert_sp_matches(sep3_program, buf, lengths)
+
+    def test_line_shorter_than_one_shard(self, sep3_program):
+        lines = ["a - b - c", "x - y - z", "h - u - r", "p - q - s"]
+        buf, lengths = _encode(lines, 64)   # lines fit inside shard 0
+        out = _assert_sp_matches(sep3_program, buf, lengths)
+        assert np.asarray(out["valid"]).all()
+
+    def test_empty_and_garbage_lines(self, sep3_program):
+        lines = ["", " - ", "- -", "a - b - c", "nosep", " - x - y"]
+        buf, lengths = _encode(lines, 64)
+        _assert_sp_matches(sep3_program, buf, lengths)
+
+    def test_separator_at_exact_line_end(self, sep3_program):
+        # Line ends exactly at a shard boundary; trailing token empty.
+        lines = ["a - b - ", "h" * 13 + " - u - "]
+        buf, lengths = _encode(lines, 64)
+        _assert_sp_matches(sep3_program, buf, lengths)
+
+    def test_combined_on_narrow_shards(self, program):
+        lines = generate_combined_lines(32, seed=7, garbage_fraction=0.1)
+        buf, lengths = _encode(lines, 512)
+        _assert_sp_matches(program, buf, lengths, n_data=1, n_seq=8)
+
+    def test_decoy_separator_before_cursor(self, sep3_program):
+        # A separator occurrence BEFORE the cursor in an earlier shard must
+        # not win the global pmin.
+        lines = ["a-b - u - r", "a - b-c - d - e"]
+        buf, lengths = _encode(lines, 64)
+        _assert_sp_matches(sep3_program, buf, lengths)
+
+    def test_last_shard_pure_padding(self, sep3_program):
+        lines = ["aa - bb - cc", "dd - ee - ff"]
+        buf, lengths = _encode(lines, 128)  # shards 1..3 all padding
+        out = _assert_sp_matches(sep3_program, buf, lengths)
+        assert np.asarray(out["valid"]).all()

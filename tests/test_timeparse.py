@@ -1,0 +1,198 @@
+"""Differential tests: device fixed-layout timestamp parser vs the host
+TimeLayout engine.
+
+For each device-compilable layout: every span the DEVICE accepts must
+resolve to exactly the host's values (epoch + every derived output); spans
+the device rejects must either be rejected by the host too, or are allowed
+to fall back (device-stricter is safe, device-laxer is a bug).
+"""
+import datetime as dt
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from logparser_tpu.dissectors.strftime_stamp import compile_strftime
+from logparser_tpu.dissectors.timelayout import compile_java_pattern
+from logparser_tpu.tpu import timefields
+from logparser_tpu.tpu.postproc import gather_span_bytes
+from logparser_tpu.tpu.timeparse import (
+    compile_layout_for_device,
+    parse_device_timestamp,
+)
+
+DEVICE_LAYOUTS = [
+    ("java", "dd/MMM/yyyy:HH:mm:ss ZZ"),
+    ("java", "yyyy-MM-dd'T'HH:mm:ssXXX"),
+    ("strf", "%d/%b/%Y:%H:%M:%S %z"),
+    ("strf", "%Y-%m-%d %H:%M:%S"),
+    ("strf", "%a %d %b %Y %I:%M:%S %p"),
+    ("strf", "%Y%m%d%H%M%S"),
+]
+
+HOST_ONLY_LAYOUTS = [
+    ("java", "dd/MMMM/yyyy HH:mm"),       # full month name: variable width
+    ("strf", "%e/%b/%Y"),                 # space-padded day
+    ("strf", "%G-W%V-%u"),                # ISO week date
+    ("strf", "%d/%b/%Y %H:%M:%S %Z"),     # zone text needs tzdata
+]
+
+
+def compile_layout(kind, pattern):
+    if kind == "strf":
+        return compile_strftime(pattern)
+    return compile_java_pattern(pattern)
+
+
+def run_device(dl, samples):
+    width = max(len(s) for s in samples) + 2
+    buf = np.zeros((len(samples), width), dtype=np.uint8)
+    lengths = np.zeros(len(samples), dtype=np.int32)
+    for i, s in enumerate(samples):
+        raw = s.encode()
+        buf[i, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        lengths[i] = len(raw)
+    comp, ok = parse_device_timestamp(
+        jnp.asarray(buf),
+        jnp.zeros(len(samples), dtype=jnp.int32),
+        jnp.asarray(lengths),
+        dl,
+        gather_span_bytes,
+    )
+    comp = {k: np.asarray(v).astype(np.int64) for k, v in comp.items()}
+    return comp, np.asarray(ok)
+
+
+def sample_strings(layout, rng):
+    """Valid renders + hostile mutations for a layout."""
+    out = []
+    for _ in range(60):
+        t = dt.datetime(
+            rng.randint(1971, 2100), rng.randint(1, 12), rng.randint(1, 28),
+            rng.randint(0, 23), rng.randint(0, 59), rng.randint(0, 59),
+        )
+        off_min = rng.choice([0, 0, 60, -120, 330, 765, -690])
+        parts = []
+        for it in layout.items:
+            kind = it[0]
+            if kind == "lit":
+                parts.append(it[1])
+            elif kind == "num":
+                field = it[1]
+                w = it[2]
+                val = {
+                    "year": t.year, "year2": t.year % 100, "month": t.month,
+                    "day": t.day, "hour": t.hour, "clock_hour": t.hour or 24,
+                    "hour12": ((t.hour - 1) % 12) + 1, "minute": t.minute,
+                    "second": t.second, "milli": rng.randint(0, 999),
+                }.get(field)
+                if val is None:
+                    return []  # unsupported sample field
+                parts.append(str(val).zfill(w))
+            elif kind == "text":
+                _, field, style = it
+                if field == "monthname":
+                    name = dt.date(2000, t.month, 1).strftime("%b")
+                    parts.append(name if style == "short" else t.strftime("%B"))
+                elif field == "dayname":
+                    parts.append(t.strftime("%a"))
+                else:
+                    parts.append("AM" if t.hour < 12 else "PM")
+            elif kind == "offset":
+                sign = "+" if off_min >= 0 else "-"
+                h, m = divmod(abs(off_min), 60)
+                sep = ":" if rng.random() < 0.5 else ""
+                parts.append(f"{sign}{h:02d}{sep}{m:02d}")
+            elif kind == "offset_colon":
+                if off_min == 0 and rng.random() < 0.5:
+                    parts.append("Z")
+                else:
+                    sign = "+" if off_min >= 0 else "-"
+                    h, m = divmod(abs(off_min), 60)
+                    parts.append(f"{sign}{h:02d}:{m:02d}")
+        out.append("".join(parts))
+
+    hostile = []
+    for s in out[:30]:
+        mutated = list(s)
+        k = rng.randrange(len(mutated))
+        mutated[k] = rng.choice("0123456789abcXYZ/:+- .")
+        hostile.append("".join(mutated))
+    hostile += ["", "garbage", out[0][:-1], out[0] + "0", "32/Foo/2020:99"]
+    return out + hostile
+
+
+@pytest.mark.parametrize("kind,pattern", DEVICE_LAYOUTS)
+def test_device_matches_host(kind, pattern):
+    layout = compile_layout(kind, pattern)
+    dl = compile_layout_for_device(layout)
+    assert dl is not None, f"{pattern!r} should be device-compilable"
+    rng = random.Random(hash(pattern) & 0xFFFF)
+    samples = sample_strings(layout, rng)
+    assert samples
+    comp, ok = run_device(dl, samples)
+
+    epochs = timefields.derive(comp, "epoch")
+    n_checked = 0
+    for i, s in enumerate(samples):
+        try:
+            want = layout.parse(s)
+        except Exception:
+            assert not ok[i], f"device accepted host-rejected {s!r}"
+            continue
+        if not ok[i]:
+            continue  # device-stricter: falls back to the oracle
+        n_checked += 1
+        assert epochs[i] == want.epoch_millis, s
+        assert comp["year"][i] == want.year, s
+        assert comp["month"][i] == want.month, s
+        assert comp["day"][i] == want.day, s
+        assert comp["hour"][i] == want.hour, s
+        assert comp["minute"][i] == want.minute, s
+        assert comp["second"][i] == want.second, s
+    # The device must take the overwhelming share of well-formed inputs.
+    assert n_checked >= 50, f"device accepted only {n_checked} valid samples"
+
+
+@pytest.mark.parametrize("kind,pattern", HOST_ONLY_LAYOUTS)
+def test_host_only_layouts_do_not_compile(kind, pattern):
+    layout = compile_layout(kind, pattern)
+    assert compile_layout_for_device(layout) is None
+
+
+def test_derived_outputs_match_host_engine():
+    layout = compile_java_pattern("dd/MMM/yyyy:HH:mm:ss ZZ")
+    dl = compile_layout_for_device(layout)
+    samples = [
+        "07/Mar/2026:23:59:60 +0000",   # leap second clamp
+        "29/Feb/2024:12:00:00 +0530",
+        "01/Jan/1971:00:00:00 -0845",
+        "31/Dec/2037:06:07:08 +1400",
+    ]
+    comp, ok = run_device(dl, samples)
+    assert ok.all()
+    for name in sorted(timefields.DEVICE_COMPONENTS):
+        got = timefields.derive(comp, name)
+        for i, s in enumerate(samples):
+            want = layout.parse(s)
+            ts = want.utc_fields() if name.endswith("_utc") else want
+            base = name[:-4] if name.endswith("_utc") else name
+            expected = {
+                "epoch": want.epoch_millis,
+                "year": ts.year, "month": ts.month, "day": ts.day,
+                "hour": ts.hour, "minute": ts.minute, "second": ts.second,
+                "millisecond": ts.nano // 1_000_000,
+                "microsecond": ts.nano // 1_000,
+                "nanosecond": ts.nano,
+                "weekyear": ts.iso_weekyear(),
+                "weekofweekyear": ts.iso_week(),
+                "monthname": ts.monthname(),
+                "date": ts.date_str(),
+                "time": ts.time_str(),
+            }[base]
+            value = got[i]
+            if isinstance(expected, int):
+                assert int(value) == expected, (name, s)
+            else:
+                assert str(value) == expected, (name, s)

@@ -379,3 +379,30 @@ class TestMultiProducerFields:
         assert all(isinstance(v, int) for v in vals)
         t = r.to_arrow()
         assert str(t.column("BYTES:response.body.bytes").type) == "int64"
+
+
+class TestZeroNullConverterDevice:
+    """BYTES -> BYTESCLF (ConvertNumberIntoCLF) device route: the host
+    compares the STRING to "0", so "00"/"007" pass through while "0" nulls —
+    leading-zero spans must take the oracle, exact-"0" nulls on device."""
+
+    def test_matches_oracle(self):
+        from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+        fid = "BYTESCLF:response.body.bytes"
+        p = TpuBatchParser('%h %l %u %t "%r" %>s %B', [fid])
+        assert p.plan_by_id[fid].null_mode == "zero_null"
+        lines = [
+            f'1.2.3.4 - - [07/Mar/2026:10:00:00 +0000] "GET /x HTTP/1.1" 200 {b}'
+            for b in ("0", "00", "007", "123", "10")
+        ]
+        result = p.parse_batch(lines)
+        got = result.to_pylist(fid)
+        for i, line in enumerate(lines):
+            want = p.oracle.parse(line, _CollectingRecord()).values.get(fid)
+            if got[i] is None:
+                assert want is None, (i, want)
+            elif isinstance(got[i], int):
+                assert got[i] == int(want), (i, got[i], want)
+            else:
+                assert got[i] == want, (i, got[i], want)

@@ -1,0 +1,96 @@
+"""Differential fuzz for the device URI fast path (tpu/postproc.split_uri_fast
++ the `fix` micro-materialization) against the host HttpUriDissector repair
+chain.
+
+Every URI the device keeps (directly or via a `fix` row) must deliver
+bit-exact path/query/ref/host/port values; URIs the device rejects must
+round-trip through the oracle to the same values — both asserted by driving
+full lines through TpuBatchParser and comparing with the per-line oracle.
+"""
+import random
+
+import pytest
+
+from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+FIELDS = [
+    "HTTP.PATH:request.firstline.uri.path",
+    "HTTP.QUERYSTRING:request.firstline.uri.query",
+    "HTTP.REF:request.firstline.uri.ref",
+    "HTTP.HOST:request.firstline.uri.host",
+    "HTTP.PORT:request.firstline.uri.port",
+    "HTTP.PROTOCOL:request.firstline.uri.protocol",
+    "HTTP.USERINFO:request.firstline.uri.userinfo",
+]
+
+CLEAN_PARTS = ["/a", "/b.html", "/x/y/z", "/idx.php", "/deep/p.png", "/"]
+QUERY_PARTS = ["q=1", "a=b&c=d", "x=", "empty", "u=%C3%A9", "v=a+b",
+               "broken=50%-off", "p=%2Fx", "odd=%zz", "t=%"]
+DIRTY = [
+    "/frag#x", "/multi#a#b", "/semi;jsessionid=1", "/sp ace",
+    "/enc%2Fpath", "/two?a=1?b=2", "/amp&first?x=1",
+    "http://host:8080/abs?q=1", "https://u:p@h/x", "ftp://h/f",
+    "/brace{x}", "/tick`y", "/quote\"z", "/pipe|a", "/caret^b",
+    "/&#x41;ent", "/ent&amp;x", "relative/no/slash", "-", "*", "",
+    "/%", "/%2", "/ok%20still", "/bs\\win", "/sq[0]", "/uml%C3%BC",
+    # Raw non-ASCII bytes: the host chain byte-encodes then latin-1-maps
+    # (mojibake-preserving); the device must hand these to the oracle.
+    "/caf\xc3\xa9", "/x?v=\xc3\xa9", "/mix\xe9",
+]
+
+
+def make_lines(uris):
+    return [
+        f'10.0.0.{i % 250 + 1} - - [07/Mar/2026:10:00:{i % 60:02d} +0000] '
+        f'"GET {u} HTTP/1.1" 200 {i + 10}'
+        for i, u in enumerate(uris)
+    ]
+
+
+def assert_matches(parser, lines):
+    result = parser.parse_batch(lines)
+    cols = {f: result.to_pylist(f) for f in FIELDS}
+    for i, line in enumerate(lines):
+        try:
+            rec = parser.oracle.parse(line, _CollectingRecord())
+            expected, ok = rec.values, True
+        except Exception:
+            expected, ok = {}, False
+        assert bool(result.valid[i]) == ok, (i, line)
+        if not ok:
+            continue
+        for f in FIELDS:
+            got = cols[f][i]
+            want = expected.get(f)
+            if isinstance(got, int) and want is not None:
+                want = int(want)
+            assert got == want, f"line {i} {f}: {got!r} != {want!r} ({line})"
+
+
+class TestDeviceUriSplit:
+    def test_enumerated_uris(self):
+        uris = list(DIRTY)
+        for p in CLEAN_PARTS:
+            uris.append(p)
+            for q in QUERY_PARTS:
+                uris.append(f"{p}?{q}")
+        parser = TpuBatchParser("common", FIELDS)
+        assert_matches(parser, make_lines(uris))
+
+    def test_fuzzed_uris(self):
+        rng = random.Random(77)
+        alphabet = "abz019-_.~%?&=#;/:{}<>` +\\"
+        uris = []
+        for _ in range(300):
+            n = rng.randint(1, 24)
+            uris.append("/" + "".join(rng.choice(alphabet) for _ in range(n)))
+        parser = TpuBatchParser("common", FIELDS)
+        assert_matches(parser, make_lines(uris))
+
+    def test_fix_rows_stay_on_device(self):
+        # %-escapes must not cost a full oracle re-parse.
+        uris = ["/logo%20big.png?q=%C3%A9", "/x?broken=50%-off", "/plain"]
+        parser = TpuBatchParser("common", FIELDS)
+        result = parser.parse_batch(make_lines(uris))
+        assert result.oracle_rows == 0
+        assert list(result.valid) == [True, True, True]
