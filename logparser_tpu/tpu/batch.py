@@ -333,6 +333,8 @@ class TpuBatchParser:
             return "numeric"
         if plan.kind == "ts":
             return "numeric" if timefields.is_numeric_output(plan.comp) else "obj"
+        if plan.kind == "qscsr":
+            return "wild"
         return "host"
 
     def _unit_decodable(self, unit: FormatUnit, field_id: str) -> bool:
@@ -465,12 +467,18 @@ class TpuBatchParser:
         if t == ftype and name == path:
             plans.append(self._terminal_plan(field_id, tok, vctx, steps, device_ok))
             return plans
-        if depth == 0 or (t, name) in visited:
-            return plans
-        visited = visited | {(t, name)}
+        if (t, name) in visited:
+            return plans  # cycle: its producer paths are already counted
         relevant = name == "" or path == name or path.startswith(name + ".")
         if not relevant:
             return plans
+        if depth == 0:
+            # Fail SAFE on depth exhaustion: a truncated path may still be
+            # a real producer — count it as host so the multi-producer
+            # guard cannot be starved by deep chains.
+            plans.append(_FieldPlan(field_id, "host"))
+            return plans
+        visited = visited | {(t, name)}
         for d in self._consumers.get(t, ()):
             for out in d.get_possible_output():
                 ot, _, oname = out.partition(":")
@@ -478,7 +486,23 @@ class TpuBatchParser:
                     # Wildcard outputs (query-string/cookies): any requested
                     # path under this prefix is produced here.
                     if ot == ftype and path.startswith(name + "."):
-                        plans.append(_FieldPlan(field_id, "host"))
+                        from ..dissectors.cookies import (
+                            RequestCookieListDissector,
+                        )
+                        from ..dissectors.query import QueryStringFieldDissector
+
+                        mode = None
+                        if isinstance(d, QueryStringFieldDissector):
+                            mode = "query"
+                        elif isinstance(d, RequestCookieListDissector):
+                            mode = "cookie"
+                        if mode is not None and vctx[0] == "" and device_ok:
+                            plans.append(_FieldPlan(
+                                field_id, "qscsr", tok.index, steps,
+                                comp=path[len(name) + 1:], meta=mode,
+                            ))
+                        else:
+                            plans.append(_FieldPlan(field_id, "host"))
                     continue
                 if oname == "":
                     new_name = name
@@ -601,7 +625,9 @@ class TpuBatchParser:
         for fid in self.requested:
             merged = self.plan_by_id[fid]
             group = self._plan_group(merged)
-            if packed is None or group == "host":
+            if packed is None or group in ("host", "wild"):
+                # host: oracle-only.  wild: CSR fields deliver exclusively
+                # through overrides (built by _materialize_csr below).
                 columns[fid] = {
                     "kind": "span",
                     "starts": np.zeros(B, dtype=np.int32),
@@ -736,6 +762,18 @@ class TpuBatchParser:
             return value
 
         overrides: Dict[str, Dict[int, Any]] = {fid: {} for fid in columns}
+        # Device CSR wildcards (query params): build the per-line override
+        # values from the packed segment table; a resilientUrlDecode failure
+        # is exactly a line the host engine fails, so those rows drop to
+        # invalid and take the oracle (which rejects them identically).
+        t_csr = time.perf_counter()
+        csr_failed = self._materialize_csr(packed, winner, valid, overrides, buf, B)
+        for i in csr_failed:
+            valid[i] = False
+            winner[i] = -1
+            for fid in self.requested:
+                overrides[fid].pop(i, None)
+        trace.add("csr_materialize", time.perf_counter() - t_csr, items=B)
         bad = 0
         invalid_rows = set(int(i) for i in np.nonzero(~valid)[0])
         # Rows the oracle must visit: lines no automaton accepted, plus lines
@@ -782,6 +820,112 @@ class TpuBatchParser:
             list(lines), buf[:B], lengths[:B], valid, columns, overrides,
             good, bad, format_index=winner[:B], oracle_rows=len(need_oracle),
         )
+
+    def _materialize_csr(
+        self, packed, winner, valid, overrides, buf, B
+    ) -> set:
+        """Build override values for device CSR wildcard fields (query
+        params) from the packed segment table.  Per-line work is a few
+        dict inserts per present segment — orders of magnitude cheaper
+        than the full-engine oracle.  Returns rows whose value decode
+        failed (the host engine fails those lines; caller invalidates
+        them so the oracle re-rejects identically)."""
+        from ..dissectors.utils import resilient_url_decode
+        from .pipeline import CSR_SLOTS, csr_group_key
+
+        failed: set = set()
+        if packed is None:
+            return failed
+        for ui, u in enumerate(self.units):
+            qs_plans = [
+                (fid, u.plan_for(fid))
+                for fid in self.requested
+                if u.plan_for(fid).kind == "qscsr"
+                and self._unit_decodable(u, fid)
+            ]
+            if not qs_plans:
+                continue
+            rows = np.nonzero((winner == ui) & valid)[0]
+            if rows.size == 0:
+                continue
+            block = packed[u.row_offset : u.row_offset + u.layout.n_rows]
+            by_key: Dict[str, List] = {}
+            for fid, p in qs_plans:
+                by_key.setdefault(csr_group_key(p), []).append((fid, p))
+            for key, flist in by_key.items():
+                ok = u.layout.get(block, key, "ok") != 0
+                # Through the URI chain the host %-repairs the whole URI
+                # BEFORE the query split (bad escapes -> %25, which also
+                # neuters %uXXXX); direct tokens ($args) reach the query
+                # dissector raw.  The repair inserts only digits, so it
+                # commutes with the device split and can be applied
+                # per-segment here.  Cookies additionally strip whitespace
+                # around names and values (RequestCookieListDissector).
+                uri_chain = bool(flist[0][1].steps)
+                cookie = flist[0][1].meta == "cookie"
+                segs = [
+                    tuple(
+                        u.layout.get(block, key, f"s{k}_{c}")
+                        for c in ("start", "nlen", "eq", "dec", "ndec",
+                                  "vstart", "vlen")
+                    )
+                    for k in range(CSR_SLOTS)
+                ]
+                dicts: Dict[int, Optional[Dict[str, str]]] = {}
+                for i_ in rows:
+                    i = int(i_)
+                    if not ok[i]:
+                        dicts[i] = {}
+                        continue
+                    d: Optional[Dict[str, str]] = {}
+                    for ss, nl, he, dc, nd, vs, vl in segs:
+                        nlen = int(nl[i])
+                        has_eq = bool(he[i])
+                        if nlen == 0 and not has_eq:
+                            continue  # empty slot / skipped empty segment
+                        s0 = int(ss[i])
+                        name = bytes(buf[i, s0 : s0 + nlen]).decode(
+                            "utf-8", "replace"
+                        )
+                        if uri_chain and nd[i]:
+                            name = _fix_uri_part(name, "")
+                        if cookie:
+                            name = name.strip()
+                        name = name.lower()
+                        if name == "":
+                            # "=value": the empty relative name matches
+                            # neither the wildcard nor any concrete target.
+                            continue
+                        if not has_eq:
+                            d[name] = ""
+                            continue
+                        v0 = int(vs[i])
+                        value = bytes(buf[i, v0 : v0 + int(vl[i])]).decode(
+                            "utf-8", "replace"
+                        )
+                        if cookie:
+                            value = value.strip()
+                        if dc[i]:
+                            if uri_chain:
+                                value = _fix_uri_part(value, "")
+                            try:
+                                value = resilient_url_decode(value)
+                            except ValueError:
+                                failed.add(i)
+                                d = None
+                                break
+                        d[name] = value
+                    if d is not None:
+                        dicts[i] = d
+                for fid, p in flist:
+                    tgt = overrides[fid]
+                    if p.comp == "*":
+                        for i, d in dicts.items():
+                            tgt[i] = d
+                    else:
+                        for i, d in dicts.items():
+                            tgt[i] = d.get(p.comp) if d else None
+        return failed
 
     def _run_oracle(self, line: Union[bytes, str]) -> Optional[Dict[str, Any]]:
         if isinstance(line, bytes):

@@ -294,6 +294,20 @@ def ts_group_key(plan: FieldPlan) -> str:
     return f"@ts:{plan.token_index}:{plan.steps!r}"
 
 
+# Segment slots per CSR wildcard split (query params / cookies).  Lines with
+# more segments than slots are routed to the oracle (overflow bit).
+CSR_SLOTS = 16
+
+
+def csr_group_key(plan: FieldPlan) -> str:
+    """All qscsr plans over the same token+steps+mode share one segment
+    table (mode — query vs cookie — picks the separator)."""
+    return f"@qs:{plan.token_index}:{plan.meta}:{plan.steps!r}"
+
+
+_CSR_SEPARATORS = {"query": b"&", "cookie": b"; "}
+
+
 @dataclass
 class PackedLayout:
     """Bit-slot map for the packed [K, B] int32 output (row 0 = validity).
@@ -353,6 +367,23 @@ class PackedLayout:
                         "c2": (r + 1, 0, 0),
                         "off": (r + 2, 0, 0),
                     }
+                    aux_needs.append((key, "ok", 1))
+            elif kind == "qscsr":
+                key = csr_group_key(plan)
+                if key not in layout.slots:
+                    slots: Dict[str, Slot] = {}
+                    for k in range(CSR_SLOTS):
+                        rn = layout.n_rows
+                        rv = layout.n_rows + 1
+                        layout.n_rows += 2
+                        slots[f"s{k}_start"] = (rn, 0, _SPAN_BITS)
+                        slots[f"s{k}_nlen"] = (rn, _SPAN_BITS, _SPAN_BITS)
+                        slots[f"s{k}_eq"] = (rn, 2 * _SPAN_BITS, 1)
+                        slots[f"s{k}_dec"] = (rn, 2 * _SPAN_BITS + 1, 1)
+                        slots[f"s{k}_ndec"] = (rn, 2 * _SPAN_BITS + 2, 1)
+                        slots[f"s{k}_vstart"] = (rv, 0, _SPAN_BITS)
+                        slots[f"s{k}_vlen"] = (rv, _SPAN_BITS, _SPAN_BITS)
+                    layout.slots[key] = slots
                     aux_needs.append((key, "ok", 1))
             else:  # pragma: no cover
                 raise AssertionError(kind)
@@ -514,7 +545,7 @@ def compute_rows(
         chain_cache[key] = (s, e, ok, null, amp, fix)
         return s, e, ok, null, amp, fix
 
-    ts_done = set()
+    group_done = set()  # emitted shared groups (@ts:/@qs: keys)
     for plan in plans:
         if plan.kind == "host":
             continue
@@ -558,10 +589,55 @@ def compute_rows(
                 first = extract(b32, s, 1)[:, 0]
                 leading_zero = ((e - s) > 1) & (first == np.uint8(ord("0")))
                 valid = valid & ~(leading_zero & chain_ok)
-        elif plan.kind == "ts":
-            if ts_group_key(plan) in ts_done:
+        elif plan.kind == "qscsr":
+            key = csr_group_key(plan)
+            if key in group_done:
                 continue
-            ts_done.add(ts_group_key(plan))
+            group_done.add(key)
+            if plan.steps and plan.steps[-1] == ("uri", "query"):
+                # The uri query span keeps its leading '?' (rendered '&'
+                # by the normalization); as QueryStringFieldDissector
+                # input that first separator only produces an empty
+                # segment the host skips — start the split past it.
+                first = extract(b32, s, 1)[:, 0]
+                s = jnp.where(
+                    (s < e) & (first == np.uint8(ord("?"))), s + 1, s
+                )
+            csr = postproc.split_csr(
+                b32, s, e, CSR_SLOTS,
+                sep=_CSR_SEPARATORS[plan.meta or "query"],
+                shift_fn=None if shift_fn is shift_zero else shift_fn,
+            )
+            if not plan.steps:
+                # Direct token capture of the query string: a lone '-' is
+                # null (decode_extracted_value) -> no params delivered.
+                first = extract(b32, s, 1)[:, 0]
+                chain_ok = chain_ok & ~(
+                    ((e - s) == 1) & (first == np.uint8(ord("-")))
+                )
+            for k in range(CSR_SLOTS):
+                seg_s = csr["seg_start"][k]
+                seg_e = csr["seg_end"][k]
+                eq = csr["eq_pos"][k]
+                seg_empty = seg_s >= seg_e
+                nlen = jnp.where(seg_empty, 0, eq - seg_s)
+                has_eq = (~seg_empty) & (eq < seg_e)
+                vstart = jnp.minimum(eq + 1, seg_e)
+                vlen = jnp.where(has_eq, seg_e - vstart, 0)
+                put(key, f"s{k}_start", jnp.where(seg_empty, 0, seg_s))
+                put(key, f"s{k}_nlen", nlen)
+                put(key, f"s{k}_eq", jnp.where(has_eq, 1, 0))
+                put(key, f"s{k}_dec", jnp.where(csr["decode"][k], 1, 0))
+                put(key, f"s{k}_ndec", jnp.where(csr["name_pct"][k], 1, 0))
+                put(key, f"s{k}_vstart", jnp.where(has_eq, vstart, 0))
+                put(key, f"s{k}_vlen", vlen)
+            put(key, "ok", jnp.where(chain_ok, 1, 0))
+            # More segments than slots: the oracle takes the whole line.
+            valid = valid & ~(csr["overflow"] & chain_ok)
+        elif plan.kind == "ts":
+            if ts_group_key(plan) in group_done:
+                continue
+            group_done.add(ts_group_key(plan))
             comp, ok = timeparse.parse_device_timestamp(
                 b32, s, e, plan.meta, extract
             )

@@ -278,6 +278,82 @@ def split_uri_fast(
     }
 
 
+def split_csr(
+    buf: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+    max_segments: int,
+    sep: bytes = b"&",
+    kv: int = ord("="),
+    shift_fn=None,
+) -> Dict[str, object]:
+    """CSR segment split of spans on device: the vectorized core of the
+    wildcard dissectors (QueryStringFieldDissector.java:76-108 splits on
+    ``&`` then ``=``; cookies split on the two-byte ``"; "`` then ``=``).
+
+    Locates up to ``max_segments`` separator-delimited segments per line and,
+    per segment, the first ``kv`` byte.  Returns per-segment arrays (lists of
+    [B] vectors) — segment k spans [seg_start[k], seg_end[k]); name/value
+    split at eq_pos[k] (== seg_end[k] when no kv byte).  ``decode[k]`` marks
+    values containing ``%`` or ``+`` (host applies resilientUrlDecode to
+    exactly those).  ``overflow`` marks lines with more segments than slots —
+    the caller routes them to the oracle.
+
+    Empty segments keep their slot (the host skips them at materialization);
+    compaction on a SIMD machine would cost a sort, skipping on host costs
+    nothing.
+    """
+    B, L = buf.shape
+    n_sep = len(sep)
+    shift = shift_fn or shift_zero
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
+    in_span = (pos >= start[:, None]) & (pos < end[:, None])
+    is_sep = None
+    for k, byte in enumerate(sep):
+        part = shift(buf, k) == np.uint8(byte) if k else (buf == np.uint8(byte))
+        is_sep = part if is_sep is None else (is_sep & part)
+    is_sep = is_sep & in_span & (pos + n_sep <= end[:, None])
+    is_kv = (buf == np.uint8(kv)) & in_span
+    is_dec = (
+        (buf == np.uint8(ord("%"))) | (buf == np.uint8(ord("+")))
+    ) & in_span
+
+    is_pct = (buf == np.uint8(ord("%"))) & in_span
+
+    seg_start: list = []
+    seg_end: list = []
+    eq_pos: list = []
+    decode: list = []
+    name_pct: list = []
+    cursor = start
+    for _ in range(max_segments):
+        usable = is_sep & (pos >= cursor[:, None])
+        nxt = jnp.min(jnp.where(usable, pos, L), axis=1).astype(jnp.int32)
+        s_end = jnp.minimum(nxt, end)
+        eq_usable = is_kv & (pos >= cursor[:, None]) & (pos < s_end[:, None])
+        eq = jnp.min(jnp.where(eq_usable, pos, L), axis=1).astype(jnp.int32)
+        eq = jnp.minimum(eq, s_end)
+        dec_usable = is_dec & (pos > eq[:, None]) & (pos < s_end[:, None])
+        np_usable = is_pct & (pos >= cursor[:, None]) & (pos < eq[:, None])
+        seg_start.append(cursor)
+        seg_end.append(s_end)
+        eq_pos.append(eq)
+        decode.append(jnp.any(dec_usable, axis=1))
+        name_pct.append(jnp.any(np_usable, axis=1))
+        cursor = s_end + n_sep
+    # One more separator past the last slot = segments we cannot ship.
+    usable = is_sep & (pos >= cursor[:, None])
+    has_more = jnp.any(usable, axis=1) | (cursor < end)
+    return {
+        "seg_start": seg_start,
+        "seg_end": seg_end,
+        "eq_pos": eq_pos,
+        "decode": decode,
+        "name_pct": name_pct,
+        "overflow": has_more,
+    }
+
+
 def split_firstline(
     buf: jnp.ndarray,
     lengths: jnp.ndarray,

@@ -1,0 +1,153 @@
+"""Differential tests for the device query-string CSR path
+(postproc.split_csr + the override materializer in TpuBatchParser).
+
+SURVEY §7.4: wildcard extraction as CSR (offsets+values) device output —
+splitting/locating on device, resilientUrlDecode host-side on exactly the
+flagged values (QueryStringFieldDissector.java:76-108 semantics).
+"""
+import random
+
+from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+WILD = "STRING:request.firstline.uri.query.*"
+SPEC = "STRING:request.firstline.uri.query.img"
+PREFIX = "STRING:request.firstline.uri.query."
+
+
+def assert_csr_matches(parser, lines):
+    result = parser.parse_batch(lines)
+    wcol = result.to_pylist(WILD)
+    scol = result.to_pylist(SPEC)
+    n_valid = 0
+    for i, line in enumerate(lines):
+        try:
+            rec = parser.oracle.parse(line, _CollectingRecord())
+            ok = True
+        except Exception:
+            rec, ok = None, False
+        assert bool(result.valid[i]) == ok, (i, line)
+        if not ok:
+            continue
+        n_valid += 1
+        want_w = {
+            k[len(PREFIX):]: v
+            for k, v in rec.values.items()
+            if k.startswith(PREFIX)
+        }
+        assert wcol[i] == want_w, (i, line, wcol[i], want_w)
+        assert scol[i] == rec.values.get(SPEC), (i, line)
+    return n_valid, result
+
+
+class TestQueryCsrDevice:
+    def test_plans_resolve_to_csr(self):
+        p = TpuBatchParser("common", [WILD, SPEC])
+        assert p.plan_by_id[WILD].kind == "qscsr"
+        assert p.plan_by_id[WILD].comp == "*"
+        assert p.plan_by_id[SPEC].kind == "qscsr"
+        assert p.plan_by_id[SPEC].comp == "img"
+        assert p._unit_oracle_fields == [[]]
+
+    def test_enumerated_queries(self):
+        uris = [
+            "/x?a=1&b=2", "/x?img=cat%20dog&B=3", "/plain", "/x?novalue",
+            "/x?a=%u0041", "/x?=weird", "/x?dup=1&dup=2", "/x?plus=a+b",
+            "/x?a=1&&b=2", "/x?trail&", "/x?a", "/x?img=%e9chop%",
+            "/x?img=%u00e9", "/x?na%me=1", "/x?n%=v", "/x?a%41me=ok",
+            "/x?" + "&".join(f"p{i}={i}" for i in range(20)),  # overflow
+            "/x?IMG=Upper&MiXeD=Case",
+        ]
+        lines = [
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET {u} HTTP/1.1" '
+            f"200 {i + 1}"
+            for i, u in enumerate(uris)
+        ]
+        p = TpuBatchParser("common", [WILD, SPEC])
+        n_valid, _ = assert_csr_matches(p, lines)
+        assert n_valid >= len(uris) - 1
+
+    def test_direct_token_args(self):
+        # nginx $args: the query dissector receives the RAW token (no URI
+        # repair chain), and '-' means null.
+        p = TpuBatchParser('$remote_addr [$time_local] "$args" $status',
+                           [WILD, SPEC])
+        assert p.plan_by_id[WILD].kind == "qscsr"
+        args = ["a=1&b=2", "-", "", "?lead=1", "x=%u0041", "plus=a+b",
+                "bad=%zz", "NAME=Q", "=v", "a%me=1", "img=direct"]
+        lines = [
+            f'2.2.2.2 [07/Mar/2026:10:00:00 +0000] "{a}" 200' for a in args
+        ]
+        assert_csr_matches(p, lines)
+
+    def test_fuzzed_queries(self):
+        rng = random.Random(4242)
+        alphabet = "abIMG019%=&+u?_."
+        uris = []
+        for _ in range(250):
+            n = rng.randint(0, 20)
+            uris.append(
+                "/p?" + "".join(rng.choice(alphabet) for _ in range(n))
+            )
+        lines = [
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET {u} HTTP/1.1" '
+            f"200 7"
+            for u in uris
+        ]
+        p = TpuBatchParser("common", [WILD, SPEC])
+        assert_csr_matches(p, lines)
+
+    def test_clean_queries_avoid_oracle(self):
+        uris = [f"/x?q={i}&user=u{i}&img=i{i}" for i in range(32)]
+        lines = [
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET {u} HTTP/1.1" '
+            f"200 7"
+            for u in uris
+        ]
+        p = TpuBatchParser("common", [WILD, SPEC])
+        result = p.parse_batch(lines)
+        assert result.oracle_rows == 0
+        assert all(result.valid)
+
+
+class TestCookieCsrDevice:
+    """Request-cookie wildcard on the same CSR machinery ("; " separator,
+    stripped names/values — RequestCookieListDissector semantics)."""
+
+    W = "HTTP.COOKIE:request.cookies.*"
+    S = "HTTP.COOKIE:request.cookies.sid"
+    PREFIX = "HTTP.COOKIE:request.cookies."
+
+    def test_cookie_differential(self):
+        fmt = '%h %l %u %t "%r" %>s %b "%{Cookie}i"'
+        p = TpuBatchParser(fmt, [self.W, self.S])
+        assert p.plan_by_id[self.W].kind == "qscsr"
+        assert p.plan_by_id[self.W].meta == "cookie"
+        cookies = [
+            "sid=abc123; theme=dark", "sid=x%20y; a=b+c", "-", "", "single",
+            "sid=1;bad=nospace", "  sid = padded ; x=y", "sid=%u0041",
+            "sid=%zz", "a=1; " * 20 + "z=2", "Name=Mixed; UP=1",
+        ]
+        lines = [
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET /x HTTP/1.1" '
+            f'200 5 "{c}"'
+            for c in cookies
+        ]
+        result = p.parse_batch(lines)
+        wcol = result.to_pylist(self.W)
+        scol = result.to_pylist(self.S)
+        for i, line in enumerate(lines):
+            try:
+                rec = p.oracle.parse(line, _CollectingRecord())
+                ok = True
+            except Exception:
+                rec, ok = None, False
+            assert bool(result.valid[i]) == ok, (i, cookies[i])
+            if not ok:
+                continue
+            want = {
+                k[len(self.PREFIX):]: v
+                for k, v in rec.values.items()
+                if k.startswith(self.PREFIX)
+            }
+            assert wcol[i] == want, (i, cookies[i], wcol[i], want)
+            assert scol[i] == rec.values.get(self.S), (i, cookies[i])
