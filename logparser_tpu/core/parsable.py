@@ -50,39 +50,59 @@ class Parsable:
         value: Union[Value, str, int, float, None],
         _recursion: bool = False,
     ) -> "Parsable":
+        # Dissectors add every output they produce; most are unwanted, and
+        # the routing decision for a given (base, type, name) triple is
+        # LINE-INVARIANT — memoize it on the parser so the common unwanted
+        # case costs one dict probe and no object construction.
+        memo = self.parser.dissection_memo
+        entry = memo.get((base, ftype, name))
+        if entry is None:
+            if base == "":  # the root name is an empty string
+                complete_name = name
+                needed_wildcard = ftype + ":*"
+            else:
+                complete_name = base if name == "" else base + "." + name
+                needed_wildcard = ftype + ":" + base + ".*"
+            needed_name = ftype + ":" + complete_name
+            remapped = self.type_remappings.get(complete_name)
+            entry = (
+                tuple(remapped) if remapped else (),
+                complete_name in self.useful_intermediates,
+                needed_name in self.needed,
+                needed_wildcard in self.needed,
+                complete_name,
+                needed_name,
+                needed_wildcard,
+            )
+            memo[(base, ftype, name)] = entry
+        (remapped_types, is_intermediate, is_needed, is_wild,
+         complete_name, needed_name, needed_wildcard) = entry
+
+        if not _recursion:
+            for new_type in remapped_types:
+                if new_type == ftype:
+                    raise DissectionFailure(
+                        "[Type Remapping] Trying to map to the same type "
+                        f"(mapping definition bug!): base={base} type={ftype} name={name}"
+                    )
+                self.add_dissection(base, new_type, name, value, _recursion=True)
+
+        if not (is_intermediate or is_needed or is_wild):
+            return self
+
         if not isinstance(value, Value):
             value = Value(value)
 
-        if base == "":  # the root name is an empty string
-            complete_name = name
-            needed_wildcard = ftype + ":*"
-        else:
-            complete_name = base if name == "" else base + "." + name
-            needed_wildcard = ftype + ":" + base + ".*"
-        needed_name = ftype + ":" + complete_name
-
-        if not _recursion:
-            remapped = self.type_remappings.get(complete_name)
-            if remapped:
-                for new_type in remapped:
-                    if new_type == ftype:
-                        raise DissectionFailure(
-                            "[Type Remapping] Trying to map to the same type "
-                            f"(mapping definition bug!): base={base} type={ftype} name={name}"
-                        )
-                    self.add_dissection(base, new_type, name, value, _recursion=True)
-
-        pf = ParsedField(ftype, complete_name, value)
-
-        if complete_name in self.useful_intermediates:
+        if is_intermediate:
+            pf = ParsedField(ftype, complete_name, value)
             self._cache[pf.id] = pf
             self.to_be_parsed.add(pf)
 
-        if needed_name in self.needed:
+        if is_needed:
             self.delivered.add(needed_name)
             self.parser.store(self.record, needed_name, needed_name, value)
 
-        if needed_wildcard in self.needed:
+        if is_wild:
             self.parser.store(self.record, needed_wildcard, needed_name, value)
         return self
 

@@ -151,6 +151,9 @@ class Parser:
         self._located_targets: Set[str] = set()
         self._needed_frozen: Optional[FrozenSet[str]] = None
         self._last_chance: Dict[str, Tuple[str, Any]] = {}
+        # Line-invariant add_dissection routing decisions, keyed by
+        # (base, type, name); reset whenever the parser (re)assembles.
+        self.dissection_memo: Dict[tuple, tuple] = {}
 
         if record_class is not None:
             for name in dir(record_class):
@@ -322,6 +325,7 @@ class Parser:
             return
         if self.root_type is None:
             raise InvalidDissectorException("No root type was set")
+        self.dissection_memo = {}  # targets may have changed since last run
 
         # Fixpoint: dissectors may register additional dissectors recursively.
         done: Set[int] = set()

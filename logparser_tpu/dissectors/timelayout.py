@@ -147,13 +147,85 @@ class TimeLayout:
         # tzdata id applied when the layout itself carries no zone
         # (StrfTimeToDateTimeFormatter.java:97-105 defaults likewise).
         self.default_zone = default_zone
+        self._fast = None          # lazily compiled regex fast path
+        self._fast_tried = False
 
     def has_zone(self) -> bool:
         return any(it[0] in ("offset", "offset_colon", "zonetext") for it in self.items)
 
     # -- parsing ---------------------------------------------------------
 
+    def _compile_fast(self):
+        """One anchored regex for fixed-width layouts (the hot shapes).
+        Returns (pattern, extractors) or None when any item is variable
+        width — regex backtracking could then accept inputs the greedy
+        item-by-item parser rejects, so those layouts keep the slow path.
+        """
+        parts: List[str] = []
+        extractors: List = []  # (kind, field_or_table)
+        for it in self.items:
+            kind = it[0]
+            if kind == "lit":
+                parts.append(re.escape(it[1]))
+            elif kind == "num":
+                _, field, minw, maxw, space_pad = it
+                if space_pad or minw != maxw:
+                    return None
+                parts.append(f"(\\d{{{minw}}})")
+                extractors.append(("num", field))
+            elif kind == "text":
+                _, field, style = it
+                if field == "monthname":
+                    table = MONTHS_FULL if style == "full" else MONTHS_SHORT
+                    key = "month"
+                elif field == "dayname":
+                    table = DAYS_FULL if style == "full" else DAYS_SHORT
+                    key = "dayofweek"
+                else:
+                    table = ["AM", "PM"]
+                    key = "ampm"
+                alts = sorted(table, key=len, reverse=True)
+                parts.append("(" + "|".join(re.escape(a) for a in alts) + ")")
+                extractors.append(("text", (key, [a.lower() for a in table])))
+            elif kind == "offset":
+                parts.append(r"([+-]\d{2}:?\d{2})")
+                extractors.append(("offset", None))
+            elif kind == "offset_colon":
+                parts.append(r"(Z|[+-]\d{2}:\d{2})")
+                extractors.append(("offset", None))
+            else:  # zonetext: zone resolution stays on the slow path
+                return None
+        return re.compile("".join(parts) + r"\Z", re.IGNORECASE), extractors
+
     def parse(self, s: str) -> ParsedTimestamp:
+        if not self._fast_tried:
+            self._fast_tried = True
+            self._fast = self._compile_fast()
+        if self._fast is not None:
+            m = self._fast[0].match(s)
+            if m is not None:
+                fields: dict = {}
+                for (kind, spec), group in zip(self._fast[1], m.groups()):
+                    if kind == "num":
+                        fields[spec] = int(group)
+                    elif kind == "text":
+                        key, lowered = spec
+                        idx = lowered.index(group.lower())
+                        fields[key] = idx + 1 if key == "month" else idx
+                    else:  # offset
+                        if group in ("Z", "z"):
+                            fields["offset"] = 0
+                        else:
+                            sign = -1 if group[0] == "-" else 1
+                            hh = int(group[1:3])
+                            mm = int(group[-2:])
+                            fields["offset"] = sign * (hh * 3600 + mm * 60)
+                return self._resolve(fields, s)
+            # fall through: the item-by-item parser produces the exact
+            # error message (index of the first mismatch)
+        return self._parse_slow(s)
+
+    def _parse_slow(self, s: str) -> ParsedTimestamp:
         fields = {}
         pos = 0
         n = len(s)

@@ -92,6 +92,13 @@ class GeoDeviceTable:
                 self.vocabs[c] = vocab
                 self.arrays[c] = np.asarray([0] + idx_col, dtype=np.int32)
 
+        # Object-array views of the vocabularies, built once: the batch
+        # materializer indexes these per batch (a production City database
+        # has ~1e5 names; rebuilding per batch would be O(vocab) each time).
+        self.vocab_arrays: Dict[str, np.ndarray] = {
+            c: np.asarray(v, dtype=object) for c, v in self.vocabs.items()
+        }
+
     def lookup_rows(self, ips_u32):
         """[B] uint32 -> [B] int32 row (0 = miss; row r = range r-1). Jittable."""
         import jax.numpy as jnp

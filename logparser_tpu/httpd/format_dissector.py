@@ -29,6 +29,14 @@ class HttpdLogFormatDissector(Dissector):
         self.dissectors: List[TokenFormatDissector] = []
         self.active_dissector: Optional[TokenFormatDissector] = None
         self._enable_jetty_fix = False
+        # Reference semantics are STATEFUL: the last-successful format stays
+        # active across lines (HttpdLogFormatDissector.java:174-204), so a
+        # line matching several formats parses differently depending on
+        # stream history.  Stateless mode re-tries from the first registered
+        # format on every line — deterministic registration priority, the
+        # semantics the batch/TPU path guarantees (and needs from its
+        # fallback oracle so device and oracle agree per line).
+        self.stateless = False
         if multi_line_log_format is not None:
             self.add_multiple_log_formats(multi_line_log_format)
             if self._enable_jetty_fix:
@@ -141,6 +149,7 @@ class HttpdLogFormatDissector(Dissector):
         new_instance.add_log_formats(self._get_all_log_formats())
         if self._enable_jetty_fix:
             new_instance.enable_jetty_fix()
+        new_instance.stateless = self.stateless
 
     # -- dissection with fallback/switch ---------------------------------
 
@@ -149,7 +158,7 @@ class HttpdLogFormatDissector(Dissector):
             raise DissectionFailure(
                 "We need one or more logformats before we can dissect."
             )
-        if self.active_dissector is None:
+        if self.stateless or self.active_dissector is None:
             self.active_dissector = self.dissectors[0]
 
         try:

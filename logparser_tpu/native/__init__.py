@@ -74,6 +74,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                       ctypes.c_int64, ctypes.c_int64,
                                       ctypes.c_int32]
         lib.lp_frame_pack.restype = ctypes.c_int64
+        lib.lp_gather_spans.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64,
+                                        i32p, i64p, u8p, ctypes.c_int32]
+        lib.lp_gather_spans.restype = None
         _lib = lib
         return _lib
 
@@ -150,6 +153,43 @@ def encode_blob(
     overflow = np.nonzero(lengths & _OVERFLOW_BIT)[0]
     lengths = (lengths & ~_OVERFLOW_BIT).astype(np.int32)
     return buf[:n], lengths[:n], [int(i) for i in overflow if i < n]
+
+
+def gather_spans(
+    buf: np.ndarray,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    threads: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Materialize per-row spans of a [B, L] buffer as one flat byte array.
+
+    Returns (data, offsets64): row r's bytes are
+    ``data[offsets[r]:offsets[r+1]]``.  Rows with lens[r] == 0 are empty.
+    The C++ path runs a threaded memcpy fan-out; the numpy fallback uses
+    the repeat-index gather (same algorithm as the Arrow bridge).
+    """
+    B, L = buf.shape
+    lens64 = np.asarray(lens, dtype=np.int64)
+    offsets = np.zeros(B + 1, dtype=np.int64)
+    np.cumsum(lens64, out=offsets[1:])
+    total = int(offsets[-1])
+    lib = get_lib()
+    starts32 = np.ascontiguousarray(starts, dtype=np.int32)
+    buf_c = np.ascontiguousarray(buf)
+    if lib is not None:
+        data = np.empty(total, dtype=np.uint8)
+        lib.lp_gather_spans(
+            _u8(buf_c), B, L,
+            starts32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _u8(data), threads or _default_threads(),
+        )
+        return data, offsets
+    row_base = np.arange(B, dtype=np.int64) * L + starts32
+    idx = np.repeat(row_base - offsets[:-1], lens64) + np.arange(
+        total, dtype=np.int64
+    )
+    return buf_c.reshape(-1)[idx], offsets
 
 
 def _encode_blob_numpy(

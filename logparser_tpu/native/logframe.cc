@@ -96,6 +96,36 @@ void lp_pack(const uint8_t* data, const int64_t* offsets,
   for (auto& th : pool) th.join();
 }
 
+// Span gather: per-row (start, end) windows of a padded [B, L] buffer ->
+// one flat byte stream at precomputed destination offsets.  The inverse of
+// lp_pack — it materializes device span columns (string fields) for
+// non-Arrow consumers without a per-row Python loop.  Rows with
+// offsets[r] == offsets[r+1] (invalid/null/empty) copy nothing.
+void lp_gather_spans(const uint8_t* buf, int64_t B, int64_t L,
+                     const int32_t* starts, const int64_t* offsets,
+                     uint8_t* out, int32_t threads) {
+  if (threads < 1) threads = 1;
+  int64_t chunk = (B + threads - 1) / threads;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      int64_t len = offsets[r + 1] - offsets[r];
+      if (len <= 0) continue;
+      std::memcpy(out + offsets[r], buf + r * L + starts[r], len);
+    }
+  };
+  if (threads == 1 || B < 4096) {
+    work(0, B);
+    return;
+  }
+  std::vector<std::thread> pool;
+  for (int32_t t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(B, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back(work, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+}
+
 // One-shot convenience: frame + pack a whole blob.  Returns line count.
 int64_t lp_frame_pack(const uint8_t* data, int64_t size,
                       uint8_t* out, int32_t* lengths,

@@ -24,56 +24,26 @@ if TYPE_CHECKING:  # pragma: no cover
 
 
 
-def _spans_to_string_array(result: "BatchResult", col) -> Optional[Any]:
-    """Vectorized span -> pa.StringArray: one flat gather from the [B, L]
-    byte buffer via offsets built with cumsum/repeat.  Returns None when the
-    gathered bytes are not valid UTF-8 (caller falls back to the per-row
-    decode with errors="replace")."""
+def _spans_to_string_array(result: "BatchResult", field_id: str) -> Optional[Any]:
+    """Vectorized span -> pa.StringArray built on BatchResult.span_bytes
+    (the single flat-gather implementation: validity mask, native gather,
+    ?&-normalization).  Returns None when the column needs the per-row path
+    or the gathered bytes are not valid UTF-8."""
     import pyarrow as pa
 
     B = result.lines_read
     if B == 0:
         return pa.array([], type=pa.string())
-    L = result.buf.shape[1]
-    starts = np.asarray(col["starts"][:B], dtype=np.int64)
-    ends = np.asarray(col["ends"][:B], dtype=np.int64)
-    ok = (
-        np.asarray(result.valid[:B]).astype(bool)
-        & np.asarray(col["ok"][:B]).astype(bool)
-    )
-    buf = result.buf[:B]
-    # Device-computed null bit: CLF '-' token captures and undelivered URI
-    # parts (decode_extracted_value semantics live in the device pipeline).
-    valid = ok & ~np.asarray(col["null"][:B]).astype(bool)
-
-    lens = np.where(valid, ends - starts, 0).astype(np.int64)
-    offsets64 = np.zeros(B + 1, dtype=np.int64)
-    np.cumsum(lens, out=offsets64[1:])
-    total = int(offsets64[-1])
-    if total > np.iinfo(np.int32).max:
+    flat = result.span_bytes(field_id)
+    if flat is None:
+        return None
+    data, offsets64, valid = flat
+    if int(offsets64[-1]) > np.iinfo(np.int32).max:
         # int32 StringArray offsets would wrap; don't rely on validate()
         # catching it after the full gather — take the fallback path now.
         return None
+    data = np.ascontiguousarray(data)
     offsets = offsets64.astype(np.int32)
-    row_base = np.arange(B, dtype=np.int64) * L + starts
-    # One repeat, not two: element j of row i sits at buf_flat[row_base[i]+j]
-    # and lands at data[offsets[i]+j], so the per-element shift is constant
-    # within a row.
-    idx = np.repeat(row_base - offsets64[:-1], lens) + np.arange(
-        total, dtype=np.int64
-    )
-    data = np.ascontiguousarray(buf).reshape(-1)[idx]
-    amp = col.get("amp")
-    if amp is not None and amp[:B].any():
-        # ?& query normalization: a leading '?' renders as '&'.
-        first_pos = offsets64[:-1]
-        swap = (
-            valid & np.asarray(amp[:B]).astype(bool) & (lens > 0)
-        )
-        swap_at = first_pos[swap]
-        swap_at = swap_at[data[swap_at] == np.uint8(ord("?"))]
-        data[swap_at] = np.uint8(ord("&"))
-
     null_bitmap = np.packbits(valid, bitorder="little")
     # pa.py_buffer wraps the numpy arrays zero-copy (buffer protocol);
     # .tobytes() here would duplicate the data buffer per batch.
@@ -129,7 +99,7 @@ def _column_to_arrow(result: "BatchResult", field_id: str):
         and not overrides
         and (fix is None or not fix[: result.lines_read].any())
     ):
-        arr = _spans_to_string_array(result, col)
+        arr = _spans_to_string_array(result, field_id)
         if arr is not None:
             return arr
 

@@ -64,8 +64,11 @@ def _default_use_pallas() -> bool:
     (L=384, combined, in-jit marginal rate so dispatch overhead is excluded):
     XLA's own fusion of the masked-reduction pipeline runs ~4.5x faster than
     the hand-written Pallas kernel (~45M vs ~10M lines/s/chip) — the workload
-    is exactly the elementwise+reduce shape XLA fuses best.  The kernel
-    remains available via LOGPARSER_TPU_PALLAS=1 or use_pallas=True."""
+    is exactly the elementwise+reduce shape XLA fuses best.  The kernel is
+    EXPERIMENTAL (see the ADR in ROADMAP.md): it remains available via
+    LOGPARSER_TPU_PALLAS=1 or use_pallas=True as a semantics cross-check,
+    but chained plans (timestamp components, URI splits, CSR) do not lower
+    through Mosaic on current toolchains."""
     env = os.environ.get("LOGPARSER_TPU_PALLAS")
     if env is not None:
         return env.strip().lower() not in ("0", "false", "no")
@@ -94,6 +97,35 @@ class _CollectingRecord:
 
     def set_value(self, name: str, value) -> None:
         self.values[name] = value
+
+
+# ---------------------------------------------------------------------------
+# Parallel oracle fallback: the per-line engine is pure Python, so large
+# fallback sets (hostile batches, host-only fields) are fanned out over a
+# persistent spawn pool — each worker holds ONE unpickled oracle parser (the
+# reference's serialize-config-to-workers distribution contract, SURVEY §3.4,
+# applied to the fallback path).
+# ---------------------------------------------------------------------------
+
+_WORKER_PARSER = None
+
+
+def _oracle_worker_init(blob: bytes) -> None:
+    global _WORKER_PARSER
+    import pickle
+
+    _WORKER_PARSER = pickle.loads(blob)
+    _WORKER_PARSER.assemble_dissectors()
+
+
+def _oracle_worker_run(lines: List[str]) -> List[Optional[Dict[str, Any]]]:
+    out: List[Optional[Dict[str, Any]]] = []
+    for line in lines:
+        try:
+            out.append(_WORKER_PARSER.parse(line, _CollectingRecord()).values)
+        except DissectionFailure:
+            out.append(None)
+    return out
 
 
 class BatchResult:
@@ -175,6 +207,41 @@ class BatchResult:
     def to_dict(self) -> Dict[str, List[Any]]:
         return {fid: self.to_pylist(fid) for fid in self._columns}
 
+    def span_bytes(self, field_id: str):
+        """Flat-bytes view of a device span column for non-Arrow consumers:
+        (data uint8, offsets int64, valid bool) — row r's raw value is
+        ``data[offsets[r]:offsets[r+1]]`` when valid[r].  Uses the native
+        threaded gather (numpy fallback inside).  Returns None when the
+        column has host overrides or repair (`fix`) rows — those need the
+        per-row path (:meth:`to_pylist`)."""
+        from ..native import gather_spans
+
+        field_id = cleanup_field_value(field_id)
+        col = self._columns[field_id]
+        if col["kind"] != "span" or self._overrides.get(field_id):
+            return None
+        B = self.lines_read
+        fix = col.get("fix")
+        if fix is not None and fix[:B].any():
+            return None
+        valid = (
+            np.asarray(self.valid[:B]).astype(bool)
+            & np.asarray(col["ok"][:B]).astype(bool)
+            & ~np.asarray(col["null"][:B]).astype(bool)
+        )
+        starts = np.asarray(col["starts"][:B], dtype=np.int32)
+        lens = np.where(
+            valid, np.asarray(col["ends"][:B]) - starts, 0
+        ).astype(np.int64)
+        data, offsets = gather_spans(self.buf[:B], starts, lens)
+        amp = col.get("amp")
+        if amp is not None and amp[:B].any():
+            swap = valid & np.asarray(amp[:B]).astype(bool) & (lens > 0)
+            at = offsets[:-1][swap]
+            at = at[data[at] == np.uint8(ord("?"))]
+            data[at] = np.uint8(ord("&"))  # the ?& query normalization
+        return data, offsets, valid
+
     def to_arrow(self, include_validity: bool = True):
         """Materialize as a pyarrow.Table (see tpu/arrow_bridge.py)."""
         from .arrow_bridge import batch_to_arrow
@@ -212,8 +279,13 @@ class TpuBatchParser:
             _default_use_pallas() if use_pallas is None else use_pallas
         )
 
-        # Host oracle parser (also the metadata source).
+        # Host oracle parser (also the metadata source).  Pinned STATELESS:
+        # the batch path guarantees deterministic per-line registration
+        # priority across formats, so its fallback oracle must not carry
+        # the reference's active-format state between lines (see
+        # HttpdLogFormatDissector.stateless).
         self.oracle = HttpdLoglineParser(_CollectingRecord, log_format, timestamp_format)
+        self.oracle.all_dissectors[0].stateless = True
         self.oracle.apply_config(type_remappings, extra_dissectors)
         self.oracle.add_parse_target("set_value", list(self.requested))
         self.oracle.assemble_dissectors()
@@ -335,6 +407,8 @@ class TpuBatchParser:
             return "numeric" if timefields.is_numeric_output(plan.comp) else "obj"
         if plan.kind == "qscsr":
             return "wild"
+        if plan.kind == "geo":
+            return "obj"
         return "host"
 
     def _unit_decodable(self, unit: FormatUnit, field_id: str) -> bool:
@@ -438,6 +512,15 @@ class TpuBatchParser:
                 "protocol", "userinfo", "host", "port", "path", "query", "ref"
             ):
                 return ("span", vctx, steps + (("uri", oname),), device_ok)
+        from ..geoip.dissectors import AbstractGeoIPDissector
+
+        if isinstance(d, AbstractGeoIPDissector) and parse == "":
+            table = self._geo_table_for(d) if device_ok else None
+            if table is not None and oname in table.columns:
+                tag = f"{type(d).__name__}:{d.database_file_name}"
+                return ("geo", vctx, steps, device_ok, oname,
+                        (tag, oname, table))
+            return ("geo", vctx, steps, False, oname, None)
         if isinstance(d, (TimeStampDissector, StrfTimeStampDissector)) and parse == "":
             if oname in timefields.DEVICE_COMPONENTS:
                 inner = (
@@ -454,6 +537,28 @@ class TpuBatchParser:
             return ("ts", vctx, steps, False, oname, None)
         # Not device-modeled: the path still counts as a producer.
         return ("value", vctx, steps, False)
+
+    def _geo_table_for(self, d):
+        """Build (once per database) the flattened device range-join table
+        for a GeoIP dissector; None when the database cannot back one."""
+        from ..geoip.device import _EXTRACTORS, GeoDeviceTable
+        from ..geoip.mmdb import MMDBReader
+
+        key = (type(d).__name__, d.database_file_name)
+        if not hasattr(self, "_geo_tables"):
+            self._geo_tables: Dict[tuple, Any] = {}
+        if key not in self._geo_tables:
+            try:
+                columns = [
+                    o.partition(":")[2]
+                    for o in d.get_possible_output()
+                    if o.partition(":")[2] in _EXTRACTORS
+                ]
+                reader = MMDBReader(d.database_file_name)
+                self._geo_tables[key] = GeoDeviceTable(reader, columns)
+            except Exception:
+                self._geo_tables[key] = None
+        return self._geo_tables[key]
 
     def _chase(
         self, field_id, ftype, path, tok, t, name,
@@ -512,17 +617,17 @@ class TpuBatchParser:
                     continue
                 spec = self._step_spec(d, oname, vctx, steps, device_ok)
                 kind = spec[0]
-                if kind == "ts":
-                    _, nctx, nsteps, ndev, comp, dl = spec
+                if kind in ("ts", "geo"):
+                    _, nctx, nsteps, ndev, comp, meta = spec
                     if path == new_name and ot == ftype:
                         if ndev:
                             plans.append(_FieldPlan(
-                                field_id, "ts", tok.index, nsteps,
-                                comp=comp, meta=dl,
+                                field_id, kind, tok.index, nsteps,
+                                comp=comp, meta=meta,
                             ))
                         else:
                             plans.append(_FieldPlan(field_id, "host"))
-                    # ts outputs are terminal values; nothing deeper.
+                    # ts/geo outputs are terminal values; nothing deeper.
                     continue
                 _, nctx, nsteps, ndev = spec
                 if path == new_name and ot == ftype:
@@ -699,6 +804,25 @@ class TpuBatchParser:
                     values = timefields.derive(comp, plan.comp, memo)
                     col["values"] = np.where(sel, values, col["values"])
                     col["ok"] = np.where(sel, ok, col["ok"])
+                elif plan.kind == "geo":
+                    from .pipeline import geo_group_key
+
+                    _, column, table = plan.meta
+                    block = packed[u.row_offset : u.row_offset + u.layout.n_rows]
+                    key = geo_group_key(plan)
+                    rows_idx = u.layout.get(block, key, "row")[:B]
+                    ok = (u.layout.get(block, key, "ok") != 0)[:B]
+                    arr = table.arrays[column][rows_idx]
+                    if column in table.vocabs:
+                        values = table.vocab_arrays[column][arr]
+                    elif arr.dtype.kind == "f":
+                        values = arr.astype(object)
+                        values[np.isnan(arr)] = None
+                    else:
+                        values = arr.astype(object)
+                        values[arr < 0] = None
+                    col["values"] = np.where(sel, values, col["values"])
+                    col["ok"] = np.where(sel, ok, col["ok"])
                 else:  # long / secmillis
                     is_null = unit_get(u, fid, "null") != 0
                     values = postproc.combine_long_limbs(
@@ -783,14 +907,17 @@ class TpuBatchParser:
             if flds:
                 need_oracle.update(int(r) for r in np.nonzero(winner == ui)[0])
         t_oracle = time.perf_counter()
-        for i in sorted(need_oracle):
+        oracle_rows_sorted = sorted(need_oracle)
+        oracle_results = self._run_oracle_many(
+            [lines[i] for i in oracle_rows_sorted]
+        )
+        for i, values in zip(oracle_rows_sorted, oracle_results):
             is_invalid = i in invalid_rows
             fields_needed = (
                 self.requested
                 if is_invalid
                 else self._unit_oracle_fields[winner[i]]
             )
-            values = self._run_oracle(lines[i])
             if values is None:
                 if is_invalid:
                     bad += 1
@@ -936,6 +1063,93 @@ class TpuBatchParser:
             return None
         return record.values
 
+    # Fallback sets at least this large fan out over the process pool;
+    # smaller ones run inline (pool startup is ~seconds once per parser).
+    oracle_parallel_threshold = 512
+
+    def _oracle_pool_get(self):
+        if getattr(self, "_oracle_pool", None) is None:
+            import multiprocessing as mp
+            import pickle
+
+            n = min(8, os.cpu_count() or 1)
+            if n < 2 or os.environ.get("LOGPARSER_TPU_ORACLE_PROCS") == "0":
+                self._oracle_pool = False
+            else:
+                # The workers run the pure-Python oracle only: scrub
+                # accelerator bootstrap variables from the child env so
+                # site hooks don't drag a device runtime (and possibly a
+                # device-attachment handshake) into every worker.
+                scrub = ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
+                saved = {v: os.environ.pop(v) for v in scrub if v in os.environ}
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                try:
+                    ctx = mp.get_context("spawn")
+                    pool = ctx.Pool(
+                        n,
+                        initializer=_oracle_worker_init,
+                        initargs=(pickle.dumps(self.oracle),),
+                    )
+                    # Readiness probe: a child-side initializer failure
+                    # (e.g. the oracle references a __main__-defined
+                    # dissector the spawn child cannot import) makes Pool
+                    # respawn dying workers forever and map() would hang —
+                    # probe with a timeout and fall back inline instead.
+                    try:
+                        pool.apply_async(_oracle_worker_run, ([],)).get(
+                            timeout=120
+                        )
+                    except Exception:
+                        pool.terminate()
+                        pool.join()
+                        raise
+                    self._oracle_pool = pool
+                    self._oracle_pool_n = n
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "oracle worker pool unavailable; falling back to "
+                        "inline parsing", exc_info=True,
+                    )
+                    self._oracle_pool = False
+                finally:
+                    os.environ.pop("JAX_PLATFORMS", None)
+                    os.environ.update(saved)
+        return self._oracle_pool or None
+
+    def _run_oracle_many(
+        self, lines: List[Union[bytes, str]]
+    ) -> List[Optional[Dict[str, Any]]]:
+        """Oracle-parse many lines, fanning out over the worker pool when
+        the set is large enough to amortize IPC."""
+        decoded = [
+            ln.decode("utf-8", errors="replace") if isinstance(ln, bytes) else ln
+            for ln in lines
+        ]
+        pool = (
+            self._oracle_pool_get()
+            if len(decoded) >= self.oracle_parallel_threshold
+            else None
+        )
+        if pool is None:
+            return [self._run_oracle(ln) for ln in decoded]
+        n_chunks = self._oracle_pool_n * 4
+        size = max(1, (len(decoded) + n_chunks - 1) // n_chunks)
+        chunks = [decoded[i : i + size] for i in range(0, len(decoded), size)]
+        out: List[Optional[Dict[str, Any]]] = []
+        for part in pool.map(_oracle_worker_run, chunks):
+            out.extend(part)
+        return out
+
+    def close(self) -> None:
+        """Release the fallback worker pool (if one was started)."""
+        pool = getattr(self, "_oracle_pool", None)
+        if pool:
+            pool.terminate()
+            pool.join()
+        self._oracle_pool = None
+
     # ------------------------------------------------------------------
     # serialization — the compiled format program (token tables, split ops,
     # packed layouts, field plans) is a serializable, device-loadable
@@ -956,6 +1170,7 @@ class TpuBatchParser:
         state = self.__dict__.copy()
         state["_jitted"] = None
         state["_pallas_fns"] = {}
+        state["_oracle_pool"] = None  # worker pools never ship in artifacts
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:

@@ -308,6 +308,13 @@ def csr_group_key(plan: FieldPlan) -> str:
 _CSR_SEPARATORS = {"query": b"&", "cookie": b"; "}
 
 
+def geo_group_key(plan: FieldPlan) -> str:
+    """All geo plans over the same token+steps+database share one device
+    range-join (plan.meta = (tag, column, GeoDeviceTable); the tag is the
+    pickle-stable database identity)."""
+    return f"@geo:{plan.token_index}:{plan.meta[0]}:{plan.steps!r}"
+
+
 @dataclass
 class PackedLayout:
     """Bit-slot map for the packed [K, B] int32 output (row 0 = validity).
@@ -367,6 +374,13 @@ class PackedLayout:
                         "c2": (r + 1, 0, 0),
                         "off": (r + 2, 0, 0),
                     }
+                    aux_needs.append((key, "ok", 1))
+            elif kind == "geo":
+                key = geo_group_key(plan)
+                if key not in layout.slots:
+                    r = layout.n_rows
+                    layout.n_rows += 1
+                    layout.slots[key] = {"row": (r, 0, 0)}
                     aux_needs.append((key, "ok", 1))
             elif kind == "qscsr":
                 key = csr_group_key(plan)
@@ -589,6 +603,22 @@ def compute_rows(
                 first = extract(b32, s, 1)[:, 0]
                 leading_zero = ((e - s) > 1) & (first == np.uint8(ord("0")))
                 valid = valid & ~(leading_zero & chain_ok)
+        elif plan.kind == "geo":
+            key = geo_group_key(plan)
+            if key in group_done:
+                continue
+            group_done.add(key)
+            table = plan.meta[2]
+            u32, ip_ok, has_colon = postproc.parse_ipv4_spans(
+                b32, s, e, extract=extract_fn
+            )
+            rows_idx = table.lookup_rows(u32)
+            put(key, "row", jnp.where(ip_ok & chain_ok, rows_idx, 0))
+            put(key, "ok", jnp.where(chain_ok, 1, 0))
+            # IPv6 literals: the host DOES look them up in the trie; the
+            # flattened device table is IPv4-only, so those lines take the
+            # oracle.
+            valid = valid & ~(has_colon & chain_ok)
         elif plan.kind == "qscsr":
             key = csr_group_key(plan)
             if key in group_done:

@@ -278,6 +278,65 @@ def split_uri_fast(
     }
 
 
+def parse_ipv4_spans(
+    buf: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+    extract=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Dotted-quad spans -> (u32, ok, has_colon).
+
+    Mirrors ``ipaddress.ip_address`` strictness for IPv4 (exactly four
+    octets, 0-255, no leading zeros — AbstractGeoIPDissector parses with it
+    and silently delivers nothing on failure).  ``has_colon`` flags spans
+    that look like IPv6 literals: the host DOES look those up, so the
+    caller routes them to the oracle instead of treating them as misses.
+    """
+    extract = extract or gather_span_bytes
+    B = buf.shape[0]
+    MAX_IP = 15  # 255.255.255.255
+    b = extract(buf, start, MAX_IP)
+    w = end - start
+
+    octet = jnp.zeros(B, dtype=jnp.int32)
+    ndig = jnp.zeros(B, dtype=jnp.int32)
+    lead0 = jnp.zeros(B, dtype=bool)
+    ndots = jnp.zeros(B, dtype=jnp.int32)
+    value = jnp.zeros(B, dtype=jnp.uint32)
+    good = jnp.ones(B, dtype=bool)
+    has_colon = jnp.zeros(B, dtype=bool)
+    for i in range(MAX_IP):
+        in_span = i < w
+        byte = b[:, i]
+        has_colon = has_colon | (in_span & (byte == np.uint8(ord(":"))))
+        d = (byte - np.uint8(ord("0"))).astype(jnp.int32)
+        is_digit = (d >= 0) & (d <= 9)
+        is_dot = byte == np.uint8(ord("."))
+        # Leading zero: an octet whose first digit is 0 and has more digits.
+        lead0 = lead0 | (in_span & is_digit & (ndig == 1) & (octet == 0))
+        octet = jnp.where(in_span & is_digit, octet * 10 + d, octet)
+        ndig = jnp.where(in_span & is_digit, ndig + 1, ndig)
+        good = good & (~in_span | is_digit | is_dot)
+        good = good & ~(in_span & (octet > 255))
+        close = in_span & is_dot
+        good = good & ~(close & (ndig == 0))
+        value = jnp.where(
+            close, (value << 8) | octet.astype(jnp.uint32), value
+        )
+        ndots = jnp.where(close, ndots + 1, ndots)
+        octet = jnp.where(close, 0, octet)
+        ndig = jnp.where(close, 0, ndig)
+    value = (value << 8) | octet.astype(jnp.uint32)
+    ok = (
+        good
+        & (w >= 7) & (w <= MAX_IP)
+        & (ndots == 3)
+        & (ndig > 0)           # final octet non-empty
+        & ~lead0
+    )
+    return value, ok, has_colon
+
+
 def split_csr(
     buf: jnp.ndarray,
     start: jnp.ndarray,

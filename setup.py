@@ -1,0 +1,39 @@
+"""Build hook: precompile the native host tier into the wheel.
+
+The runtime compiles ``logparser_tpu/native/logframe.cc`` on first use and
+caches the result as ``_build/logframe-<srchash>.so``; shipping that same
+hash-named artifact inside the wheel means installed environments never need
+a toolchain (and environments without one at build time still get a working
+wheel — the numpy fallback covers them)."""
+import os
+import shutil
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        super().run()
+        try:
+            import sys
+
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from logparser_tpu.native import _compile_lib
+
+            so_path = _compile_lib()
+        except Exception:
+            so_path = None  # no toolchain: ship source-only (runtime fallback)
+        dest = os.path.join(
+            self.build_lib, "logparser_tpu", "native", "_build"
+        )
+        # Stale hash-named artifacts (from earlier source revisions or a
+        # reused build tree) must not ship.
+        if os.path.isdir(dest):
+            shutil.rmtree(dest)
+        if so_path:
+            os.makedirs(dest, exist_ok=True)
+            shutil.copy2(so_path, dest)
+
+
+setup(cmdclass={"build_py": build_py_with_native})

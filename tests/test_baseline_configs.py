@@ -151,6 +151,10 @@ class TestBaselineConfigs:
                 GeoIPCityDissector(CITY_MMDB), GeoIPASNDissector(ASN_MMDB),
             ],
         )
+        # Round-2 goal: the GeoIP chain joins on DEVICE (flattened range
+        # table + searchsorted); no field forces the per-line oracle.
+        assert p._unit_oracle_fields == [[]]
+        assert {pl.kind for pl in p.plan_by_id.values()} <= {"span", "geo"}
         assert_batch_matches_oracle(p, lines, fields)
 
     def test_config5_multiformat_mixed(self):

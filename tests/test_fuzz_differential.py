@@ -33,7 +33,9 @@ TOKEN_POOL = [
      )),
     ('"%r"', ["HTTP.FIRSTLINE:request.firstline",
               "HTTP.METHOD:request.firstline.method",
-              "HTTP.URI:request.firstline.uri"],
+              "HTTP.URI:request.firstline.uri",
+              "HTTP.PATH:request.firstline.uri.path",
+              "HTTP.QUERYSTRING:request.firstline.uri.query"],
      lambda rng: '"%s %s HTTP/1.%d"' % (
          rng.choice(["GET", "POST", "HEAD", "OPTIONS"]),
          rng.choice([
@@ -68,6 +70,29 @@ TOKEN_POOL = [
      lambda rng: rng.choice(["localhost", "www.example.com", "host-1"])),
     ("%k", ["NUMBER:connection.keepalivecount"],
      lambda rng: str(rng.randint(0, 50))),
+    # strftime timestamp tokens (the device TimeLayout compiler path)
+    ("[%{%d/%b/%Y:%H:%M:%S %z}t]",
+     ["TIME.EPOCH:request.receive.time.epoch",
+      "TIME.YEAR:request.receive.time.year",
+      "TIME.MONTHNAME:request.receive.time.monthname"],
+     lambda rng: "[%02d/%s/%04d:%02d:%02d:%02d %s]" % (
+         rng.randint(1, 28),
+         rng.choice(["Jan", "Apr", "Aug", "Oct"]),
+         rng.randint(1990, 2037),
+         rng.randint(0, 23), rng.randint(0, 59), rng.randint(0, 60),
+         rng.choice(["+0000", "-0930", "+1345"]),
+     )),
+    ("%{%Y-%m-%dT%H:%M:%S}t",
+     ["TIME.EPOCH:request.receive.time.epoch",
+      "TIME.DATE:request.receive.time.date"],
+     lambda rng: "%04d-%02d-%02dT%02d:%02d:%02d" % (
+         rng.randint(1971, 2036), rng.randint(1, 12), rng.randint(1, 28),
+         rng.randint(0, 23), rng.randint(0, 59), rng.randint(0, 59),
+     )),
+    ("%m", ["HTTP.METHOD:request.method"],
+     lambda rng: rng.choice(["GET", "POST", "DELETE", "PATCH"])),
+    ('"%q"', ["HTTP.QUERYSTRING:request.querystring"],
+     lambda rng: rng.choice(['""', '"?a=1"', '"?x=%20y&b"', '"?broken=%zz"'])),
 ]
 
 N_FORMATS = 10
@@ -194,6 +219,25 @@ NGINX_POOL = [
      lambda rng: rng.choice([".", "p"])),
     ("$msec", ["TIME.EPOCH:request.receive.time.epoch"],
      lambda rng: f"{rng.randint(10**8, 2 * 10**9)}.{rng.randint(0, 999):03d}"),
+    ("[$time_iso8601]", ["TIME.EPOCH:request.receive.time.epoch",
+                         "TIME.YEAR:request.receive.time.year"],
+     lambda rng: "[%04d-%02d-%02dT%02d:%02d:%02d%s]" % (
+         rng.randint(1975, 2036), rng.randint(1, 12), rng.randint(1, 28),
+         rng.randint(0, 23), rng.randint(0, 59), rng.randint(0, 59),
+         rng.choice(["+00:00", "-08:00", "+05:30"]),
+     )),
+    ("$request_time", ["SECOND_MILLIS:response.server.processing.time"],
+     lambda rng: f"{rng.randint(0, 300)}.{rng.randint(0, 999):03d}"),
+    ('"$request_uri"', ["HTTP.URI:request.firstline.uri",
+                        "HTTP.PATH:request.firstline.uri.path",
+                        "HTTP.QUERYSTRING:request.firstline.uri.query"],
+     lambda rng: rng.choice([
+         '"/"', '"/a/b?c=1&d=2"', '"/p%20q"', '"/x?u=%C3%A9"', '"/multi?a=1?b"',
+     ])),
+    ("$request_method", ["HTTP.METHOD:request.firstline.method"],
+     lambda rng: rng.choice(["GET", "HEAD", "PUT"])),
+    ("$host", ["STRING:connection.server.name"],
+     lambda rng: rng.choice(["example.com", "a.b.c", "localhost"])),
 ]
 
 

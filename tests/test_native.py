@@ -63,3 +63,43 @@ def test_encode_batch_native_path_equivalent():
     buf2, lengths2, _ = encode_batch(fast_lines)
     for i, ln in enumerate(fast_lines):
         assert bytes(buf2[i][: lengths2[i]]) == ln
+
+
+class TestGatherSpans:
+    def test_native_matches_numpy(self):
+        import numpy as np
+
+        from logparser_tpu import native
+
+        rng = np.random.default_rng(9)
+        B, L = 257, 96
+        buf = rng.integers(32, 127, size=(B, L), dtype=np.uint8)
+        starts = rng.integers(0, L // 2, size=B).astype(np.int32)
+        lens = rng.integers(0, L // 2, size=B).astype(np.int64)
+        lens[::7] = 0  # null/empty rows copy nothing
+        data, offsets = native.gather_spans(buf, starts, lens)
+        assert offsets[-1] == lens.sum()
+        for r in range(B):
+            got = bytes(data[offsets[r]:offsets[r + 1]])
+            want = bytes(buf[r, starts[r]:starts[r] + lens[r]])
+            assert got == want, r
+
+    def test_batchresult_span_bytes(self):
+        from logparser_tpu.tpu.batch import TpuBatchParser
+
+        fid = "HTTP.USERAGENT:request.user-agent"
+        p = TpuBatchParser("combined", [fid])
+        lines = [
+            '1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET /x HTTP/1.1" '
+            f'200 5 "-" "agent/{i}"'
+            for i in range(17)
+        ] + ['1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET /y HTTP/1.1" '
+             '200 5 "-" "-"']
+        result = p.parse_batch(lines)
+        data, offsets, valid = result.span_bytes(fid)
+        expected = result.to_pylist(fid)
+        for r, want in enumerate(expected):
+            if want is None:
+                assert not valid[r]
+            else:
+                assert bytes(data[offsets[r]:offsets[r + 1]]).decode() == want
