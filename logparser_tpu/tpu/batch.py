@@ -508,8 +508,15 @@ class TpuBatchParser:
             if part is not None:
                 return ("span", vctx, steps + (("fl", part),), device_ok)
         if isinstance(d, HttpUriDissector) and parse == "":
+            if oname == "port":
+                # Port is numeric on the host (uri.port int, STRING_OR_LONG
+                # casts): terminal long parse over the device port span.
+                return (
+                    "value", ("long", null_mode, scale),
+                    steps + (("uri", oname),), device_ok,
+                )
             if oname in (
-                "protocol", "userinfo", "host", "port", "path", "query", "ref"
+                "protocol", "userinfo", "host", "path", "query", "ref"
             ):
                 return ("span", vctx, steps + (("uri", oname),), device_ok)
         from ..geoip.dissectors import AbstractGeoIPDissector

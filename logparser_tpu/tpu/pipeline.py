@@ -499,6 +499,13 @@ def compute_rows(
     line_constraints: List[jnp.ndarray] = []
     false_b = jnp.zeros(B, dtype=bool)
 
+    def clf_dash(s, e):
+        """Token-level CLF null: the span is a lone '-'
+        (decode_extracted_value, ApacheHttpdLogFormatDissector:176-178 /
+        NginxHttpdLogFormatDissector:107-119)."""
+        first = extract(b32, s, 1)[:, 0]
+        return ((e - s) == 1) & (first == np.uint8(ord("-")))
+
     def run_step(step: Tuple[str, str], s, e, ok, cache_key):
         name, part = step
         if name == "fl":
@@ -519,8 +526,12 @@ def compute_rows(
         if name == "uri":
             uri = uri_cache.get(cache_key)
             if uri is None:
+                # Direct token input: CLF null — the dissector receives
+                # None and delivers nothing.  Sub-spans (firstline uri)
+                # take '-' literally, like the host.
+                dash = clf_dash(s, e) if len(cache_key) == 1 else None
                 uri = postproc.split_uri_fast(
-                    b32, s, e, extract=extract, shift_fn=shift_fn
+                    b32, s, e, extract=extract, shift_fn=shift_fn, dash=dash
                 )
                 uri_cache[cache_key] = uri
                 # Repair-needing URIs fail the line (unless the chain
@@ -530,15 +541,38 @@ def compute_rows(
             if part == "path":
                 return (
                     uri["path_start"], uri["path_end"], step_ok,
-                    uri["empty"], false_b, uri["path_fix"],
+                    uri["path_null"], false_b, uri["path_fix"],
                 )
             if part == "query":
                 return (
                     uri["query_start"], uri["query_end"], step_ok,
-                    uri["empty"], uri["query_amp"], uri["query_fix"],
+                    uri["query_null"], uri["query_amp"], uri["query_fix"],
                 )
-            # protocol/userinfo/host/port/ref: never delivered on the
-            # relative fast path -> null span.
+            if part == "protocol":
+                return (
+                    uri["proto_start"], uri["proto_end"], step_ok,
+                    uri["proto_null"], false_b, false_b,
+                )
+            if part == "userinfo":
+                return (
+                    uri["userinfo_start"], uri["userinfo_end"], step_ok,
+                    uri["userinfo_null"], false_b, false_b,
+                )
+            if part == "host":
+                return (
+                    uri["host_start"], uri["host_end"], step_ok,
+                    uri["host_null"], false_b, false_b,
+                )
+            if part == "port":
+                # Null port == empty span: the downstream long parse fails
+                # on it and the column reads None (the host only delivers
+                # port when the authority parse produced one).
+                return (
+                    uri["port_start"], uri["port_end"], step_ok,
+                    false_b, false_b, false_b,
+                )
+            # ref: clean rows cannot contain '#', so the fragment is
+            # always absent -> null span.
             return s, s, step_ok, jnp.ones(B, dtype=bool), false_b, false_b
         raise AssertionError(step)  # pragma: no cover
 
@@ -566,11 +600,7 @@ def compute_rows(
         s, e, chain_ok, null, amp, fix = chain_spans(plan.token_index, plan.steps)
         if plan.kind == "span":
             if not plan.steps:
-                # Direct token capture: CLF '-' means null
-                # (decode_extracted_value, ApacheHttpdLogFormatDissector
-                # :176-178 / NginxHttpdLogFormatDissector :107-119).
-                first = extract(b32, s, 1)[:, 0]
-                null = ((e - s) == 1) & (first == np.uint8(ord("-")))
+                null = clf_dash(s, e)  # direct token capture: CLF null
             put_span(plan.field_id, s, e, chain_ok, null, amp, fix)
         elif plan.kind in ("long", "secmillis"):
             if plan.kind == "secmillis":
@@ -639,12 +669,9 @@ def compute_rows(
                 shift_fn=None if shift_fn is shift_zero else shift_fn,
             )
             if not plan.steps:
-                # Direct token capture of the query string: a lone '-' is
-                # null (decode_extracted_value) -> no params delivered.
-                first = extract(b32, s, 1)[:, 0]
-                chain_ok = chain_ok & ~(
-                    ((e - s) == 1) & (first == np.uint8(ord("-")))
-                )
+                # Direct token capture of the query string: CLF null ->
+                # no params delivered.
+                chain_ok = chain_ok & ~clf_dash(s, e)
             for k in range(CSR_SLOTS):
                 seg_s = csr["seg_start"][k]
                 seg_e = csr["seg_end"][k]

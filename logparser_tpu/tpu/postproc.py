@@ -179,14 +179,16 @@ def split_uri_fast(
     end: jnp.ndarray,
     extract=None,
     shift_fn=None,
+    dash=None,
 ) -> Dict[str, jnp.ndarray]:
-    """Fast-path URI split: relative, repair-free URIs -> sub-spans.
+    """Fast-path URI split: repair-free URIs -> sub-spans on device.
 
     Mirrors HttpUriDissector (dissectors/uri.py; HttpUriDissector.java:52-63)
-    for the common case — a path-relative URI that the repair chain would
-    pass through unchanged.  ``clean`` is False whenever ANY repair stage
+    for spans the repair chain would pass through unchanged: relative URIs,
+    scheme-less paths, and absolute URLs with a server-based (or cleanly
+    registry-based) authority.  ``clean`` is False whenever ANY repair stage
     could fire; such lines must be re-parsed by the host oracle (the caller
-    folds ``clean`` into line validity).  Conditions checked:
+    folds ``ok`` into line validity).  Conditions checked:
 
     - no byte the URIUtil encode step would %-escape (control, space, DEL,
       0xFF, ``{}|\\^[]`<>"``),
@@ -194,19 +196,36 @@ def split_uri_fast(
     - no ``;`` (sound over-approximation of the HTML-entity unescape:
       every entity needs a ``;``),
     - at most one ``?``, and only as the first query-separator occurrence
-      (otherwise the ?->& normalization rewrites bytes inside the span),
-    - leading ``/`` (absolute URLs take the authority-parsing host path).
+      (otherwise the ?->& normalization rewrites bytes inside the span).
 
-    Percent signs do NOT force the oracle: they only flag per-row host
-    micro-materialization (orders of magnitude cheaper than a full oracle
-    re-parse).  ``path_fix`` marks rows whose path contains ``%`` (the host
-    delivers the path percent-DECODED, and bad escapes are first repaired
-    to ``%25``); ``query_fix`` marks rows whose query contains a bad escape
-    (repaired to ``%25``; well-formed query escapes are delivered raw).
-    The ``%``-repair inserts only the digits ``25``, so it cannot create or
-    destroy separators — span boundaries are unaffected.
+    Absolute URLs (JavaUri semantics, dissectors/uri.py:105-168): scheme =
+    up to the first ``:`` when it precedes any ``/``/separator and matches
+    ``[A-Za-z][A-Za-z0-9+.-]*`` (an invalid scheme raises on the host, so
+    those rows go to the oracle, which rejects them identically); a
+    ``://`` introduces an authority ending at the next ``/`` or query
+    separator; the LAST ``@`` splits userinfo; the last ``:`` in the
+    remainder splits a digits-only port.  A non-server authority (host
+    charset outside ``[A-Za-z0-9.-]``, or a non-numeric port) is
+    registry-based: userinfo/host/port are all null, path/query still
+    deliver.  Rows the device cannot model exactly take the oracle:
+    IPv6 ``[...]`` literals, ``%`` anywhere before the path (userinfo is
+    percent-decoded on the host), opaque URIs (scheme without ``//``),
+    and ports longer than 18 digits.  Scheme-less spans not starting with
+    ``/`` ("example.com/x") have no authority: the whole head is path,
+    protocol/userinfo/host/port null (exactly _URI_SPLIT's behavior).
 
-    An empty span is clean: every output is null (the host dissector
+    Percent signs in path/query do NOT force the oracle: they only flag
+    per-row host micro-materialization (orders of magnitude cheaper than a
+    full oracle re-parse).  ``path_fix`` marks rows whose path contains
+    ``%`` (the host delivers the path percent-DECODED, and bad escapes are
+    first repaired to ``%25``); ``query_fix`` marks rows whose query
+    contains a bad escape (repaired to ``%25``; well-formed query escapes
+    are delivered raw).  The ``%``-repair inserts only the digits ``25``,
+    so it cannot create or destroy separators — span boundaries are
+    unaffected.
+
+    An empty span — or a lone ``-`` when the caller passes the token-level
+    CLF ``dash`` mask — is clean: every output is null (the host dissector
     delivers nothing).  The query span keeps its leading separator byte;
     when that byte is ``?`` the host delivers it as ``&`` (the ?&
     normalization) — the ``amp`` flag tells the materializer to swap it.
@@ -217,6 +236,9 @@ def split_uri_fast(
     in_span = (pos >= start[:, None]) & (pos < end[:, None])
     width = end - start
     empty = width == 0
+    if dash is None:
+        dash = jnp.zeros(B, dtype=bool)
+    all_null = empty | dash
 
     is_q = (buf == np.uint8(ord("?"))) & in_span
     is_amp = (buf == np.uint8(ord("&"))) & in_span
@@ -242,7 +264,6 @@ def split_uri_fast(
         (q_count == 0) | ((q_count == 1) & (first_q == first_sep))
     )
 
-    # '%' handling: flags per-row host micro-materialization, not oracle.
     is_pct = (buf == np.uint8(ord("%"))) & in_span
     shift = shift_fn or shift_zero
     nxt1 = shift(buf, 1)
@@ -256,23 +277,134 @@ def split_uri_fast(
         )
 
     pct_bad = is_pct & ~(_is_hex(nxt1) & _is_hex(nxt2) & (pos + 2 < end[:, None]))
-    path_fix = jnp.any(is_pct & (pos < first_sep[:, None]), axis=1)
-    query_fix = jnp.any(pct_bad & (pos >= first_sep[:, None]), axis=1)
 
     lead = extract(buf, start, 1)[:, 0]
-    relative = (~empty) & (lead == np.uint8(ord("/")))
-    ok = clean & (relative | empty)
+    relative = (~all_null) & (lead == np.uint8(ord("/")))
+
+    # ---- absolute/scheme-less analysis (JavaUri semantics) -----------
+    is_digit = (buf >= np.uint8(ord("0"))) & (buf <= np.uint8(ord("9")))
+    is_alpha = (
+        ((buf >= np.uint8(ord("A"))) & (buf <= np.uint8(ord("Z"))))
+        | ((buf >= np.uint8(ord("a"))) & (buf <= np.uint8(ord("z"))))
+    )
+    is_colon = (buf == np.uint8(ord(":"))) & in_span
+    is_slash = (buf == np.uint8(ord("/"))) & in_span
+
+    first_colon = jnp.min(jnp.where(is_colon, pos, L), axis=1).astype(jnp.int32)
+    first_slash = jnp.min(jnp.where(is_slash, pos, L), axis=1).astype(jnp.int32)
+    limit = jnp.minimum(jnp.minimum(first_slash, first_sep), end)
+    has_scheme = (first_colon < limit) & (first_colon > start)
+
+    scheme_cs = (
+        is_alpha | is_digit
+        | (buf == np.uint8(ord("+")))
+        | (buf == np.uint8(ord(".")))
+        | (buf == np.uint8(ord("-")))
+    )
+    in_scheme = (pos > start[:, None]) & (pos < first_colon[:, None])
+    lead_alpha = (
+        ((lead >= np.uint8(ord("A"))) & (lead <= np.uint8(ord("Z"))))
+        | ((lead >= np.uint8(ord("a"))) & (lead <= np.uint8(ord("z"))))
+    )
+    scheme_ok = lead_alpha & jnp.all(scheme_cs | ~in_scheme, axis=1)
+
+    d2 = extract(buf, first_colon + 1, 2)
+    dslash = (
+        (d2[:, 0] == np.uint8(ord("/")))
+        & (d2[:, 1] == np.uint8(ord("/")))
+        & (first_colon + 3 <= end)
+    )
+    auth_start = first_colon + 3
+    slash_a = jnp.min(
+        jnp.where(is_slash & (pos >= auth_start[:, None]), pos, L), axis=1
+    ).astype(jnp.int32)
+    auth_end = jnp.minimum(jnp.minimum(slash_a, first_sep), end)
+    in_auth = (pos >= auth_start[:, None]) & (pos < auth_end[:, None])
+    at = jnp.max(
+        jnp.where((buf == np.uint8(ord("@"))) & in_auth, pos, -1), axis=1
+    ).astype(jnp.int32)
+    has_at = at >= 0
+    rest_start = jnp.where(has_at, at + 1, auth_start)
+    colon2 = jnp.max(
+        jnp.where(is_colon & (pos >= rest_start[:, None]) & (pos < auth_end[:, None]),
+                  pos, -1),
+        axis=1,
+    ).astype(jnp.int32)
+    has_pcolon = colon2 >= 0
+    port_start = colon2 + 1
+    port_len = auth_end - port_start
+    port_empty = port_len <= 0
+    in_port = has_pcolon[:, None] & (pos >= port_start[:, None]) & (
+        pos < auth_end[:, None]
+    )
+    port_digits = jnp.all(is_digit | ~in_port, axis=1)
+    host_end = jnp.where(
+        has_pcolon & (port_empty | port_digits), colon2, auth_end
+    )
+    in_host = (pos >= rest_start[:, None]) & (pos < host_end[:, None])
+    host_cs = (
+        is_alpha | is_digit
+        | (buf == np.uint8(ord(".")))
+        | (buf == np.uint8(ord("-")))
+    )
+    host_ok_cs = jnp.all(host_cs | ~in_host, axis=1)
+    registry = (~host_ok_cs) | (has_pcolon & ~port_empty & ~port_digits)
+
+    # IPv6 '[...]' literals need no dedicated guard: '[' is in the encode
+    # bad-set, so such spans already fail `clean` and take the oracle.
+    pct_pre = jnp.any(is_pct & (pos < auth_end[:, None]), axis=1)
+    abs_ok = (
+        has_scheme & scheme_ok & dslash
+        & ~pct_pre
+        & ~(has_pcolon & (port_len > MAX_LONG_DIGITS))
+    )
+    is_abs = has_scheme & abs_ok & ~all_null
+    # Scheme-less, not starting with '/': no authority possible — the whole
+    # head is path (protocol/userinfo/host/port null).
+    case3 = (~has_scheme) & (~relative) & (~all_null)
+    handled = all_null | relative | case3 | is_abs
+    ok = clean & handled
 
     zero_span = start
-    has_query = (~empty) & (first_sep < end)
+    show_auth = is_abs & ~registry
+    path_begin = jnp.where(is_abs, auth_end, start)
+    path_fix = jnp.any(
+        is_pct & (pos >= path_begin[:, None]) & (pos < first_sep[:, None]),
+        axis=1,
+    )
+    query_fix = jnp.any(pct_bad & (pos >= first_sep[:, None]), axis=1)
+    has_query = (~all_null) & (first_sep < end)
+
+    def span(show, s, e):
+        return jnp.where(show, s, zero_span), jnp.where(show, e, zero_span)
+
+    proto_s, proto_e = span(is_abs, start, first_colon)
+    ui_show = show_auth & has_at
+    ui_s, ui_e = span(ui_show, auth_start, at)
+    host_s, host_e = span(show_auth, rest_start, host_end)
+    port_show = show_auth & has_pcolon & ~port_empty
+    port_s, port_e = span(port_show, port_start, auth_end)
     return {
         "ok": ok,
-        "empty": empty,
-        "path_start": jnp.where(empty, zero_span, start),
-        "path_end": jnp.where(empty, zero_span, first_sep),
-        "query_start": jnp.where(empty, zero_span, first_sep),
-        "query_end": jnp.where(empty, zero_span, end),
+        "all_null": all_null,
+        "path_start": jnp.where(all_null, zero_span, path_begin),
+        "path_end": jnp.where(all_null, zero_span, jnp.maximum(first_sep, path_begin)),
+        "path_null": all_null,
+        "query_start": jnp.where(all_null, zero_span, first_sep),
+        "query_end": jnp.where(all_null, zero_span, end),
+        "query_null": all_null,
         "query_amp": has_query,
+        "proto_start": proto_s,
+        "proto_end": proto_e,
+        "proto_null": all_null | ~is_abs,
+        "userinfo_start": ui_s,
+        "userinfo_end": ui_e,
+        "userinfo_null": all_null | ~ui_show,
+        "host_start": host_s,
+        "host_end": host_e,
+        "host_null": all_null | ~show_auth,
+        "port_start": port_s,
+        "port_end": port_e,
         "path_fix": path_fix,
         "query_fix": query_fix,
     }

@@ -87,6 +87,61 @@ class TestDeviceUriSplit:
         parser = TpuBatchParser("common", FIELDS)
         assert_matches(parser, make_lines(uris))
 
+    # Absolute-URL coverage (JavaUri authority semantics on device).
+    ABSOLUTE = [
+        "http://example.com/x?q=1",
+        "https://example.com",
+        "https://example.com/",
+        "http://example.com:8080/a/b?c=d&e=f",
+        "http://example.com:/empty-port",
+        "http://example.com:0/zero",
+        "http://user@example.com/u",
+        "http://user:pw@example.com:81/up",
+        "http://a@b@c.com/double-at",
+        "http://my_host/underscore",          # registry-based: null host
+        "http://host:8x8/bad-port",           # registry-based: null all
+        "HTTPS://UPPER.CASE/keep",
+        "ftp://files.example.org:2121/f.iso",
+        "http:///empty-authority",
+        "http://:8080/empty-host",
+        "http://host?q=no-path",
+        "http://host&amp-in-authority/x",
+        "http://[::1]:80/ipv6",               # oracle: IPv6 literal
+        "mailto:someone@example.com",         # oracle: opaque (no //)
+        "1http://bad.scheme/x",               # oracle: invalid scheme -> bad line
+        "http//missing.colon/x",
+        "example.com/no/scheme?y=2",
+        "a:b",                                # opaque -> oracle
+        ":leading-colon",
+        "http://enc%41oded.host/x",           # oracle: % before path
+        "http://user%40x@host/x",             # oracle: % in userinfo
+        "http://host:123456789012345678901/x",  # >18-digit port -> oracle
+        "http://host/%41path?with=%2Fenc",
+        "scheme+ext.1://host.name/x",
+    ]
+
+    def test_absolute_urls(self):
+        parser = TpuBatchParser("common", FIELDS)
+        assert_matches(parser, make_lines(self.ABSOLUTE))
+
+    def test_fuzzed_absolute_urls(self):
+        rng = random.Random(178)
+        heads = ["http", "https", "ftp", "h2-x", "1bad", "no colon", ""]
+        hosts = ["example.com", "a.b.c", "my_host", "h-1.io", "[::1]", "",
+                 "x%41y", "a@b"]
+        tails = ["", ":80", ":", ":8x", ":012345678901234567890"]
+        paths = ["", "/", "/x/y", "/p%20q", "/a?b=c&d=e", "?bare=q", "/u@p",
+                 "/a:b", "//double"]
+        uris = []
+        for _ in range(250):
+            s = rng.choice(heads) + "://" + rng.choice(hosts)
+            if rng.random() < 0.3:
+                s = rng.choice(["u", "u:p", "a@b", ""]) + "@" + s[len("x://"):]
+                s = rng.choice(heads) + "://" + s
+            uris.append(s + rng.choice(tails) + rng.choice(paths))
+        parser = TpuBatchParser("common", FIELDS)
+        assert_matches(parser, make_lines(uris))
+
     def test_fix_rows_stay_on_device(self):
         # %-escapes must not cost a full oracle re-parse.
         uris = ["/logo%20big.png?q=%C3%A9", "/x?broken=50%-off", "/plain"]
@@ -94,3 +149,22 @@ class TestDeviceUriSplit:
         result = parser.parse_batch(make_lines(uris))
         assert result.oracle_rows == 0
         assert list(result.valid) == [True, True, True]
+
+    def test_absolute_urls_stay_on_device(self):
+        uris = [
+            "http://example.com/x?q=1",
+            "https://user:pw@shop.example.org:8443/cart?item=3&ref=a",
+            "http://my_host/registry-based",
+            "example.com/no/scheme",
+            "/relative/still?fine=1",
+        ]
+        parser = TpuBatchParser("common", FIELDS)
+        result = parser.parse_batch(make_lines(uris))
+        assert result.oracle_rows == 0
+        assert list(result.valid) == [True] * len(uris)
+        assert result.to_pylist("HTTP.HOST:request.firstline.uri.host") == [
+            "example.com", "shop.example.org", None, None, None,
+        ]
+        assert result.to_pylist("HTTP.PORT:request.firstline.uri.port") == [
+            None, 8443, None, None, None,
+        ]
