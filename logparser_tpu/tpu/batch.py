@@ -325,6 +325,9 @@ class TpuBatchParser:
         # registration-priority semantics; the rest is oracle territory.
         fmt = self.oracle.all_dissectors[0]
         dissectors = getattr(fmt, "dissectors", [fmt])
+        from .pipeline import CSR_SLOTS
+
+        self.csr_slots = CSR_SLOTS
         self.units: List[FormatUnit] = []
         for d in dissectors:
             try:
@@ -332,7 +335,9 @@ class TpuBatchParser:
             except UnsupportedFormatError:
                 break
             plans = [self._resolve(prog, fid) for fid in self.requested]
-            self.units.append(FormatUnit(prog, plans, PackedLayout.for_plans(plans)))
+            self.units.append(FormatUnit(
+                prog, plans, PackedLayout.for_plans(plans, self.csr_slots)
+            ))
         assign_row_offsets(self.units)
 
         # Merged per-field plan: the first non-host kind across formats (used
@@ -386,6 +391,24 @@ class TpuBatchParser:
             fn = build_units_pallas_fn(self.units, B, L)
             self._pallas_fns[key] = fn
         return fn
+
+    def _grow_csr_slots(self) -> bool:
+        """Adaptive CSR: double the wildcard segment-slot count (bounded by
+        CSR_SLOTS_MAX) and rebuild the packed layouts + executor.  Called
+        when a batch flags CSR overflow, so query-heavy corpora cost a few
+        recompiles instead of routing every long line to the per-line
+        oracle.  Returns False at the cap (those lines stay oracle-bound)."""
+        from .pipeline import CSR_SLOTS_MAX
+
+        if self.csr_slots >= CSR_SLOTS_MAX:
+            return False
+        self.csr_slots *= 2
+        for u in self.units:
+            u.layout = PackedLayout.for_plans(u.plans, self.csr_slots)
+        assign_row_offsets(self.units)
+        self._jitted = self._build_jitted()
+        self._pallas_fns = {}
+        return True
 
     # ------------------------------------------------------------------
 
@@ -600,6 +623,7 @@ class TpuBatchParser:
                     if ot == ftype and path.startswith(name + "."):
                         from ..dissectors.cookies import (
                             RequestCookieListDissector,
+                            ResponseSetCookieListDissector,
                         )
                         from ..dissectors.query import QueryStringFieldDissector
 
@@ -608,6 +632,8 @@ class TpuBatchParser:
                             mode = "query"
                         elif isinstance(d, RequestCookieListDissector):
                             mode = "cookie"
+                        elif isinstance(d, ResponseSetCookieListDissector):
+                            mode = "setcookie"
                         if mode is not None and vctx[0] == "" and device_ok:
                             plans.append(_FieldPlan(
                                 field_id, "qscsr", tok.index, steps,
@@ -666,8 +692,15 @@ class TpuBatchParser:
         columns: Dict[str, Dict[str, np.ndarray]] = {}
         zeros_null = np.zeros(B, dtype=bool)
 
-        fn = self.device_fn(padded_b, buf.shape[1])
-        if fn is not None:
+        from .pipeline import CSR_OVERFLOW_BIT
+
+        while True:
+            fn = self.device_fn(padded_b, buf.shape[1])
+            if fn is None:
+                packed = None
+                valid = np.zeros(B, dtype=bool)
+                winner = np.full(B, -1, dtype=np.int64)
+                break
             # ONE packed [sum K_i, B] int32 output -> ONE device->host fetch
             # (transfer round-trips dominate on tunneled TPU attachments).
             with trace.stage("device", items=B):
@@ -687,6 +720,11 @@ class TpuBatchParser:
             # reference's registration-priority semantics with the real
             # backtracking regexes (HttpdLogFormatDissector.java:174-204).
             row0 = np.stack([packed[u.row_offset, :B] for u in self.units])
+            # Adaptive CSR: any line with more wildcard segments than the
+            # current layout's slots -> double the slots and re-run (a few
+            # bounded recompiles replace a per-line oracle cliff).
+            if ((row0 & CSR_OVERFLOW_BIT) != 0).any() and self._grow_csr_slots():
+                continue
             validity = (row0 & 1) != 0
             plausible = (row0 & 2) != 0
             valid = validity.any(axis=0)
@@ -700,10 +738,7 @@ class TpuBatchParser:
                 )[0] > 0
                 winner = np.where(contested, -1, winner)
                 valid = valid & ~contested
-        else:
-            packed = None
-            valid = np.zeros(B, dtype=bool)
-            winner = np.full(B, -1, dtype=np.int64)
+            break
         for i in overflow:
             valid[i] = False
             winner[i] = -1
@@ -965,7 +1000,7 @@ class TpuBatchParser:
         failed (the host engine fails those lines; caller invalidates
         them so the oracle re-rejects identically)."""
         from ..dissectors.utils import resilient_url_decode
-        from .pipeline import CSR_SLOTS, csr_group_key
+        from .pipeline import csr_group_key
 
         failed: set = set()
         if packed is None:
@@ -997,13 +1032,14 @@ class TpuBatchParser:
                 # around names and values (RequestCookieListDissector).
                 uri_chain = bool(flist[0][1].steps)
                 cookie = flist[0][1].meta == "cookie"
+                setcookie = flist[0][1].meta == "setcookie"
                 segs = [
                     tuple(
                         u.layout.get(block, key, f"s{k}_{c}")
                         for c in ("start", "nlen", "eq", "dec", "ndec",
                                   "vstart", "vlen")
                     )
-                    for k in range(CSR_SLOTS)
+                    for k in range(u.layout.csr_slots)
                 ]
                 dicts: Dict[int, Optional[Dict[str, str]]] = {}
                 for i_ in rows:
@@ -1015,6 +1051,27 @@ class TpuBatchParser:
                     for ss, nl, he, dc, nd, vs, vl in segs:
                         nlen = int(nl[i])
                         has_eq = bool(he[i])
+                        if setcookie:
+                            # Set-Cookie segments: eq bit = emit; name is
+                            # stripped + lowercased (empty -> skipped, the
+                            # HttpCookie-parse ValueError path); the value
+                            # is the RAW whole cookie text.
+                            if not has_eq:
+                                continue
+                            s0 = int(ss[i])
+                            name = (
+                                bytes(buf[i, s0 : s0 + nlen])
+                                .decode("utf-8", "replace")
+                                .strip()
+                                .lower()
+                            )
+                            if name == "":
+                                continue
+                            v0 = int(vs[i])
+                            d[name] = bytes(
+                                buf[i, v0 : v0 + int(vl[i])]
+                            ).decode("utf-8", "replace")
+                            continue
                         if nlen == 0 and not has_eq:
                             continue  # empty slot / skipped empty segment
                         s0 = int(ss[i])
@@ -1182,6 +1239,10 @@ class TpuBatchParser:
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
+        if "csr_slots" not in state:  # pre-adaptive-CSR artifacts
+            from .pipeline import CSR_SLOTS
+
+            self.csr_slots = CSR_SLOTS
         if not getattr(self, "_use_pallas_explicit", False):
             # The defaulted flag described the BUILDER's backend; this
             # process may be a different machine — re-derive locally.

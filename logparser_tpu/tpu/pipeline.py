@@ -294,9 +294,18 @@ def ts_group_key(plan: FieldPlan) -> str:
     return f"@ts:{plan.token_index}:{plan.steps!r}"
 
 
-# Segment slots per CSR wildcard split (query params / cookies).  Lines with
-# more segments than slots are routed to the oracle (overflow bit).
+# Default segment slots per CSR wildcard split (query params / cookies).
+# Lines with more segments than slots are routed to the oracle AND flagged
+# in the validity row (CSR_OVERFLOW_BIT); TpuBatchParser reacts by doubling
+# the layout's slot count (up to CSR_SLOTS_MAX) and re-running the batch, so
+# query-heavy corpora pay a bounded number of recompiles instead of a
+# per-line oracle cliff.
 CSR_SLOTS = 16
+CSR_SLOTS_MAX = 128
+
+# row 0 bit assignments (see compute_rows): bit 0 = line validity, bit 1 =
+# plausibility (multi-format winner protocol), bit 2 = CSR slot overflow.
+CSR_OVERFLOW_BIT = 4
 
 
 def csr_group_key(plan: FieldPlan) -> str:
@@ -327,10 +336,13 @@ class PackedLayout:
 
     slots: Dict[str, Dict[str, Slot]] = dataclass_field(default_factory=dict)
     n_rows: int = 1
+    csr_slots: int = CSR_SLOTS
 
     @classmethod
-    def for_plans(cls, plans: Sequence[FieldPlan]) -> "PackedLayout":
-        layout = cls()
+    def for_plans(
+        cls, plans: Sequence[FieldPlan], csr_slots: int = CSR_SLOTS
+    ) -> "PackedLayout":
+        layout = cls(csr_slots=csr_slots)
         aux_needs: List[Tuple[str, str, int]] = []  # (slot_key, comp, bits)
         for plan in plans:
             kind = plan.kind
@@ -386,7 +398,7 @@ class PackedLayout:
                 key = csr_group_key(plan)
                 if key not in layout.slots:
                     slots: Dict[str, Slot] = {}
-                    for k in range(CSR_SLOTS):
+                    for k in range(csr_slots):
                         rn = layout.n_rows
                         rv = layout.n_rows + 1
                         layout.n_rows += 2
@@ -497,6 +509,7 @@ def compute_rows(
     uri_cache: Dict[tuple, Dict[str, jnp.ndarray]] = {}
     chain_cache: Dict[tuple, tuple] = {}
     line_constraints: List[jnp.ndarray] = []
+    csr_overflow_rows: List[jnp.ndarray] = []
     false_b = jnp.zeros(B, dtype=bool)
 
     def clf_dash(s, e):
@@ -654,6 +667,33 @@ def compute_rows(
             if key in group_done:
                 continue
             group_done.add(key)
+            if plan.meta == "setcookie":
+                if not plan.steps:
+                    chain_ok = chain_ok & ~clf_dash(s, e)
+                sc = postproc.split_setcookie_csr(
+                    b32, s, e, layout.csr_slots,
+                    shift_fn=None if shift_fn is shift_zero else shift_fn,
+                )
+                for k in range(layout.csr_slots):
+                    seg_s = sc["seg_start"][k]
+                    seg_e = sc["seg_end"][k]
+                    emit = sc["emit"][k]
+                    put(key, f"s{k}_start", jnp.where(emit, seg_s, 0))
+                    put(key, f"s{k}_nlen",
+                        jnp.where(emit, sc["name_end"][k] - seg_s, 0))
+                    put(key, f"s{k}_eq", jnp.where(emit, 1, 0))
+                    put(key, f"s{k}_vstart", jnp.where(emit, seg_s, 0))
+                    put(key, f"s{k}_vlen", jnp.where(emit, seg_e - seg_s, 0))
+                put(key, "ok", jnp.where(chain_ok, 1, 0))
+                # Host-quirk rows (overwritten held part, set-cookie:
+                # prefix) and slot overflow take the oracle.  The overflow
+                # bit is masked by the running line validity: overflow on
+                # an already-rejected line must not trigger slot growth.
+                valid = valid & ~(sc["bad"] & chain_ok)
+                overflowed = sc["overflow"] & chain_ok & valid
+                valid = valid & ~overflowed
+                csr_overflow_rows.append(overflowed)
+                continue
             if plan.steps and plan.steps[-1] == ("uri", "query"):
                 # The uri query span keeps its leading '?' (rendered '&'
                 # by the normalization); as QueryStringFieldDissector
@@ -664,7 +704,7 @@ def compute_rows(
                     (s < e) & (first == np.uint8(ord("?"))), s + 1, s
                 )
             csr = postproc.split_csr(
-                b32, s, e, CSR_SLOTS,
+                b32, s, e, layout.csr_slots,
                 sep=_CSR_SEPARATORS[plan.meta or "query"],
                 shift_fn=None if shift_fn is shift_zero else shift_fn,
             )
@@ -672,7 +712,7 @@ def compute_rows(
                 # Direct token capture of the query string: CLF null ->
                 # no params delivered.
                 chain_ok = chain_ok & ~clf_dash(s, e)
-            for k in range(CSR_SLOTS):
+            for k in range(layout.csr_slots):
                 seg_s = csr["seg_start"][k]
                 seg_e = csr["seg_end"][k]
                 eq = csr["eq_pos"][k]
@@ -689,8 +729,14 @@ def compute_rows(
                 put(key, f"s{k}_vstart", jnp.where(has_eq, vstart, 0))
                 put(key, f"s{k}_vlen", vlen)
             put(key, "ok", jnp.where(chain_ok, 1, 0))
-            # More segments than slots: the oracle takes the whole line.
-            valid = valid & ~(csr["overflow"] & chain_ok)
+            # More segments than slots: the oracle takes the whole line,
+            # and the overflow is surfaced in row 0 so the host can react
+            # by growing the slot count (adaptive CSR).  Masked by the
+            # running line validity so overflow on an already-rejected
+            # line cannot trigger permanent slot growth.
+            overflowed = csr["overflow"] & chain_ok & valid
+            valid = valid & ~overflowed
+            csr_overflow_rows.append(overflowed)
         elif plan.kind == "ts":
             if ts_group_key(plan) in group_done:
                 continue
@@ -718,6 +764,10 @@ def compute_rows(
     row0 = jnp.where(valid, 1, 0).astype(jnp.int32)
     if plausible is not None:
         row0 = row0 | (jnp.where(plausible, 2, 0).astype(jnp.int32))
+    for overflowed in csr_overflow_rows:
+        row0 = row0 | jnp.where(overflowed, CSR_OVERFLOW_BIT, 0).astype(
+            jnp.int32
+        )
     rows[0] = row0
     zero = jnp.zeros(B, dtype=jnp.int32)
     return [r if r is not None else zero for r in rows]

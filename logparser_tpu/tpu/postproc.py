@@ -545,6 +545,134 @@ def split_csr(
     }
 
 
+def _ci_literal_mask(buf, shift, lit: bytes, in_span):
+    """[B, L] bool: case-insensitive `lit` match starting at this position
+    (ASCII fold on letters only)."""
+    m = None
+    for k, ch in enumerate(lit):
+        col = shift(buf, k) if k else buf
+        if ord("a") <= ch <= ord("z"):
+            part = (col | np.uint8(0x20)) == np.uint8(ch)
+        else:
+            part = col == np.uint8(ch)
+        m = part if m is None else (m & part)
+    return m & in_span
+
+
+_MINIMAL_EXPIRES_LENGTH = 15  # len("expires=XXXXXXX")
+
+
+def split_setcookie_csr(
+    buf: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+    max_segments: int,
+    shift_fn=None,
+) -> Dict[str, object]:
+    """Device split of a Set-Cookie response header list: ``", "`` separated
+    cookies with the expires-comma rejoin quirk
+    (ResponseSetCookieListDissector.java:78-115, dissectors/cookies.py:120).
+
+    A part whose FIRST (case-insensitive) ``expires=`` starts within 15
+    bytes of its end is glued to the following part (the expires date
+    itself contains ``", "``); the glued part is NOT re-checked.  Host
+    quirks preserved exactly: a trailing held part is silently dropped
+    (``emit`` False); a held part followed by another holding part is
+    overwritten on the host — those rows (and parts starting with a
+    case-insensitive ``set-cookie`` prefix, which the host name parser
+    strips) set ``bad`` and take the oracle.
+
+    Per segment k: the cookie name spans [seg_start[k], name_end[k])
+    (host strips + lowercases it; empty names are skipped there), the
+    delivered value is the RAW whole segment [seg_start[k], seg_end[k]).
+    ``overflow`` marks lines with more cookies than slots.
+    """
+    B, L = buf.shape
+    shift = shift_fn or shift_zero
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
+    in_span = (pos >= start[:, None]) & (pos < end[:, None])
+
+    is_sep = (
+        (buf == np.uint8(ord(",")))
+        & (shift(buf, 1) == np.uint8(ord(" ")))
+        & in_span
+        & (pos + 2 <= end[:, None])
+    )
+    is_semi = (buf == np.uint8(ord(";"))) & in_span
+    is_eq = (buf == np.uint8(ord("="))) & in_span
+    exp_mask = _ci_literal_mask(buf, shift, b"expires=", in_span)
+    prefix_mask = _ci_literal_mask(buf, shift, b"set-cookie", in_span)
+
+    seg_start: list = []
+    seg_end_l: list = []
+    name_end_l: list = []
+    emit_l: list = []
+    bad = jnp.zeros(B, dtype=bool)
+    cursor = start
+    for _ in range(max_segments):
+        usable = is_sep & (pos >= cursor[:, None])
+        nxt = jnp.min(jnp.where(usable, pos, L), axis=1).astype(jnp.int32)
+        s_end = jnp.minimum(nxt, end)
+        exp_usable = exp_mask & (pos >= cursor[:, None]) & (
+            pos + 8 <= s_end[:, None]
+        )
+        exp = jnp.min(jnp.where(exp_usable, pos, L), axis=1).astype(jnp.int32)
+        hold = (exp < L) & (exp > s_end - _MINIMAL_EXPIRES_LENGTH)
+        last = s_end >= end
+
+        # Merged end: the separator after the held part's date fragment.
+        usable2 = is_sep & (pos >= (s_end + 2)[:, None])
+        nxt2 = jnp.min(jnp.where(usable2, pos, L), axis=1).astype(jnp.int32)
+        s_end2 = jnp.minimum(nxt2, end)
+        exp2_usable = exp_mask & (pos >= (s_end + 2)[:, None]) & (
+            pos + 8 <= s_end2[:, None]
+        )
+        exp2 = jnp.min(jnp.where(exp2_usable, pos, L), axis=1).astype(jnp.int32)
+        hold2 = (exp2 < L) & (exp2 > s_end2 - _MINIMAL_EXPIRES_LENGTH)
+
+        merged = hold & ~last
+        bad = bad | (merged & hold2)  # host overwrite quirk -> oracle
+        drop = hold & last            # trailing held part: host drops it
+        seg_e = jnp.where(merged, s_end2, s_end)
+
+        semi = jnp.min(
+            jnp.where(is_semi & (pos >= cursor[:, None]) & (pos < seg_e[:, None]),
+                      pos, L),
+            axis=1,
+        ).astype(jnp.int32)
+        eq_bound = jnp.minimum(semi, seg_e)
+        eq = jnp.min(
+            jnp.where(is_eq & (pos >= cursor[:, None]) & (pos < eq_bound[:, None]),
+                      pos, L),
+            axis=1,
+        ).astype(jnp.int32)
+        name_end = jnp.minimum(jnp.minimum(eq, semi), seg_e)
+        nonempty = cursor < seg_e
+        emit = nonempty & ~drop
+        # The host name parser strips a (case-insensitive) set-cookie[2]:
+        # prefix first — those rows go to the oracle.
+        has_prefix = jnp.any(
+            prefix_mask & (pos == cursor[:, None]), axis=1
+        )
+        bad = bad | (emit & has_prefix)
+
+        seg_start.append(cursor)
+        seg_end_l.append(seg_e)
+        name_end_l.append(name_end)
+        emit_l.append(emit)
+        cursor = seg_e + 2
+    usable = is_sep & (pos >= cursor[:, None])
+    has_more = jnp.any(usable, axis=1) | (cursor < end)
+    return {
+        "seg_start": seg_start,
+        "seg_end": seg_end_l,
+        "name_end": name_end_l,
+        "emit": emit_l,
+        "bad": bad,
+        "overflow": has_more,
+    }
+
+
 def split_firstline(
     buf: jnp.ndarray,
     lengths: jnp.ndarray,

@@ -108,6 +108,44 @@ class TestQueryCsrDevice:
         assert result.oracle_rows == 0
         assert all(result.valid)
 
+    def test_adaptive_slots_grow_past_16(self):
+        # Query-heavy corpus: >16 params used to take the per-line oracle;
+        # the parser must instead double its CSR slots and stay on device.
+        uris = [
+            "/x?" + "&".join(f"p{i}={i}" for i in range(n))
+            for n in (3, 17, 25, 40, 64)
+        ]
+        lines = [
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET {u} HTTP/1.1" '
+            f"200 7"
+            for u in uris
+        ]
+        p = TpuBatchParser("common", [WILD, SPEC])
+        assert p.csr_slots == 16
+        n_valid, result = assert_csr_matches(p, lines)
+        assert n_valid == len(lines)
+        assert p.csr_slots == 64
+        assert result.oracle_rows == 0
+        # Grown slots persist: the next batch runs without recompiling.
+        n_valid2, result2 = assert_csr_matches(p, lines)
+        assert result2.oracle_rows == 0
+
+    def test_adaptive_slots_cap_routes_to_oracle(self):
+        from logparser_tpu.tpu.pipeline import CSR_SLOTS_MAX
+
+        big = "/x?" + "&".join(f"p{i}={i}" for i in range(CSR_SLOTS_MAX + 5))
+        lines = [
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET {big} HTTP/1.1" '
+            f"200 7",
+            '1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET /x?a=1 HTTP/1.1" '
+            "200 7",
+        ]
+        p = TpuBatchParser("common", [WILD, SPEC])
+        n_valid, result = assert_csr_matches(p, lines)
+        assert n_valid == 2          # oracle still delivers the huge line
+        assert p.csr_slots == CSR_SLOTS_MAX
+        assert result.oracle_rows == 1
+
 
 class TestCookieCsrDevice:
     """Request-cookie wildcard on the same CSR machinery ("; " separator,
@@ -151,3 +189,96 @@ class TestCookieCsrDevice:
             }
             assert wcol[i] == want, (i, cookies[i], wcol[i], want)
             assert scol[i] == rec.values.get(self.S), (i, cookies[i])
+
+
+class TestSetCookieCsrDevice:
+    """Response Set-Cookie list on device: ", "-separated cookies with the
+    expires-comma rejoin quirk (ResponseSetCookieListDissector semantics);
+    the delivered value is the raw whole cookie text."""
+
+    W = "HTTP.SETCOOKIE:response.cookies.*"
+    S = "HTTP.SETCOOKIE:response.cookies.sid"
+    PREFIX = "HTTP.SETCOOKIE:response.cookies."
+    FMT = '%h %l %u %t "%r" %>s %b "%{Set-Cookie}o"'
+
+    def _lines(self, values):
+        return [
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET /x HTTP/1.1" '
+            f'200 5 "{c}"'
+            for c in values
+        ]
+
+    def _assert_matches(self, p, values):
+        lines = self._lines(values)
+        result = p.parse_batch(lines)
+        wcol = result.to_pylist(self.W)
+        scol = result.to_pylist(self.S)
+        for i, line in enumerate(lines):
+            try:
+                rec = p.oracle.parse(line, _CollectingRecord())
+                ok = True
+            except Exception:
+                rec, ok = None, False
+            assert bool(result.valid[i]) == ok, (i, values[i])
+            if not ok:
+                continue
+            want = {
+                k[len(self.PREFIX):]: v
+                for k, v in rec.values.items()
+                if k.startswith(self.PREFIX)
+            }
+            assert wcol[i] == want, (i, values[i], wcol[i], want)
+            assert scol[i] == rec.values.get(self.S), (i, values[i])
+        return result
+
+    def test_setcookie_differential(self):
+        p = TpuBatchParser(self.FMT, [self.W, self.S])
+        assert p.plan_by_id[self.W].kind == "qscsr"
+        assert p.plan_by_id[self.W].meta == "setcookie"
+        values = [
+            "sid=abc; path=/",
+            "sid=a, theme=b",
+            "sid=1; expires=Thu, 01-Jan-2026 00:00:00 GMT; path=/, theme=d",
+            "sid=1; Expires=Thu, 01 Jan 2026 00:00:00 GMT",
+            "sid=1; expires=Thu, ",            # trailing held part: dropped
+            "x=expires=foo, y=2",              # early expires= in a value
+            "a=1, b=2, c=3",
+            "a=x=y; path=/, b=2",
+            "=nameless, b=2",
+            " sid = padded , t=1",
+            "-", "", "justaname",
+            "UP=Mixed; Path=/",
+            "sid=1; expires=Thu, 01-Jan-2026 00:00:00 GMT, "
+            "t2=2; expires=Fri, 02-Jan-2026 00:00:00 GMT",
+        ]
+        self._assert_matches(p, values)
+
+    def test_setcookie_quirks_route_to_oracle(self):
+        p = TpuBatchParser(self.FMT, [self.W, self.S])
+        values = [
+            # Double-hold: the host overwrites the first held part.
+            "a=1; expires=Thu, b=2; expires=Fri, 03-Jan-2026 00:00:00 GMT",
+            # set-cookie: prefix is stripped by the host name parser.
+            "set-cookie: sid=5; path=/",
+            "Set-Cookie2: sid=6",
+        ]
+        result = self._assert_matches(p, values)
+        assert result.oracle_rows == len(values)
+
+    def test_setcookie_stays_on_device(self):
+        p = TpuBatchParser(self.FMT, [self.W, self.S])
+        values = [
+            "sid=abc; path=/; expires=Thu, 01-Jan-2026 00:00:00 GMT, t=1",
+            "a=1, b=2",
+            "-",
+        ]
+        result = p.parse_batch(self._lines(values))
+        assert result.oracle_rows == 0
+        assert all(result.valid)
+
+    def test_setcookie_overflow_grows_slots(self):
+        p = TpuBatchParser(self.FMT, [self.W, self.S])
+        many = ", ".join(f"c{i}={i}" for i in range(24))
+        result = self._assert_matches(p, [many, "sid=1"])
+        assert p.csr_slots == 32
+        assert result.oracle_rows == 0
