@@ -773,14 +773,22 @@ class TpuBatchParser:
             merged = self.plan_by_id[fid]
             group = self._plan_group(merged)
             if packed is None or group in ("host", "wild"):
-                # host: oracle-only.  wild: CSR fields deliver exclusively
-                # through overrides (built by _materialize_csr below).
+                # host: oracle-only.  wild wildcards (.*) deliver
+                # exclusively through overrides; wild CONCRETE fields
+                # (query.img) get their span column filled directly by
+                # _materialize_csr — fresh arrays, it writes into them.
+                concrete_wild = (
+                    packed is not None
+                    and group == "wild"
+                    and merged.comp != "*"
+                )
                 columns[fid] = {
                     "kind": "span",
                     "starts": np.zeros(B, dtype=np.int32),
                     "ends": np.zeros(B, dtype=np.int32),
                     "ok": np.zeros(B, dtype=bool),
-                    "null": zeros_null,
+                    "null": np.zeros(B, dtype=bool) if concrete_wild
+                    else zeros_null,
                 }
                 continue
             if group == "span":
@@ -933,7 +941,9 @@ class TpuBatchParser:
         # is exactly a line the host engine fails, so those rows drop to
         # invalid and take the oracle (which rejects them identically).
         t_csr = time.perf_counter()
-        csr_failed = self._materialize_csr(packed, winner, valid, overrides, buf, B)
+        csr_failed = self._materialize_csr(
+            packed, winner, valid, overrides, columns, buf, B
+        )
         for i in csr_failed:
             valid[i] = False
             winner[i] = -1
@@ -991,20 +1001,29 @@ class TpuBatchParser:
         )
 
     def _materialize_csr(
-        self, packed, winner, valid, overrides, buf, B
+        self, packed, winner, valid, overrides, columns, buf, B
     ) -> set:
-        """Build override values for device CSR wildcard fields (query
-        params) from the packed segment table.  Per-line work is a few
-        dict inserts per present segment — orders of magnitude cheaper
-        than the full-engine oracle.  Returns rows whose value decode
-        failed (the host engine fails those lines; caller invalidates
-        them so the oracle re-rejects identically)."""
-        from ..dissectors.utils import resilient_url_decode
+        """Materialize device CSR wildcard groups (query params / cookies /
+        set-cookies) from the packed segment table.
+
+        Vectorized: emitted segments are flattened with numpy gathers into
+        one flat byte buffer per (names, values); per-segment Python work is
+        one bytes-slice decode.  Concrete fields (``query.img``) are matched
+        by name and written straight into their span COLUMN (no per-row
+        objects at all); wildcard ``.*`` fields build their per-row dicts
+        from the flat buffers.  Only rows that need per-value Python —
+        resilientUrlDecode (``%``/``+`` values), uri-chain name %-repair,
+        or whitespace/non-ASCII trimming at cookie name/value edges — take
+        the per-row fallback loop.  Returns rows whose value decode failed
+        (the host engine fails those lines; caller invalidates them so the
+        oracle re-rejects identically)."""
         from .pipeline import csr_group_key
 
         failed: set = set()
         if packed is None:
             return failed
+        L = buf.shape[1]
+        buf_flat = buf.reshape(-1)
         for ui, u in enumerate(self.units):
             qs_plans = [
                 (fid, u.plan_for(fid))
@@ -1023,100 +1042,253 @@ class TpuBatchParser:
                 by_key.setdefault(csr_group_key(p), []).append((fid, p))
             for key, flist in by_key.items():
                 ok = u.layout.get(block, key, "ok") != 0
-                # Through the URI chain the host %-repairs the whole URI
-                # BEFORE the query split (bad escapes -> %25, which also
-                # neuters %uXXXX); direct tokens ($args) reach the query
-                # dissector raw.  The repair inserts only digits, so it
-                # commutes with the device split and can be applied
-                # per-segment here.  Cookies additionally strip whitespace
-                # around names and values (RequestCookieListDissector).
                 uri_chain = bool(flist[0][1].steps)
                 cookie = flist[0][1].meta == "cookie"
                 setcookie = flist[0][1].meta == "setcookie"
-                segs = [
-                    tuple(
-                        u.layout.get(block, key, f"s{k}_{c}")
-                        for c in ("start", "nlen", "eq", "dec", "ndec",
-                                  "vstart", "vlen")
+                K = u.layout.csr_slots
+
+                def mat(comp: str) -> np.ndarray:
+                    return np.stack([
+                        u.layout.get(block, key, f"s{k}_{comp}")[:B][rows]
+                        for k in range(K)
+                    ])
+
+                SS, NL, VS, VL = mat("start"), mat("nlen"), mat("vstart"), mat("vlen")
+                HE = mat("eq").astype(bool)
+                DC = mat("dec").astype(bool)
+                ND = mat("ndec").astype(bool)
+                ok_r = ok[rows]
+                # A segment is emitted iff its name is non-empty: empty
+                # slots pack nlen 0, and "=value" segments (empty name)
+                # match nothing — QueryStringFieldDissector skips them.
+                # Set-cookie additionally requires the device emit bit.
+                emit = (NL > 0) & ok_r[None, :]
+                if setcookie:
+                    emit &= HE
+
+                # Segments needing per-value Python: url-decode (%/+ in
+                # value), uri-chain name %-repair, or cookie/set-cookie
+                # whitespace-or-non-ASCII trim at name/value edges (host
+                # str.strip() also eats \x1c-\x1f and unicode whitespace;
+                # >= 0x80 edge bytes conservatively take the slow path).
+                def edge(S, N):
+                    has = N > 0
+                    a = rows[None, :] * L + S
+                    first = buf_flat[np.where(has, a, 0)]
+                    last = buf_flat[np.where(has, a + N - 1, 0)]
+                    e = (first <= 0x20) | (first >= 0x80)
+                    e |= (last <= 0x20) | (last >= 0x80)
+                    return has & e
+
+                if setcookie:
+                    flag = edge(SS, NL)
+                elif cookie:
+                    flag = DC | edge(SS, NL) | edge(VS, VL)
+                elif uri_chain:
+                    flag = DC | ND
+                else:
+                    flag = DC
+                flag &= emit
+                row_flag = flag.any(axis=0)
+                vrows = rows[~row_flag]
+                py_rows = rows[row_flag]
+
+                need_dicts = any(p.comp == "*" for _, p in flist)
+                dicts: Dict[int, Optional[Dict[str, str]]] = (
+                    {int(r): {} for r in vrows.tolist()} if need_dicts else {}
+                )
+
+                # ---- vectorized path: flatten emitted segments ----------
+                emv = emit[:, ~row_flag]
+                pr, pk = np.nonzero(emv.T)  # row-major: slot order per row
+                if pr.size:
+                    sub = (pk, pr)
+                    s_row = vrows[pr]
+                    s_ss = SS[:, ~row_flag][sub]
+                    s_nl = NL[:, ~row_flag][sub]
+                    s_vs = VS[:, ~row_flag][sub]
+                    s_vl = np.where(
+                        HE[:, ~row_flag][sub] | setcookie,
+                        VL[:, ~row_flag][sub], 0,
                     )
-                    for k in range(u.layout.csr_slots)
-                ]
-                dicts: Dict[int, Optional[Dict[str, str]]] = {}
-                for i_ in rows:
-                    i = int(i_)
-                    if not ok[i]:
-                        dicts[i] = {}
-                        continue
-                    d: Optional[Dict[str, str]] = {}
-                    for ss, nl, he, dc, nd, vs, vl in segs:
-                        nlen = int(nl[i])
-                        has_eq = bool(he[i])
-                        if setcookie:
-                            # Set-Cookie segments: eq bit = emit; name is
-                            # stripped + lowercased (empty -> skipped, the
-                            # HttpCookie-parse ValueError path); the value
-                            # is the RAW whole cookie text.
-                            if not has_eq:
-                                continue
-                            s0 = int(ss[i])
-                            name = (
-                                bytes(buf[i, s0 : s0 + nlen])
-                                .decode("utf-8", "replace")
-                                .strip()
-                                .lower()
+
+                    def flat(starts, lens):
+                        off = np.zeros(len(lens) + 1, dtype=np.int64)
+                        np.cumsum(lens, out=off[1:])
+                        idx = np.repeat(
+                            s_row * L + starts - off[:-1], lens
+                        ) + np.arange(int(off[-1]), dtype=np.int64)
+                        return buf_flat[idx].tobytes(), off
+
+                    n_seg = pr.size
+                    if need_dicts:
+                        nb, non = flat(s_ss, s_nl)
+                        vb, nov = flat(s_vs, s_vl)
+                        # str.lower() reproduces the host lowercase exactly
+                        # (including any non-ASCII inside the name).
+                        names = [
+                            nb[non[j] : non[j + 1]]
+                            .decode("utf-8", "replace").lower()
+                            for j in range(n_seg)
+                        ]
+                        rl = s_row.tolist()
+                        vals = [
+                            vb[nov[j] : nov[j + 1]].decode("utf-8", "replace")
+                            for j in range(n_seg)
+                        ]
+                        for j in range(n_seg):
+                            dicts[rl[j]][names[j]] = vals[j]
+                        names_arr = np.array(names, dtype=object)
+
+                        def match_comp(comp: str) -> np.ndarray:
+                            return np.nonzero(names_arr == comp)[0]
+                    else:
+                        # Concrete-only: match names byte-wise without
+                        # building Python strings.  ASCII case fold; rare
+                        # segments with high bytes (host str.lower() may
+                        # rewrite them) decode individually.
+                        def match_comp(comp: str) -> np.ndarray:
+                            comp_b = comp.encode("utf-8")
+                            mlen = np.nonzero(s_nl == len(comp_b))[0]
+                            if mlen.size == 0 or len(comp_b) == 0:
+                                return mlen[:0]
+                            idx = (
+                                (s_row * L + s_ss)[mlen][:, None]
+                                + np.arange(len(comp_b))
                             )
-                            if name == "":
-                                continue
-                            v0 = int(vs[i])
-                            d[name] = bytes(
-                                buf[i, v0 : v0 + int(vl[i])]
-                            ).decode("utf-8", "replace")
-                            continue
-                        if nlen == 0 and not has_eq:
-                            continue  # empty slot / skipped empty segment
-                        s0 = int(ss[i])
-                        name = bytes(buf[i, s0 : s0 + nlen]).decode(
-                            "utf-8", "replace"
-                        )
-                        if uri_chain and nd[i]:
-                            name = _fix_uri_part(name, "")
-                        if cookie:
-                            name = name.strip()
-                        name = name.lower()
-                        if name == "":
-                            # "=value": the empty relative name matches
-                            # neither the wildcard nor any concrete target.
-                            continue
-                        if not has_eq:
-                            d[name] = ""
-                            continue
-                        v0 = int(vs[i])
-                        value = bytes(buf[i, v0 : v0 + int(vl[i])]).decode(
-                            "utf-8", "replace"
-                        )
-                        if cookie:
-                            value = value.strip()
-                        if dc[i]:
-                            if uri_chain:
-                                value = _fix_uri_part(value, "")
-                            try:
-                                value = resilient_url_decode(value)
-                            except ValueError:
-                                failed.add(i)
-                                d = None
-                                break
-                        d[name] = value
-                    if d is not None:
-                        dicts[i] = d
+                            g = buf_flat[idx]
+                            upper = (g >= 0x41) & (g <= 0x5A)
+                            folded = np.where(upper, g | 0x20, g)
+                            target = np.frombuffer(comp_b, dtype=np.uint8)
+                            eq = (folded == target).all(axis=1)
+                            high = (g >= 0x80).any(axis=1)
+                            out = mlen[eq & ~high]
+                            for jj in np.nonzero(high)[0]:
+                                j = int(mlen[jj])
+                                a = int(s_row[j] * L + s_ss[j])
+                                name = bytes(
+                                    buf_flat[a : a + int(s_nl[j])]
+                                ).decode("utf-8", "replace").lower()
+                                if name == comp:
+                                    out = np.append(out, j)
+                            out.sort()
+                            return out
+                else:
+
+                    def match_comp(comp: str) -> np.ndarray:
+                        return np.empty(0, dtype=np.int64)
+
+                    s_row = s_vs = s_vl = np.empty(0, dtype=np.int64)
+
                 for fid, p in flist:
-                    tgt = overrides[fid]
                     if p.comp == "*":
+                        continue
+                    # Concrete field -> span column writes (duplicate rows:
+                    # numpy fancy assignment keeps the LAST segment, the
+                    # host's overwrite order).
+                    col = columns[fid]
+                    col["ok"][vrows] = True
+                    col["null"][vrows] = True
+                    m = match_comp(p.comp)
+                    if m.size:
+                        mr = s_row[m]
+                        col["starts"][mr] = s_vs[m]
+                        col["ends"][mr] = s_vs[m] + s_vl[m]
+                        col["null"][mr] = False
+
+                # ---- per-row fallback: decode/repair/trim segments ------
+                if py_rows.size:
+                    self._materialize_csr_slow(
+                        py_rows, rows, ok, SS, NL, HE, DC, ND, VS, VL,
+                        uri_chain, cookie, setcookie, buf, dicts, failed,
+                        need_dicts, flist, overrides, columns,
+                    )
+
+                if need_dicts:
+                    for fid, p in flist:
+                        if p.comp != "*":
+                            continue
+                        tgt = overrides[fid]
                         for i, d in dicts.items():
                             tgt[i] = d
-                    else:
-                        for i, d in dicts.items():
-                            tgt[i] = d.get(p.comp) if d else None
         return failed
+
+    def _materialize_csr_slow(
+        self, py_rows, rows, ok, SS, NL, HE, DC, ND, VS, VL,
+        uri_chain, cookie, setcookie, buf, dicts, failed,
+        need_dicts, flist, overrides, columns,
+    ) -> None:
+        """Per-row CSR materialization for rows with segments that need
+        per-value Python (url-decode, %-repair, edge trimming) — the exact
+        host semantics, including decode-failure -> failed row."""
+        from ..dissectors.utils import resilient_url_decode
+
+        pos_of = {int(r): j for j, r in enumerate(rows.tolist())}
+        for i in py_rows.tolist():
+            i = int(i)
+            j = pos_of[i]
+            d: Optional[Dict[str, str]] = {}
+            if ok[i]:
+                for k in range(SS.shape[0]):
+                    nlen = int(NL[k, j])
+                    has_eq = bool(HE[k, j])
+                    if setcookie:
+                        if not has_eq:
+                            continue
+                        s0 = int(SS[k, j])
+                        name = (
+                            bytes(buf[i, s0 : s0 + nlen])
+                            .decode("utf-8", "replace")
+                            .strip()
+                            .lower()
+                        )
+                        if name == "":
+                            continue
+                        v0 = int(VS[k, j])
+                        d[name] = bytes(
+                            buf[i, v0 : v0 + int(VL[k, j])]
+                        ).decode("utf-8", "replace")
+                        continue
+                    if nlen == 0 and not has_eq:
+                        continue  # empty slot / skipped empty segment
+                    s0 = int(SS[k, j])
+                    name = bytes(buf[i, s0 : s0 + nlen]).decode(
+                        "utf-8", "replace"
+                    )
+                    if uri_chain and ND[k, j]:
+                        name = _fix_uri_part(name, "")
+                    if cookie:
+                        name = name.strip()
+                    name = name.lower()
+                    if name == "":
+                        # "=value": the empty relative name matches
+                        # neither the wildcard nor any concrete target.
+                        continue
+                    if not has_eq:
+                        d[name] = ""
+                        continue
+                    v0 = int(VS[k, j])
+                    value = bytes(buf[i, v0 : v0 + int(VL[k, j])]).decode(
+                        "utf-8", "replace"
+                    )
+                    if cookie:
+                        value = value.strip()
+                    if DC[k, j]:
+                        if uri_chain:
+                            value = _fix_uri_part(value, "")
+                        try:
+                            value = resilient_url_decode(value)
+                        except ValueError:
+                            failed.add(i)
+                            d = None
+                            break
+                    d[name] = value
+            if need_dicts and d is not None:
+                dicts[i] = d
+            for fid, p in flist:
+                if p.comp == "*":
+                    continue
+                overrides[fid][i] = (d.get(p.comp) if d else None)
 
     def _run_oracle(self, line: Union[bytes, str]) -> Optional[Dict[str, Any]]:
         if isinstance(line, bytes):

@@ -559,9 +559,6 @@ def _ci_literal_mask(buf, shift, lit: bytes, in_span):
     return m & in_span
 
 
-_MINIMAL_EXPIRES_LENGTH = 15  # len("expires=XXXXXXX")
-
-
 def split_setcookie_csr(
     buf: jnp.ndarray,
     start: jnp.ndarray,
@@ -587,6 +584,10 @@ def split_setcookie_csr(
     delivered value is the RAW whole segment [seg_start[k], seg_end[k]).
     ``overflow`` marks lines with more cookies than slots.
     """
+    # The shared quirk constant (len("expires=XXXXXXX")) — imported from
+    # the host dissector so device and host can never diverge.
+    from ..dissectors.cookies import _MINIMAL_EXPIRES_LENGTH
+
     B, L = buf.shape
     shift = shift_fn or shift_zero
     pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
