@@ -193,13 +193,18 @@ def marginal_device_rate(parser, buf, lengths, batch, n_lo=16, n_hi=144):
             best = min(best, time.perf_counter() - t0)
         return best
 
-    marginal_s = 0.0
-    for _attempt in range(2):  # re-measure once if noise flips the slope
-        marginal_s = (time_loop(n_hi) - time_loop(n_lo)) / (n_hi - n_lo)
-        if marginal_s > 0:
-            break
+    # The tunneled chip attachment jitters ~20% run-to-run.  The slope is
+    # a DIFFERENCE of two timings, so noise can push individual samples
+    # either way (an inflated n_lo makes the rate look too high) — take
+    # the median of three slopes rather than the extreme.
+    slopes = sorted(
+        (time_loop(n_hi) - time_loop(n_lo)) / (n_hi - n_lo)
+        for _attempt in range(3)
+    )
+    marginal_s = slopes[1]
     if marginal_s <= 0:
-        marginal_s = time_loop(n_hi) / n_hi
+        positive = [s for s in slopes if s > 0]
+        marginal_s = positive[0] if positive else time_loop(n_hi) / n_hi
     return batch / marginal_s
 
 

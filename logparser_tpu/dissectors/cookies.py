@@ -186,33 +186,54 @@ class ResponseSetCookieDissector(Dissector):
     def get_new_instance(self) -> "Dissector":
         return ResponseSetCookieDissector()
 
+    @classmethod
+    def parse_attrs(cls, value: str) -> dict:
+        """One Set-Cookie value -> delivered attributes.  The single source
+        of the per-cookie attribute semantics, shared by the per-line
+        dissect below and the batch CSR materializer (tpu/batch.py):
+        ``value`` = the first ';'-part's value; exact-lowercase attribute
+        keys (ResponseSetCookieDissector.java:99-118 switch — "Expires" is
+        ignored, matching the reference); ``expires`` in seconds (the
+        backwards-compatible STRING form) plus ``expires_epoch`` millis;
+        later duplicate attributes overwrite (record last-wins)."""
+        out: dict = {}
+        for i, raw_part in enumerate(value.split(";")):
+            part = raw_part.strip()
+            kv = part.split("=", 1)
+            key = kv[0].strip()
+            part_value = kv[1].strip() if len(kv) == 2 else ""
+            if i == 0:
+                out["value"] = part_value
+            elif key == "expires":
+                expires = cls._parse_expire(part_value)
+                out["expires"] = expires // 1000
+                out["expires_epoch"] = expires
+            elif key in ("domain", "comment", "path"):
+                out[key] = part_value
+            # Anything else (incl. max-age) is ignored.
+        return out
+
     def dissect(self, parsable, input_name: str) -> None:
         field = parsable.get_parsable_field(self.INPUT_TYPE, input_name)
         value = field.value.get_string()
         if value is None or value == "":
             return
 
-        for i, raw_part in enumerate(value.split(";")):
-            part = raw_part.strip()
-            kv = part.split("=", 1)
-            key = kv[0].strip()
-            part_value = kv[1].strip() if len(kv) == 2 else ""
+        attrs = self.parse_attrs(value)
+        if "value" in attrs:
+            parsable.add_dissection(input_name, "STRING", "value", attrs["value"])
+        if "expires" in attrs:
+            parsable.add_dissection(input_name, "STRING", "expires", attrs["expires"])
+            parsable.add_dissection(
+                input_name, "TIME.EPOCH", "expires", attrs["expires_epoch"]
+            )
+        for key in ("domain", "comment", "path"):
+            if key in attrs:
+                parsable.add_dissection(input_name, "STRING", key, attrs[key])
 
-            if i == 0:
-                parsable.add_dissection(input_name, "STRING", "value", part_value)
-            elif key == "expires":
-                expires = self._parse_expire(part_value)
-                # Backwards compatibility: STRING version is in seconds.
-                parsable.add_dissection(
-                    input_name, "STRING", "expires", expires // 1000
-                )
-                parsable.add_dissection(input_name, "TIME.EPOCH", "expires", expires)
-            elif key in ("domain", "comment", "path"):
-                parsable.add_dissection(input_name, "STRING", key, part_value)
-            # Anything else (incl. max-age) is ignored.
-
-    def _parse_expire(self, expire_string: str) -> int:
-        for layout in self._date_layouts():
+    @classmethod
+    def _parse_expire(cls, expire_string: str) -> int:
+        for layout in cls._date_layouts():
             try:
                 return layout.parse(expire_string).epoch_millis
             except (TimestampParseError, ValueError):

@@ -641,6 +641,36 @@ class TpuBatchParser:
                             ))
                         else:
                             plans.append(_FieldPlan(field_id, "host"))
+                    elif path.startswith(name + "."):
+                        # Per-cookie ATTRIBUTE through the Set-Cookie
+                        # wildcard: response.cookies.<cookie>.<attr> with
+                        # the attr typed by ResponseSetCookieDissector
+                        # (STRING value/path/domain/comment/expires;
+                        # TIME.EPOCH expires).  The cookie name is
+                        # everything before the last component (names may
+                        # contain dots).
+                        from ..dissectors.cookies import (
+                            ResponseSetCookieListDissector,
+                        )
+
+                        rest = path[len(name) + 1:]
+                        cname, _, attr = rest.rpartition(".")
+                        typed = (
+                            ftype == "STRING"
+                            and attr in ("value", "path", "domain",
+                                         "comment", "expires")
+                        ) or (ftype == "TIME.EPOCH" and attr == "expires")
+                        if (
+                            isinstance(d, ResponseSetCookieListDissector)
+                            and cname and typed
+                        ):
+                            if vctx[0] == "" and device_ok:
+                                plans.append(_FieldPlan(
+                                    field_id, "qscsr", tok.index, steps,
+                                    comp=cname, meta="setcookie", attr=attr,
+                                ))
+                            else:
+                                plans.append(_FieldPlan(field_id, "host"))
                     continue
                 if oname == "":
                     new_name = name
@@ -781,6 +811,7 @@ class TpuBatchParser:
                     packed is not None
                     and group == "wild"
                     and merged.comp != "*"
+                    and not getattr(merged, "attr", "")
                 )
                 columns[fid] = {
                     "kind": "span",
@@ -1144,34 +1175,50 @@ class TpuBatchParser:
                             return np.nonzero(names_arr == comp)[0]
                     else:
                         # Concrete-only: match names byte-wise without
-                        # building Python strings.  ASCII case fold; rare
-                        # segments with high bytes (host str.lower() may
-                        # rewrite them) decode individually.
+                        # building Python strings.  ASCII case fold.
+                        # Segments containing ANY high byte are decoded
+                        # individually regardless of byte length: host
+                        # str.lower() can change the UTF-8 length (e.g.
+                        # U+212A Kelvin sign, 3 bytes -> 'k', 1 byte), so
+                        # a raw-length pre-filter would silently miss them.
+                        nb_arr, non = flat(s_ss, s_nl)
+                        nb_np = np.frombuffer(nb_arr, dtype=np.uint8)
+                        if nb_np.size:
+                            seg_high = np.add.reduceat(
+                                (nb_np >= 0x80).astype(np.int64), non[:-1]
+                            ) > 0
+                        else:
+                            seg_high = np.zeros(n_seg, dtype=bool)
+
                         def match_comp(comp: str) -> np.ndarray:
                             comp_b = comp.encode("utf-8")
-                            mlen = np.nonzero(s_nl == len(comp_b))[0]
-                            if mlen.size == 0 or len(comp_b) == 0:
-                                return mlen[:0]
-                            idx = (
-                                (s_row * L + s_ss)[mlen][:, None]
-                                + np.arange(len(comp_b))
-                            )
-                            g = buf_flat[idx]
-                            upper = (g >= 0x41) & (g <= 0x5A)
-                            folded = np.where(upper, g | 0x20, g)
-                            target = np.frombuffer(comp_b, dtype=np.uint8)
-                            eq = (folded == target).all(axis=1)
-                            high = (g >= 0x80).any(axis=1)
-                            out = mlen[eq & ~high]
-                            for jj in np.nonzero(high)[0]:
-                                j = int(mlen[jj])
-                                a = int(s_row[j] * L + s_ss[j])
-                                name = bytes(
-                                    buf_flat[a : a + int(s_nl[j])]
-                                ).decode("utf-8", "replace").lower()
-                                if name == comp:
-                                    out = np.append(out, j)
-                            out.sort()
+                            if len(comp_b) == 0:
+                                return np.empty(0, dtype=np.int64)
+                            mlen = np.nonzero(
+                                (s_nl == len(comp_b)) & ~seg_high
+                            )[0]
+                            out = mlen
+                            if mlen.size:
+                                idx = (
+                                    (s_row * L + s_ss)[mlen][:, None]
+                                    + np.arange(len(comp_b))
+                                )
+                                g = buf_flat[idx]
+                                upper = (g >= 0x41) & (g <= 0x5A)
+                                folded = np.where(upper, g | 0x20, g)
+                                target = np.frombuffer(comp_b, dtype=np.uint8)
+                                out = mlen[(folded == target).all(axis=1)]
+                            extra = [
+                                j
+                                for j in np.nonzero(seg_high)[0].tolist()
+                                if nb_arr[non[j] : non[j + 1]]
+                                .decode("utf-8", "replace").lower() == comp
+                            ]
+                            if extra:
+                                out = np.concatenate(
+                                    [out, np.asarray(extra, dtype=np.int64)]
+                                )
+                                out.sort()
                             return out
                 else:
 
@@ -1180,8 +1227,22 @@ class TpuBatchParser:
 
                     s_row = s_vs = s_vl = np.empty(0, dtype=np.int64)
 
+                match_cache: Dict[str, np.ndarray] = {}
+                attrs_cache: Dict[str, dict] = {}
                 for fid, p in flist:
                     if p.comp == "*":
+                        continue
+                    m = match_cache.get(p.comp)
+                    if m is None:
+                        m = match_cache[p.comp] = match_comp(p.comp)
+                    if getattr(p, "attr", ""):
+                        # Per-cookie attribute: parse the matched cookie's
+                        # text once per row (host parse_attrs — the exact
+                        # per-line semantics) and deliver via overrides.
+                        self._deliver_setcookie_attr(
+                            fid, p, m, s_row, s_vs, s_vl, buf, overrides,
+                            attrs_cache,
+                        )
                         continue
                     # Concrete field -> span column writes (duplicate rows:
                     # numpy fancy assignment keeps the LAST segment, the
@@ -1189,7 +1250,6 @@ class TpuBatchParser:
                     col = columns[fid]
                     col["ok"][vrows] = True
                     col["null"][vrows] = True
-                    m = match_comp(p.comp)
                     if m.size:
                         mr = s_row[m]
                         col["starts"][mr] = s_vs[m]
@@ -1213,6 +1273,44 @@ class TpuBatchParser:
                             tgt[i] = d
         return failed
 
+    @staticmethod
+    def _setcookie_attr_key(fid: str, attr: str) -> str:
+        """parse_attrs key for a requested attr field: the TIME.EPOCH twin
+        of expires reads the millis value, everything else its own name."""
+        if attr == "expires" and fid.startswith("TIME.EPOCH:"):
+            return "expires_epoch"
+        return attr
+
+    def _deliver_setcookie_attr(
+        self, fid, p, m, s_row, s_vs, s_vl, buf, overrides, attrs_cache
+    ) -> None:
+        """Deliver one per-cookie attribute field for matched segments.
+        With duplicate same-name cookies, the host dissects only the LAST
+        delivery (the parsable cache entry is overwritten before the
+        sub-dissector consumes it), so only the last matched segment per
+        row is parsed; its absent attributes read None.  ``attrs_cache``
+        memoizes parse_attrs by cookie text so N requested attributes of
+        one cookie split/date-parse it once."""
+        from ..dissectors.cookies import ResponseSetCookieDissector
+
+        key = self._setcookie_attr_key(fid, p.attr)
+        tgt = overrides[fid]
+        last: Dict[int, int] = {}
+        for j in m.tolist():
+            last[int(s_row[j])] = j
+        for row, j in last.items():
+            v0 = int(s_vs[j])
+            text = bytes(buf[row, v0 : v0 + int(s_vl[j])]).decode(
+                "utf-8", "replace"
+            )
+            attrs = attrs_cache.get(text)
+            if attrs is None:
+                attrs = attrs_cache[text] = (
+                    ResponseSetCookieDissector.parse_attrs(text)
+                )
+            if key in attrs:
+                tgt[row] = attrs[key]
+
     def _materialize_csr_slow(
         self, py_rows, rows, ok, SS, NL, HE, DC, ND, VS, VL,
         uri_chain, cookie, setcookie, buf, dicts, failed,
@@ -1221,8 +1319,10 @@ class TpuBatchParser:
         """Per-row CSR materialization for rows with segments that need
         per-value Python (url-decode, %-repair, edge trimming) — the exact
         host semantics, including decode-failure -> failed row."""
+        from ..dissectors.cookies import ResponseSetCookieDissector
         from ..dissectors.utils import resilient_url_decode
 
+        attrs_cache: Dict[str, dict] = {}
         pos_of = {int(r): j for j, r in enumerate(rows.tolist())}
         for i in py_rows.tolist():
             i = int(i)
@@ -1287,6 +1387,20 @@ class TpuBatchParser:
                 dicts[i] = d
             for fid, p in flist:
                 if p.comp == "*":
+                    continue
+                if getattr(p, "attr", ""):
+                    # `d` keeps the last same-name cookie — exactly the
+                    # one the host's cache-overwrite semantics dissect.
+                    text = d.get(p.comp) if d else None
+                    if text:
+                        key = self._setcookie_attr_key(fid, p.attr)
+                        attrs = attrs_cache.get(text)
+                        if attrs is None:
+                            attrs = attrs_cache[text] = (
+                                ResponseSetCookieDissector.parse_attrs(text)
+                            )
+                        if key in attrs:
+                            overrides[fid][i] = attrs[key]
                     continue
                 overrides[fid][i] = (d.get(p.comp) if d else None)
 

@@ -57,10 +57,14 @@ class FieldPlan:
     kind: str                     # span | long | secmillis | ts | host
     token_index: int = -1
     steps: Tuple[Tuple[str, str], ...] = ()   # e.g. (("fl", "uri"),)
-    comp: str = ""                # ts output name
-    meta: object = None           # ts: DeviceTimeLayout
+    comp: str = ""                # ts output name / CSR wildcard key
+    meta: object = None           # ts: DeviceTimeLayout; qscsr: mode
     null_mode: str = ""           # "" | dash_null | dash_zero | zero_null
     scale: int = 1                # value multiplier (ms -> us converters)
+    # qscsr set-cookie only: the per-cookie attribute requested THROUGH the
+    # wildcard (value/expires/path/domain/comment); comp is the cookie name.
+    # Materialized host-side per matched row (cookies.parse_attrs).
+    attr: str = ""
 
 
 # ---------------------------------------------------------------------------

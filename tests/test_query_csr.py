@@ -282,3 +282,96 @@ class TestSetCookieCsrDevice:
         result = self._assert_matches(p, [many, "sid=1"])
         assert p.csr_slots == 32
         assert result.oracle_rows == 0
+
+
+class TestSetCookieAttrDevice:
+    """Per-cookie attribute fields THROUGH the Set-Cookie wildcard
+    (response.cookies.sid.value / .expires / .path / .domain / .comment):
+    device CSR segment match + host parse_attrs per matched row."""
+
+    FMT = '%h %l %u %t "%r" %>s %b "%{Set-Cookie}o"'
+    FIELDS = [
+        "STRING:response.cookies.sid.value",
+        "STRING:response.cookies.sid.expires",
+        "TIME.EPOCH:response.cookies.sid.expires",
+        "STRING:response.cookies.sid.path",
+        "STRING:response.cookies.sid.domain",
+        "STRING:response.cookies.sid.comment",
+    ]
+
+    def _lines(self, values):
+        return [
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET /x HTTP/1.1" '
+            f'200 5 "{c}"'
+            for c in values
+        ]
+
+    def test_plans_resolve_through_wildcard(self):
+        p = TpuBatchParser(self.FMT, self.FIELDS)
+        for f in self.FIELDS:
+            plan = p.plan_by_id[f]
+            assert plan.kind == "qscsr", (f, plan.kind)
+            assert plan.comp == "sid"
+            assert plan.attr
+        assert p._unit_oracle_fields == [[]]
+
+    def test_attr_differential(self):
+        p = TpuBatchParser(self.FMT, self.FIELDS)
+        values = [
+            "sid=abc; path=/shop; expires=Thu, 01-Jan-2027 00:00:00 GMT; "
+            "domain=ex.com; comment=hi",
+            "sid=plain",
+            "sid=1; Expires=Thu, 01 Jan 2027 00:00:00 GMT",  # uppercase: ignored
+            "sid=1; expires=Thu, 01 Jan 2027 00:00:00 GMT",
+            "sid=1; expires=garbage",                         # parse fail -> 0
+            "other=1; path=/x",                               # sid absent
+            "sid=a; path=/1, sid=b; domain=d2",               # duplicate merge
+            "sid=a; max-age=3600",                            # ignored attr
+            "-", "",
+            "sid=v; path = /sp ; domain= d.e",
+            "SID=case; path=/c",
+        ]
+        lines = self._lines(values)
+        result = p.parse_batch(lines)
+        cols = {f: result.to_pylist(f) for f in self.FIELDS}
+        for i, line in enumerate(lines):
+            rec = p.oracle.parse(line, _CollectingRecord())
+            for f in self.FIELDS:
+                want = rec.values.get(f)
+                got = cols[f][i]
+                if isinstance(got, int) and want is not None:
+                    want = int(want)
+                assert got == want, (i, values[i], f, got, want)
+
+    def test_attrs_stay_on_device(self):
+        p = TpuBatchParser(self.FMT, self.FIELDS)
+        values = [
+            "sid=abc; path=/shop; expires=Thu, 01-Jan-2027 00:00:00 GMT",
+            "sid=x", "other=1",
+        ]
+        result = p.parse_batch(self._lines(values))
+        assert result.oracle_rows == 0
+        assert cols_ok(result)
+
+
+def cols_ok(result):
+    return all(result.valid)
+
+
+def test_concrete_match_survives_unicode_lower():
+    # U+212A (KELVIN SIGN, 3 UTF-8 bytes) lowercases to 'k' (1 byte): the
+    # concrete-only byte-match pre-filter must not drop it on raw length.
+    p = TpuBatchParser('$remote_addr [$time_local] "$args" $status',
+                       ["STRING:request.firstline.uri.query.k"])
+    args = ["K=kelvin", "k=plain", "x=1"]
+    lines = [
+        f'2.2.2.2 [07/Mar/2026:10:00:00 +0000] "{a}" 200' for a in args
+    ]
+    result = p.parse_batch(lines)
+    col = result.to_pylist("STRING:request.firstline.uri.query.k")
+    want = []
+    for line in lines:
+        rec = p.oracle.parse(line, _CollectingRecord())
+        want.append(rec.values.get("STRING:request.firstline.uri.query.k"))
+    assert col == want, (col, want)
+    assert col[0] == "kelvin" and col[1] == "plain" and col[2] is None
