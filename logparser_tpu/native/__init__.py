@@ -122,7 +122,7 @@ def encode_blob(
     data: bytes,
     line_len: int = 0,
     min_bucket: int = 64,
-    cap: int = 4096,
+    cap: int = 8191,  # tpu.runtime.DEFAULT_MAX_LINE_LEN (13-bit span slots)
     threads: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray, List[int]]:
     """Newline-delimited bytes -> (buf [B, L] uint8, lengths [B] int32,

@@ -282,7 +282,7 @@ def compute_split(
 # ---------------------------------------------------------------------------
 # Packed output layout: every output component is a bit slot (row, shift,
 # bits) in the [K, B] int32 result.  Span-producing kinds pack
-# start|len|ok into ONE row (13+13+1 bits; L is capped at 4096 =
+# start|len|ok into ONE row (13+13+1 bits; L is capped at 8191 =
 # runtime.DEFAULT_MAX_LINE_LEN); numeric/epoch aux bits (ok/null/lo_digits)
 # share trailing "meta" rows.  Device->host transfer is round-trip- and
 # bandwidth-bound on tunneled attachments, so rows are precious.

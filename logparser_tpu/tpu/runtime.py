@@ -21,7 +21,10 @@ import jax.numpy as jnp
 
 from .program import DeviceProgram
 
-DEFAULT_MAX_LINE_LEN = 4096
+# The packed span slots are 13 bits (pipeline._SPAN_BITS), so the device
+# path handles lines up to 8191 bytes; only longer lines overflow to the
+# host oracle.
+DEFAULT_MAX_LINE_LEN = 8191
 
 
 def bucket_length(max_len: int, min_bucket: int = 64,
@@ -58,7 +61,8 @@ def encode_batch(
             b"\n" in r or r.endswith(b"\r") or not r for r in raw
         ):
             buf, lengths, overflow = encode_blob(
-                b"\n".join(raw), line_len, min_bucket
+                b"\n".join(raw), line_len, min_bucket,
+                cap=DEFAULT_MAX_LINE_LEN,
             )
             if buf.shape[0] == len(raw):
                 return buf, lengths, overflow

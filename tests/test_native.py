@@ -16,7 +16,7 @@ needs_native = pytest.mark.skipif(
 
 def _both(blob, **kw):
     return encode_blob(blob, **kw), _encode_blob_numpy(
-        blob, kw.get("line_len", 0), kw.get("min_bucket", 64), kw.get("cap", 4096)
+        blob, kw.get("line_len", 0), kw.get("min_bucket", 64), kw.get("cap", 8191)
     )
 
 
@@ -29,7 +29,7 @@ def _both(blob, **kw):
         b"a\nbb\nccc\n",
         b"a\r\nb\r\n",          # CRLF stripped
         b"\n\n",                # empty lines
-        b"x" * 5000 + b"\nshort\n",  # overflow beyond the 4096 cap
+        b"x" * 9000 + b"\nshort\n",  # overflow beyond the 8191 cap
         bytes(range(1, 10)) + b"\n" + b"\xff\xfe binary ok\n",
     ],
 )
@@ -43,11 +43,11 @@ def test_native_matches_numpy(blob):
 
 @needs_native
 def test_native_overflow_reported():
-    blob = b"y" * 5000 + b"\nok\n"
+    blob = b"y" * 9000 + b"\nok\n"
     buf, lengths, overflow = encode_blob(blob)
     assert overflow == [0]
-    assert buf.shape[1] == 4096
-    assert lengths[0] == 4096  # truncated, overflow bit stripped
+    assert buf.shape[1] == 8191
+    assert lengths[0] == 8191  # truncated, overflow bit stripped
     assert bytes(buf[1][: lengths[1]]) == b"ok"
 
 

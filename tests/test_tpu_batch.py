@@ -130,15 +130,32 @@ class TestEdge:
         else:
             assert ua == expected.get("HTTP.USERAGENT:request.user-agent")
 
-    def test_long_line_overflow(self):
+    def test_long_line_device_resident(self):
+        # Lines up to 8191 bytes fit the 13-bit span slots: no oracle.
         line = (
             '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /'
             + "a" * 8000
             + ' HTTP/1.1" 200 5 "-" "-"'
         )
+        assert len(line) <= 8191
+        batch = TpuBatchParser("combined", FIELDS)
+        result = batch.parse_batch([line])
+        assert result.valid[0]
+        assert result.oracle_rows == 0
+        assert result.to_pylist("STRING:request.status.last")[0] == "200"
+        uri = result.to_pylist("HTTP.URI:request.firstline.uri")[0]
+        assert uri == "/" + "a" * 8000
+
+    def test_long_line_overflow(self):
+        line = (
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /'
+            + "a" * 8300
+            + ' HTTP/1.1" 200 5 "-" "-"'
+        )
         batch = TpuBatchParser("combined", FIELDS)
         result = batch.parse_batch([line])
         # Overflows the max device bucket -> host oracle handles it.
+        assert result.oracle_rows == 1
         assert result.valid[0]
         assert result.to_pylist("STRING:request.status.last")[0] == "200"
 
