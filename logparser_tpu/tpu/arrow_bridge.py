@@ -103,18 +103,28 @@ def _column_to_arrow(result: "BatchResult", field_id: str):
         if arr is not None:
             return arr
 
+    if field_id.endswith(".*"):
+        # Wildcard map columns: the flat CSR buffers build the MapArray
+        # directly when possible (no per-row dict materialization at all);
+        # the dict path handles the exact-semantics leftovers.
+        from .batch import _LazyWildcard
+
+        if isinstance(overrides, _LazyWildcard):
+            arr = overrides.to_arrow_map(B)
+            if arr is not None:
+                return arr
+        return pa.array(
+            [
+                None if v is None else list(v.items())
+                for v in result.to_pylist(field_id)
+            ],
+            type=pa.map_(pa.string(), pa.string()),
+        )
+
     # Host-delivered / span columns: type from the materialized values
     # (host-path numerics — e.g. dissector-produced numbers like GeoIP
     # asn.number — must come out int64/float64, not stringified).
     values_py = result.to_pylist(field_id)
-    if field_id.endswith(".*"):
-        return pa.array(
-            [
-                None if v is None else list(v.items())
-                for v in values_py
-            ],
-            type=pa.map_(pa.string(), pa.string()),
-        )
     non_null = [v for v in values_py if v is not None]
     if non_null and all(isinstance(v, int) and not isinstance(v, bool) for v in non_null):
         return pa.array(values_py, type=pa.int64())

@@ -128,6 +128,149 @@ def _oracle_worker_run(lines: List[str]) -> List[Optional[Dict[str, Any]]]:
     return out
 
 
+class _LazyWildcard:
+    """Override mapping for wildcard (``.*``) CSR fields.
+
+    The flat CSR buffers (rows, per-segment name/value byte runs) are kept
+    as-is; the per-row Python dicts the ``to_pylist`` contract requires
+    materialize on first dict-style access.  The Arrow bridge reads the
+    flat buffers directly (``to_arrow_map``) and never pays the per-row
+    build.  ``eager`` holds dicts delivered individually (slow-path rows,
+    oracle fallback); it always wins over chunk data for the same row.
+    """
+
+    __slots__ = ("eager", "chunks", "_dense", "dropped")
+
+    def __init__(self) -> None:
+        self.eager: Dict[int, Any] = {}
+        # (vrows, seg_row, name_bytes, name_off, val_bytes, val_off, high)
+        self.chunks: List[tuple] = []
+        self._dense: Optional[Dict[int, Any]] = None
+        # Tombstones: rows popped by the caller (csr_failed invalidation).
+        # A row can be chunk-delivered by one CSR group and failed by
+        # ANOTHER group on the same line, so pop must shadow chunk data
+        # too, not just `eager`.
+        self.dropped: set = set()
+
+    def add_chunk(self, vrows, seg_row, nb, non, vb, nov, seg_high) -> None:
+        self.chunks.append((vrows, seg_row, nb, non, vb, nov, seg_high))
+        self._dense = None
+
+    def _materialize(self) -> Dict[int, Any]:
+        if self._dense is None:
+            dense: Dict[int, Any] = {}
+            for vrows, seg_row, nb, non, vb, nov, _hi in self.chunks:
+                for r in vrows.tolist():
+                    dense[r] = {}
+                rl = seg_row.tolist()
+                for j in range(len(rl)):
+                    name = (
+                        nb[non[j] : non[j + 1]]
+                        .decode("utf-8", "replace").lower()
+                    )
+                    dense[rl[j]][name] = vb[nov[j] : nov[j + 1]].decode(
+                        "utf-8", "replace"
+                    )
+            dense.update(self.eager)
+            for i in self.dropped:
+                dense.pop(i, None)
+            self._dense = dense
+        return self._dense
+
+    def __contains__(self, i) -> bool:
+        return i in self._materialize()
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __setitem__(self, i, value) -> None:
+        self.eager[i] = value
+        self.dropped.discard(i)
+        if self._dense is not None:
+            self._dense[i] = value
+
+    def pop(self, i, default=None):
+        self.dropped.add(i)
+        if self._dense is not None:
+            self._dense.pop(i, None)
+        return self.eager.pop(i, default)
+
+    def __bool__(self) -> bool:
+        return bool(self.eager) or any(
+            len(c[0]) for c in self.chunks
+        ) or bool(self._dense)
+
+    def to_arrow_map(self, B: int):
+        """pyarrow MapArray built straight from the flat buffers; None when
+        this needs the exact dict path (multi-chunk/multi-format results,
+        individually-delivered rows, non-ASCII names whose str.lower()
+        differs from the byte fold, duplicate names within a row — the
+        dict contract collapses those)."""
+        if (
+            self._dense is not None or self.eager or self.dropped
+            or len(self.chunks) != 1
+        ):
+            return None
+        import pyarrow as pa
+
+        vrows, seg_row, nb, non, vb, nov, seg_high = self.chunks[0]
+        if bool(np.asarray(seg_high).any()):
+            return None
+        n_seg = len(seg_row)
+        nb_np = np.frombuffer(nb, dtype=np.uint8)
+        upper = (nb_np >= 0x41) & (nb_np <= 0x5A)
+        folded = np.where(upper, nb_np | 0x20, nb_np)
+        if n_seg:
+            # Duplicate-name detection by signature (row, len, sum, first,
+            # last byte) over the FOLDED bytes — the emitted keys are
+            # folded, so "A"/"a" must count as duplicates.  Any collision
+            # — including a false positive — bails to the dict path,
+            # which dedups exactly.
+            lens = np.diff(non)
+            sums = np.add.reduceat(folded.astype(np.int64), non[:-1])
+            sig = np.stack([
+                np.asarray(seg_row, dtype=np.int64), lens, sums,
+                folded[non[:-1]].astype(np.int64),
+                folded[non[1:] - 1].astype(np.int64),
+            ])
+            if np.unique(sig, axis=1).shape[1] != n_seg:
+                return None
+        if int(non[-1]) > np.iinfo(np.int32).max or int(nov[-1]) > np.iinfo(
+            np.int32
+        ).max:
+            return None
+        counts = np.zeros(B, dtype=np.int64)
+        left = np.searchsorted(seg_row, vrows, side="left")
+        right = np.searchsorted(seg_row, vrows, side="right")
+        counts[vrows] = right - left
+        covered = np.zeros(B, dtype=bool)
+        covered[vrows] = True
+        offsets64 = np.zeros(B + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets64[1:])
+        offsets = offsets64.astype(np.int32)
+        mask = np.concatenate([~covered, [False]])
+        try:
+            keys = pa.StringArray.from_buffers(
+                n_seg,
+                pa.py_buffer(non.astype(np.int32)),
+                pa.py_buffer(np.ascontiguousarray(folded)),
+            )
+            items = pa.StringArray.from_buffers(
+                n_seg,
+                pa.py_buffer(nov.astype(np.int32)),
+                pa.py_buffer(np.frombuffer(vb, dtype=np.uint8)),
+            )
+            arr = pa.MapArray.from_arrays(
+                pa.array(offsets, type=pa.int32(), mask=mask), keys, items
+            )
+            arr.validate(full=True)  # UTF-8 check happens here
+        except (pa.lib.ArrowException, TypeError, ValueError):
+            # Anything the flat construction cannot express exactly falls
+            # back to the dict path (which is always correct).
+            return None
+        return arr
+
+
 class BatchResult:
     """Columnar parse result over one batch."""
 
@@ -966,7 +1109,10 @@ class TpuBatchParser:
                         pass
             return value
 
-        overrides: Dict[str, Dict[int, Any]] = {fid: {} for fid in columns}
+        overrides: Dict[str, Any] = {
+            fid: (_LazyWildcard() if fid.endswith(".*") else {})
+            for fid in columns
+        }
         # Device CSR wildcards (query params): build the per-line override
         # values from the packed segment table; a resilientUrlDecode failure
         # is exactly a line the host engine fails, so those rows drop to
@@ -1125,14 +1271,16 @@ class TpuBatchParser:
                 py_rows = rows[row_flag]
 
                 need_dicts = any(p.comp == "*" for _, p in flist)
-                dicts: Dict[int, Optional[Dict[str, str]]] = (
-                    {int(r): {} for r in vrows.tolist()} if need_dicts else {}
-                )
+                dicts: Dict[int, Optional[Dict[str, str]]] = {}
 
                 # ---- vectorized path: flatten emitted segments ----------
                 emv = emit[:, ~row_flag]
                 pr, pk = np.nonzero(emv.T)  # row-major: slot order per row
-                if pr.size:
+                n_seg = pr.size
+                nb, non = b"", np.zeros(1, dtype=np.int64)
+                vb, nov = b"", np.zeros(1, dtype=np.int64)
+                seg_high = np.zeros(0, dtype=bool)
+                if n_seg:
                     sub = (pk, pr)
                     s_row = vrows[pr]
                     s_ss = SS[:, ~row_flag][sub]
@@ -1151,81 +1299,56 @@ class TpuBatchParser:
                         ) + np.arange(int(off[-1]), dtype=np.int64)
                         return buf_flat[idx].tobytes(), off
 
-                    n_seg = pr.size
-                    if need_dicts:
-                        nb, non = flat(s_ss, s_nl)
-                        vb, nov = flat(s_vs, s_vl)
-                        # str.lower() reproduces the host lowercase exactly
-                        # (including any non-ASCII inside the name).
-                        names = [
-                            nb[non[j] : non[j + 1]]
-                            .decode("utf-8", "replace").lower()
-                            for j in range(n_seg)
-                        ]
-                        rl = s_row.tolist()
-                        vals = [
-                            vb[nov[j] : nov[j + 1]].decode("utf-8", "replace")
-                            for j in range(n_seg)
-                        ]
-                        for j in range(n_seg):
-                            dicts[rl[j]][names[j]] = vals[j]
-                        names_arr = np.array(names, dtype=object)
-
-                        def match_comp(comp: str) -> np.ndarray:
-                            return np.nonzero(names_arr == comp)[0]
+                    nb, non = flat(s_ss, s_nl)
+                    nb_np = np.frombuffer(nb, dtype=np.uint8)
+                    if nb_np.size:
+                        seg_high = np.add.reduceat(
+                            (nb_np >= 0x80).astype(np.int64), non[:-1]
+                        ) > 0
                     else:
-                        # Concrete-only: match names byte-wise without
-                        # building Python strings.  ASCII case fold.
-                        # Segments containing ANY high byte are decoded
-                        # individually regardless of byte length: host
-                        # str.lower() can change the UTF-8 length (e.g.
-                        # U+212A Kelvin sign, 3 bytes -> 'k', 1 byte), so
-                        # a raw-length pre-filter would silently miss them.
-                        nb_arr, non = flat(s_ss, s_nl)
-                        nb_np = np.frombuffer(nb_arr, dtype=np.uint8)
-                        if nb_np.size:
-                            seg_high = np.add.reduceat(
-                                (nb_np >= 0x80).astype(np.int64), non[:-1]
-                            ) > 0
-                        else:
-                            seg_high = np.zeros(n_seg, dtype=bool)
-
-                        def match_comp(comp: str) -> np.ndarray:
-                            comp_b = comp.encode("utf-8")
-                            if len(comp_b) == 0:
-                                return np.empty(0, dtype=np.int64)
-                            mlen = np.nonzero(
-                                (s_nl == len(comp_b)) & ~seg_high
-                            )[0]
-                            out = mlen
-                            if mlen.size:
-                                idx = (
-                                    (s_row * L + s_ss)[mlen][:, None]
-                                    + np.arange(len(comp_b))
-                                )
-                                g = buf_flat[idx]
-                                upper = (g >= 0x41) & (g <= 0x5A)
-                                folded = np.where(upper, g | 0x20, g)
-                                target = np.frombuffer(comp_b, dtype=np.uint8)
-                                out = mlen[(folded == target).all(axis=1)]
-                            extra = [
-                                j
-                                for j in np.nonzero(seg_high)[0].tolist()
-                                if nb_arr[non[j] : non[j + 1]]
-                                .decode("utf-8", "replace").lower() == comp
-                            ]
-                            if extra:
-                                out = np.concatenate(
-                                    [out, np.asarray(extra, dtype=np.int64)]
-                                )
-                                out.sort()
-                            return out
+                        seg_high = np.zeros(n_seg, dtype=bool)
+                    if need_dicts:
+                        vb, nov = flat(s_vs, s_vl)
                 else:
+                    s_row = s_ss = s_nl = s_vs = s_vl = np.empty(
+                        0, dtype=np.int64
+                    )
 
-                    def match_comp(comp: str) -> np.ndarray:
+                def match_comp(comp: str) -> np.ndarray:
+                    # Byte-wise name match with ASCII case fold; Python
+                    # strings are never built for the common case.
+                    # Segments containing ANY high byte decode individually
+                    # regardless of byte length: host str.lower() can
+                    # change the UTF-8 length (e.g. U+212A Kelvin sign,
+                    # 3 bytes -> 'k', 1 byte), so a raw-length pre-filter
+                    # would silently miss them.
+                    comp_b = comp.encode("utf-8")
+                    if n_seg == 0 or len(comp_b) == 0:
                         return np.empty(0, dtype=np.int64)
-
-                    s_row = s_vs = s_vl = np.empty(0, dtype=np.int64)
+                    mlen = np.nonzero((s_nl == len(comp_b)) & ~seg_high)[0]
+                    out = mlen
+                    if mlen.size:
+                        idx = (
+                            (s_row * L + s_ss)[mlen][:, None]
+                            + np.arange(len(comp_b))
+                        )
+                        g = buf_flat[idx]
+                        upper = (g >= 0x41) & (g <= 0x5A)
+                        folded = np.where(upper, g | 0x20, g)
+                        target = np.frombuffer(comp_b, dtype=np.uint8)
+                        out = mlen[(folded == target).all(axis=1)]
+                    extra = [
+                        j
+                        for j in np.nonzero(seg_high)[0].tolist()
+                        if nb[non[j] : non[j + 1]]
+                        .decode("utf-8", "replace").lower() == comp
+                    ]
+                    if extra:
+                        out = np.concatenate(
+                            [out, np.asarray(extra, dtype=np.int64)]
+                        )
+                        out.sort()
+                    return out
 
                 match_cache: Dict[str, np.ndarray] = {}
                 attrs_cache: Dict[str, dict] = {}
@@ -1269,8 +1392,15 @@ class TpuBatchParser:
                         if p.comp != "*":
                             continue
                         tgt = overrides[fid]
-                        for i, d in dicts.items():
-                            tgt[i] = d
+                        if isinstance(tgt, _LazyWildcard):
+                            if vrows.size:
+                                tgt.add_chunk(
+                                    vrows, s_row, nb, non, vb, nov, seg_high
+                                )
+                            tgt.eager.update(dicts)
+                        else:  # pragma: no cover — defensive
+                            for i, d in dicts.items():
+                                tgt[i] = d
         return failed
 
     @staticmethod

@@ -89,3 +89,87 @@ def test_span_fast_path_edge_cases():
     assert col[1] is None          # '-' -> null
     assert col[2] is None          # invalid line
     assert col[3] == "a�b"    # non-UTF8 -> replacement char via fallback
+
+
+class TestWildcardMapFastPath:
+    """The flat-buffer MapArray construction must agree exactly with the
+    per-row dict path (duplicates, case, decode rows, oracle rows)."""
+
+    FMT = "common"
+    W = "STRING:request.firstline.uri.query.*"
+
+    def _result(self, uris):
+        from logparser_tpu.tpu.batch import TpuBatchParser
+
+        p = TpuBatchParser(self.FMT, [self.W])
+        lines = [
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET {u} HTTP/1.1" '
+            f"200 7"
+            for u in uris
+        ]
+        return p.parse_batch(lines)
+
+    def _assert_paths_agree(self, result, expect_fast):
+        import pyarrow as pa
+
+        ov = result._overrides[self.W]
+        fast = ov.to_arrow_map(result.lines_read)
+        assert (fast is not None) == expect_fast
+        table = result.to_arrow()
+        got = table.column(self.W).to_pylist()
+        want = [
+            None if v is None else list(v.items())
+            for v in result.to_pylist(self.W)
+        ]
+        assert got == want
+
+    def test_fast_path_simple(self):
+        r = self._result(["/x?a=1&b=2", "/plain", "/x?IMG=Up&c="])
+        self._assert_paths_agree(r, expect_fast=True)
+
+    def test_duplicate_names_fall_back(self):
+        r = self._result(["/x?dup=1&dup=2", "/x?a=1"])
+        self._assert_paths_agree(r, expect_fast=False)
+
+    def test_decode_rows_fall_back_per_row_only(self):
+        # %-decode rows are eager; the whole column takes the dict path.
+        r = self._result(["/x?v=%C3%A9", "/x?a=1"])
+        self._assert_paths_agree(r, expect_fast=False)
+
+    def test_oracle_rows_fall_back(self):
+        r = self._result(["/frag#x?y=1", "/x?a=1"])
+        self._assert_paths_agree(r, expect_fast=False)
+
+    def test_lazy_dicts_not_built_for_arrow(self):
+        r = self._result([f"/x?k{i}=v{i}&n{i}=m{i}" for i in range(16)])
+        ov = r._overrides[self.W]
+        r.to_arrow()
+        assert ov._dense is None  # Arrow path never materialized dicts
+        # ... and the dict contract still works afterwards.
+        assert r.to_pylist(self.W)[3] == {"k3": "v3", "n3": "m3"}
+
+    def test_case_insensitive_duplicates_fall_back(self):
+        # "A" and "a" fold to the same emitted key: the dict contract
+        # collapses them, so the flat path must bail.
+        r = self._result(["/x?A=1&a=2", "/x?b=1"])
+        self._assert_paths_agree(r, expect_fast=False)
+        assert r.to_pylist(self.W)[0] == {"a": "2"}
+
+    def test_popped_rows_stay_popped_across_groups(self):
+        # A row chunk-delivered by the query group but failed by the
+        # cookie group on the SAME line must read None everywhere.
+        from logparser_tpu.tpu.batch import TpuBatchParser
+
+        fmt = '%h %l %u %t "%r" %>s %b "%{Cookie}i"'
+        p = TpuBatchParser(fmt, [self.W, "HTTP.COOKIE:request.cookies.*"])
+        lines = [
+            '1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET /x?q=1 '
+            'HTTP/1.1" 200 5 "bad=%zz"',
+            '1.1.1.1 - - [07/Mar/2026:10:00:01 +0000] "GET /y?r=2 '
+            'HTTP/1.1" 200 5 "ok=1"',
+        ]
+        r = p.parse_batch(lines)
+        assert not r.valid[0] and r.valid[1]
+        assert r.to_pylist(self.W) == [None, {"r": "2"}]
+        arrow = r.to_arrow().column(self.W).to_pylist()
+        assert arrow == [None, [("r", "2")]]

@@ -1,0 +1,223 @@
+"""Full-vocabulary TimeStampDissector output tier.
+
+The reference locks every TIME.* output (local + _utc) against concrete
+values (TestTimeStampDissector.java, 612 LoC).  This tier goes one step
+further: expectations are computed INDEPENDENTLY from Python's datetime
+(offset arithmetic, ISO week fields), so a bug shared by the host engine
+and the device path — which differential tests cannot see — still fails.
+
+Covered: every output for timestamps across offsets (incl. cross-year UTC
+shifts and half-hour offsets), ISO week-year edges, month-abbreviation
+case-insensitivity, fractional seconds, the TIME.ZONE/TIME.TIMEZONE
+delivery quirk, and device-batch agreement for the derived outputs.
+"""
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from logparser_tpu.core.parser import Parser
+from logparser_tpu.dissectors.timestamp import TimeStampDissector
+from logparser_tpu.testing import DissectorTester
+
+
+class _Rec:
+    def __init__(self):
+        self.v = {}
+
+    def set_value(self, name, value):
+        self.v[name] = value
+
+
+def parse_all_outputs(value, pattern=None):
+    d = TimeStampDissector(pattern) if pattern else TimeStampDissector()
+    p = Parser(_Rec)
+    p.add_dissector(d)
+    p.set_root_type("TIME.STAMP")
+    p.add_parse_target("set_value", d.get_possible_output())
+    p.assemble_dissectors()
+    return p.parse(value, _Rec()).v
+
+
+_MONTHNAMES = [
+    "January", "February", "March", "April", "May", "June", "July",
+    "August", "September", "October", "November", "December",
+]
+
+
+def expected_outputs(local: datetime) -> dict:
+    """Ground-truth output map for a tz-aware datetime, straight from
+    datetime/isocalendar — independent of the engine under test."""
+    out = {}
+    for suffix, dt in (("", local), ("_utc", local.astimezone(timezone.utc))):
+        iso = dt.isocalendar()
+        micros = dt.microsecond
+        out.update({
+            f"TIME.YEAR:year{suffix}": str(dt.year),
+            f"TIME.MONTH:month{suffix}": str(dt.month),
+            f"TIME.MONTHNAME:monthname{suffix}": _MONTHNAMES[dt.month - 1],
+            f"TIME.DAY:day{suffix}": str(dt.day),
+            f"TIME.HOUR:hour{suffix}": str(dt.hour),
+            f"TIME.MINUTE:minute{suffix}": str(dt.minute),
+            f"TIME.SECOND:second{suffix}": str(dt.second),
+            f"TIME.MILLISECOND:millisecond{suffix}": str(micros // 1000),
+            f"TIME.MICROSECOND:microsecond{suffix}": str(micros),
+            f"TIME.NANOSECOND:nanosecond{suffix}": str(micros * 1000),
+            f"TIME.WEEK:weekofweekyear{suffix}": str(iso[1]),
+            f"TIME.YEAR:weekyear{suffix}": str(iso[0]),
+            f"TIME.DATE:date{suffix}": dt.strftime("%Y-%m-%d"),
+            f"TIME.TIME:time{suffix}": dt.strftime("%H:%M:%S"),
+        })
+    out["TIME.EPOCH:epoch"] = str(int(local.timestamp() * 1000))
+    return out
+
+
+APACHE_CASES = [
+    # (apache-format input, tz-aware ground-truth datetime)
+    ("31/Dec/2012:23:00:44 -0700",
+     datetime(2012, 12, 31, 23, 0, 44,
+              tzinfo=timezone(timedelta(hours=-7)))),
+    ("01/Jan/2000:00:00:00 +0000",
+     datetime(2000, 1, 1, tzinfo=timezone.utc)),
+    ("29/Feb/2016:12:30:59 +0530",        # leap day + half-hour offset
+     datetime(2016, 2, 29, 12, 30, 59,
+              tzinfo=timezone(timedelta(hours=5, minutes=30)))),
+    ("01/Jan/2016:06:00:00 +0000",        # ISO week 53 of weekyear 2015
+     datetime(2016, 1, 1, 6, tzinfo=timezone.utc)),
+    ("31/Dec/2018:10:00:00 +0000",        # ISO week 1 of weekyear 2019
+     datetime(2018, 12, 31, 10, tzinfo=timezone.utc)),
+    ("15/Jun/2026:23:59:59 +1400",        # extreme positive offset
+     datetime(2026, 6, 15, 23, 59, 59,
+              tzinfo=timezone(timedelta(hours=14)))),
+    ("01/Mar/1999:00:00:01 -1100",
+     datetime(1999, 3, 1, 0, 0, 1,
+              tzinfo=timezone(timedelta(hours=-11)))),
+]
+
+
+@pytest.mark.parametrize("value,local", APACHE_CASES,
+                         ids=[c[0] for c in APACHE_CASES])
+def test_every_output_against_datetime_ground_truth(value, local):
+    got = parse_all_outputs(value)
+    want = expected_outputs(local)
+    for field, expect in want.items():
+        assert got.get(field) == expect, (field, got.get(field), expect)
+    # The quirk: timezone is declared possible but never delivered.
+    assert "TIME.ZONE:timezone" not in got
+
+
+def test_timezone_quirk_declared_not_delivered():
+    d = TimeStampDissector()
+    assert "TIME.ZONE:timezone" in d.get_possible_output()
+    (DissectorTester.create()
+     .with_dissector(TimeStampDissector())
+     .with_input("31/Dec/2012:23:00:44 -0700")
+     .expect_possible("TIME.ZONE:timezone")
+     .expect_absent_string("TIME.ZONE:timezone")
+     .check_expectations())
+
+
+def test_month_abbreviation_case_insensitive():
+    expected = parse_all_outputs("30/Sep/2016:00:00:06 +0000")
+    for variant in ("sep", "SEP", "sEp", "SeP", "seP", "Sep"):
+        got = parse_all_outputs(f"30/{variant}/2016:00:00:06 +0000")
+        assert got == expected, variant
+
+
+def test_fractional_seconds_pattern():
+    got = parse_all_outputs(
+        "2016-02-29 12:30:59.123 +0000", "yyyy-MM-dd HH:mm:ss.SSS ZZ"
+    )
+    local = datetime(2016, 2, 29, 12, 30, 59, 123000, tzinfo=timezone.utc)
+    want = expected_outputs(local)
+    for field, expect in want.items():
+        assert got.get(field) == expect, (field, got.get(field), expect)
+    assert got["TIME.MILLISECOND:millisecond"] == "123"
+    assert got["TIME.EPOCH:epoch"] == str(int(local.timestamp() * 1000))
+
+
+def test_iso_week_boundaries():
+    # Jan 1 belonging to the previous ISO week-year and Dec 31 to the next.
+    jan = parse_all_outputs("01/Jan/2021:12:00:00 +0000")
+    assert jan["TIME.WEEK:weekofweekyear"] == "53"
+    assert jan["TIME.YEAR:weekyear"] == "2020"
+    assert jan["TIME.YEAR:year"] == "2021"
+    dec = parse_all_outputs("31/Dec/2019:12:00:00 +0000")
+    assert dec["TIME.WEEK:weekofweekyear"] == "1"
+    assert dec["TIME.YEAR:weekyear"] == "2020"
+    assert dec["TIME.YEAR:year"] == "2019"
+
+
+def test_long_casts_for_numeric_outputs():
+    (DissectorTester.create()
+     .with_dissector(TimeStampDissector())
+     .with_input("31/Dec/2012:23:00:44 -0700")
+     .expect("TIME.EPOCH:epoch", 1357020044000)
+     .expect("TIME.YEAR:year", 2012)
+     .expect("TIME.MONTH:month", 12)
+     .expect("TIME.DAY:day", 31)
+     .expect("TIME.HOUR:hour", 23)
+     .expect("TIME.MINUTE:minute", 0)
+     .expect("TIME.SECOND:second", 44)
+     .expect("TIME.YEAR:year_utc", 2013)
+     .expect("TIME.MONTH:month_utc", 1)
+     .expect("TIME.DAY:day_utc", 1)
+     .expect("TIME.HOUR:hour_utc", 6)
+     .check_expectations())
+
+
+def test_bad_timestamps_fail():
+    from logparser_tpu.core.exceptions import DissectionFailure
+
+    for bad in ("32/Dec/2012:23:00:44 -0700",   # day out of range
+                "31/Foo/2012:23:00:44 -0700",   # bad month name
+                "31/Dec/2012:24:00:44 -0700",   # hour 24
+                "31/Dec/2012:23:61:44 -0700",   # minute 61
+                "garbage"):
+        with pytest.raises(DissectionFailure):
+            parse_all_outputs(bad)
+
+
+DEVICE_TS_FIELDS = [
+    "TIME.EPOCH:request.receive.time.epoch",
+    "TIME.YEAR:request.receive.time.year",
+    "TIME.MONTH:request.receive.time.month",
+    "TIME.DAY:request.receive.time.day",
+    "TIME.HOUR:request.receive.time.hour",
+    "TIME.MINUTE:request.receive.time.minute",
+    "TIME.SECOND:request.receive.time.second",
+    "TIME.MONTHNAME:request.receive.time.monthname",
+    "TIME.DATE:request.receive.time.date",
+    "TIME.TIME:request.receive.time.time",
+    "TIME.YEAR:request.receive.time.year_utc",
+    "TIME.DAY:request.receive.time.day_utc",
+    "TIME.HOUR:request.receive.time.hour_utc",
+    "TIME.WEEK:request.receive.time.weekofweekyear",
+    "TIME.YEAR:request.receive.time.weekyear",
+]
+
+
+def test_device_batch_agrees_with_ground_truth():
+    """The SAME timestamps through the device batch path: every derived
+    output must equal the datetime ground truth (not merely the oracle)."""
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    parser = TpuBatchParser("common", DEVICE_TS_FIELDS)
+    lines = [
+        f'1.2.3.4 - - [{ts}] "GET /x HTTP/1.1" 200 5'
+        for ts, _ in APACHE_CASES
+    ]
+    result = parser.parse_batch(lines)
+    assert result.oracle_rows == 0
+    cols = {f: result.to_pylist(f) for f in DEVICE_TS_FIELDS}
+    for i, (_, local) in enumerate(APACHE_CASES):
+        want = expected_outputs(local)
+        for f in DEVICE_TS_FIELDS:
+            ftype, _, path = f.partition(":")
+            leaf = path.split("time.", 1)[1]
+            expect = want.get(f"{ftype}:{leaf}")
+            if expect is None:
+                expect = want[f"{ftype}:{leaf}"]
+            got = cols[f][i]
+            if isinstance(got, int):
+                expect = int(expect)
+            assert got == expect, (i, f, got, expect)
