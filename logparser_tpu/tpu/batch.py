@@ -203,48 +203,144 @@ class _LazyWildcard:
     def to_arrow_map(self, B: int):
         """pyarrow MapArray built straight from the flat buffers; None when
         this needs the exact dict path (multi-chunk/multi-format results,
-        individually-delivered rows, non-ASCII names whose str.lower()
-        differs from the byte fold, duplicate names within a row — the
-        dict contract collapses those)."""
-        if (
-            self._dense is not None or self.eager or self.dropped
-            or len(self.chunks) != 1
-        ):
+        non-ASCII names whose str.lower() differs from the byte fold,
+        duplicate names within a row — the dict contract collapses those).
+        Individually-delivered rows (``eager``: decode/repair/oracle rows)
+        and popped rows (``dropped``) are PATCHED into the flat
+        construction rather than disabling it — a single %-escaped value
+        in a big batch must not cost the whole column its fast path."""
+        if self._dense is not None or len(self.chunks) != 1:
             return None
+        if len(self.eager) > max(64, B // 32):
+            return None  # heavy fallback traffic: splicing stops paying
         import pyarrow as pa
 
         vrows, seg_row, nb, non, vb, nov, seg_high = self.chunks[0]
-        if bool(np.asarray(seg_high).any()):
-            return None
+        seg_row = np.asarray(seg_row, dtype=np.int64)
+        seg_high = np.asarray(seg_high, dtype=bool)
         n_seg = len(seg_row)
+        name_lens = np.diff(non)
+        val_lens = np.diff(nov)
         nb_np = np.frombuffer(nb, dtype=np.uint8)
+        vb_np = np.frombuffer(vb, dtype=np.uint8)
         upper = (nb_np >= 0x41) & (nb_np <= 0x5A)
         folded = np.where(upper, nb_np | 0x20, nb_np)
+
+        # Rows whose chunk segments must not be emitted: individually
+        # delivered (eager wins) or popped.  Filter BEFORE the bail-out
+        # checks so a shadowed row's segments (e.g. duplicate names on an
+        # oracle-overridden line) cannot cost the column its fast path.
+        shadow = set(self.dropped)
+        shadow.update(self.eager)
+        if shadow:
+            shadow_arr = np.fromiter(shadow, dtype=np.int64)
+            seg_keep = ~np.isin(seg_row, shadow_arr)
+            if not seg_keep.all():
+                byte_keep_n = np.repeat(seg_keep, name_lens)
+                byte_keep_v = np.repeat(seg_keep, val_lens)
+                folded = folded[byte_keep_n]
+                vb_np = vb_np[byte_keep_v]
+                seg_row = seg_row[seg_keep]
+                seg_high = seg_high[seg_keep]
+                name_lens = name_lens[seg_keep]
+                val_lens = val_lens[seg_keep]
+                n_seg = len(seg_row)
+
+        if bool(seg_high.any()):
+            return None
         if n_seg:
             # Duplicate-name detection by signature (row, len, sum, first,
             # last byte) over the FOLDED bytes — the emitted keys are
             # folded, so "A"/"a" must count as duplicates.  Any collision
             # — including a false positive — bails to the dict path,
             # which dedups exactly.
-            lens = np.diff(non)
-            sums = np.add.reduceat(folded.astype(np.int64), non[:-1])
+            off = np.zeros(n_seg + 1, dtype=np.int64)
+            np.cumsum(name_lens, out=off[1:])
+            sums = np.add.reduceat(folded.astype(np.int64), off[:-1])
             sig = np.stack([
-                np.asarray(seg_row, dtype=np.int64), lens, sums,
-                folded[non[:-1]].astype(np.int64),
-                folded[non[1:] - 1].astype(np.int64),
+                seg_row, name_lens, sums,
+                folded[off[:-1]].astype(np.int64),
+                folded[off[1:] - 1].astype(np.int64),
             ])
             if np.unique(sig, axis=1).shape[1] != n_seg:
                 return None
-        if int(non[-1]) > np.iinfo(np.int32).max or int(nov[-1]) > np.iinfo(
-            np.int32
-        ).max:
-            return None
+
         counts = np.zeros(B, dtype=np.int64)
         left = np.searchsorted(seg_row, vrows, side="left")
         right = np.searchsorted(seg_row, vrows, side="right")
         counts[vrows] = right - left
         covered = np.zeros(B, dtype=bool)
         covered[vrows] = True
+        for i in self.dropped:
+            if 0 <= i < B:
+                covered[i] = False
+                counts[i] = 0
+
+        # Splice the eager rows' items into row order (few rows: python
+        # per ROW, still vectorized per segment everywhere else).
+        if self.eager:
+            cut_bytes_n = cut_bytes_v = cut_seg = 0
+            inserts = []
+            for i in sorted(self.eager):
+                if not (0 <= i < B):
+                    continue
+                d = self.eager[i]
+                if d is None:
+                    covered[i] = False
+                    counts[i] = 0
+                    continue
+                covered[i] = True
+                counts[i] = len(d)
+                keys_b = [str(k).encode("utf-8") for k in d.keys()]
+                vals_b = [str(v).encode("utf-8") for v in d.values()]
+                inserts.append((i, keys_b, vals_b))
+            if inserts:
+                nb_off = np.zeros(len(name_lens) + 1, dtype=np.int64)
+                np.cumsum(name_lens, out=nb_off[1:])
+                vb_off = np.zeros(len(val_lens) + 1, dtype=np.int64)
+                np.cumsum(val_lens, out=vb_off[1:])
+                name_pieces, val_pieces = [], []
+                len_pieces_n, len_pieces_v = [], []
+                for i, keys_b, vals_b in inserts:
+                    at = int(np.searchsorted(seg_row, i, side="left"))
+                    name_pieces.append(folded[cut_bytes_n:int(nb_off[at])])
+                    val_pieces.append(vb_np[cut_bytes_v:int(vb_off[at])])
+                    len_pieces_n.append(name_lens[cut_seg:at])
+                    len_pieces_v.append(val_lens[cut_seg:at])
+                    if keys_b:
+                        name_pieces.append(
+                            np.frombuffer(b"".join(keys_b), dtype=np.uint8)
+                        )
+                        val_pieces.append(
+                            np.frombuffer(b"".join(vals_b), dtype=np.uint8)
+                        )
+                        len_pieces_n.append(
+                            np.array([len(k) for k in keys_b], dtype=np.int64)
+                        )
+                        len_pieces_v.append(
+                            np.array([len(v) for v in vals_b], dtype=np.int64)
+                        )
+                    cut_bytes_n, cut_bytes_v, cut_seg = (
+                        int(nb_off[at]), int(vb_off[at]), at
+                    )
+                name_pieces.append(folded[cut_bytes_n:])
+                val_pieces.append(vb_np[cut_bytes_v:])
+                len_pieces_n.append(name_lens[cut_seg:])
+                len_pieces_v.append(val_lens[cut_seg:])
+                folded = np.concatenate(name_pieces)
+                vb_np = np.concatenate(val_pieces)
+                name_lens = np.concatenate(len_pieces_n)
+                val_lens = np.concatenate(len_pieces_v)
+                n_seg = len(name_lens)
+
+        non32 = np.zeros(n_seg + 1, dtype=np.int64)
+        np.cumsum(name_lens, out=non32[1:])
+        nov32 = np.zeros(n_seg + 1, dtype=np.int64)
+        np.cumsum(val_lens, out=nov32[1:])
+        if int(non32[-1]) > np.iinfo(np.int32).max or int(
+            nov32[-1]
+        ) > np.iinfo(np.int32).max:
+            return None
         offsets64 = np.zeros(B + 1, dtype=np.int64)
         np.cumsum(counts, out=offsets64[1:])
         offsets = offsets64.astype(np.int32)
@@ -252,13 +348,13 @@ class _LazyWildcard:
         try:
             keys = pa.StringArray.from_buffers(
                 n_seg,
-                pa.py_buffer(non.astype(np.int32)),
+                pa.py_buffer(non32.astype(np.int32)),
                 pa.py_buffer(np.ascontiguousarray(folded)),
             )
             items = pa.StringArray.from_buffers(
                 n_seg,
-                pa.py_buffer(nov.astype(np.int32)),
-                pa.py_buffer(np.frombuffer(vb, dtype=np.uint8)),
+                pa.py_buffer(nov32.astype(np.int32)),
+                pa.py_buffer(np.ascontiguousarray(vb_np)),
             )
             arr = pa.MapArray.from_arrays(
                 pa.array(offsets, type=pa.int32(), mask=mask), keys, items

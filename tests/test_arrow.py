@@ -131,14 +131,28 @@ class TestWildcardMapFastPath:
         r = self._result(["/x?dup=1&dup=2", "/x?a=1"])
         self._assert_paths_agree(r, expect_fast=False)
 
-    def test_decode_rows_fall_back_per_row_only(self):
-        # %-decode rows are eager; the whole column takes the dict path.
-        r = self._result(["/x?v=%C3%A9", "/x?a=1"])
-        self._assert_paths_agree(r, expect_fast=False)
+    def test_decode_rows_spliced_into_fast_path(self):
+        # %-decode rows are eager; they splice into the flat construction
+        # instead of disabling the fast path for the whole column.
+        r = self._result(["/x?v=%C3%A9", "/x?a=1", "/y?b=2&c=3"])
+        self._assert_paths_agree(r, expect_fast=True)
 
-    def test_oracle_rows_fall_back(self):
+    def test_oracle_rows_spliced_into_fast_path(self):
         r = self._result(["/frag#x?y=1", "/x?a=1"])
-        self._assert_paths_agree(r, expect_fast=False)
+        self._assert_paths_agree(r, expect_fast=True)
+
+    def test_eager_splice_positions(self):
+        # Eager rows at the batch edges and midstream, multiple params.
+        r = self._result([
+            "/a?p=%41&q=2",      # eager (decode) first row
+            "/b?x=1",
+            "/c?y=%42",          # eager midstream
+            "/d?z=3&w=4",
+            "/e?last=%43",       # eager last row
+        ])
+        self._assert_paths_agree(r, expect_fast=True)
+        assert r.to_pylist(self.W)[0] == {"p": "A", "q": "2"}
+        assert r.to_pylist(self.W)[4] == {"last": "C"}
 
     def test_lazy_dicts_not_built_for_arrow(self):
         r = self._result([f"/x?k{i}=v{i}&n{i}=m{i}" for i in range(16)])
@@ -173,3 +187,24 @@ class TestWildcardMapFastPath:
         assert r.to_pylist(self.W) == [None, {"r": "2"}]
         arrow = r.to_arrow().column(self.W).to_pylist()
         assert arrow == [None, [("r", "2")]]
+
+    def test_shadowed_dup_segments_keep_fast_path(self):
+        # A line with duplicate query names that ALSO fails the cookie
+        # group (popped row): its segments are shadowed before the
+        # duplicate check, so the column keeps the fast path.
+        from logparser_tpu.tpu.batch import TpuBatchParser
+
+        fmt = '%h %l %u %t "%r" %>s %b "%{Cookie}i"'
+        p = TpuBatchParser(fmt, [self.W, "HTTP.COOKIE:request.cookies.*"])
+        lines = [
+            '1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET /x?dup=1&dup=2 '
+            'HTTP/1.1" 200 5 "bad=%zz"',
+            '1.1.1.1 - - [07/Mar/2026:10:00:01 +0000] "GET /y?r=2 '
+            'HTTP/1.1" 200 5 "ok=1"',
+        ]
+        r = p.parse_batch(lines)
+        ov = r._overrides[self.W]
+        fast = ov.to_arrow_map(r.lines_read)
+        assert fast is not None
+        got = r.to_arrow().column(self.W).to_pylist()
+        assert got == [None, [("r", "2")]]

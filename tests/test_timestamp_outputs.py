@@ -214,9 +214,7 @@ def test_device_batch_agrees_with_ground_truth():
         for f in DEVICE_TS_FIELDS:
             ftype, _, path = f.partition(":")
             leaf = path.split("time.", 1)[1]
-            expect = want.get(f"{ftype}:{leaf}")
-            if expect is None:
-                expect = want[f"{ftype}:{leaf}"]
+            expect = want[f"{ftype}:{leaf}"]
             got = cols[f][i]
             if isinstance(got, int):
                 expect = int(expect)

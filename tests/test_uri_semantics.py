@@ -1,0 +1,109 @@
+"""URI repair-chain semantics locked to hand-derived expectations.
+
+The differential suites prove device == oracle; this tier locks the
+ORACLE itself to concrete values derived by hand from the documented
+repair chain (dissectors/uri.py: encode bad chars -> ?/& normalization ->
+%-repair x2 -> HTML-entity repair/unescape -> =#/#& fixes -> multi-#
+collapse -> JavaUri split), so a regression shared by both paths still
+fails.  Each expectation's derivation is noted inline.
+"""
+import pytest
+
+from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+PREFIX = "request.firstline.uri"
+FIELDS = [
+    f"HTTP.PATH:{PREFIX}.path",
+    f"HTTP.QUERYSTRING:{PREFIX}.query",
+    f"HTTP.REF:{PREFIX}.ref",
+    f"HTTP.HOST:{PREFIX}.host",
+    f"HTTP.PORT:{PREFIX}.port",
+    f"HTTP.PROTOCOL:{PREFIX}.protocol",
+    f"HTTP.USERINFO:{PREFIX}.userinfo",
+]
+
+# (uri, {leaf: value}) — unlisted leaves must be absent/None.
+CASES = [
+    # ?->& then first &->?& : the raw query keeps a leading '&'.
+    ("/a/b.html?x=1&y=2", {"path": "/a/b.html", "query": "&x=1&y=2"}),
+    # Later '?' separators normalize to '&'.
+    ("/x?a=1?b=2", {"path": "/x", "query": "&a=1&b=2"}),
+    # Absolute URL: scheme/userinfo/host/port split; fragment delivered.
+    ("http://u:p@h.com:8080/p?q=1#f",
+     {"path": "/p", "query": "&q=1", "ref": "f", "protocol": "http",
+      "userinfo": "u:p", "host": "h.com", "port": 8080}),
+    # HTML4 entity unescaped AFTER the ?& normalization.
+    ("/x?a=&lt;b", {"path": "/x", "query": "&a=<b"}),
+    # '=#' artifact collapses to '='.
+    ("/x?a=#b", {"path": "/x", "query": "&a=b"}),
+    # Bad escape %zz -> %25zz; path percent-decode restores the original.
+    ("/x%zzy", {"path": "/x%zzy", "query": ""}),
+    # Space is %-encoded then percent-decoded back in the path.
+    ("/a b", {"path": "/a b", "query": ""}),
+    # Multiple '#': all but the last collapse to '~'.
+    ("/x#a#b", {"path": "/x~a", "query": "", "ref": "b"}),
+    # Non-standard %uXXXX: the '%' is repaired to %25 in the RAW query
+    # (param-level decode is a different stage).
+    ("/x?a=%u0041bc", {"path": "/x", "query": "&a=%25u0041bc"}),
+    # Well-formed escapes in the path are decoded.
+    ("/deep%2Fpath", {"path": "/deep/path", "query": ""}),
+    # '#&' artifact collapses to '&' (fragment disappears).
+    ("/x?a=1#&b=2", {"path": "/x", "query": "&a=1&b=2"}),
+    # Registry-based authority (underscore host): null host, path kept.
+    ("http://my_host/x", {"path": "/x", "query": "", "protocol": "http"}),
+    # Empty-port colon: host keeps, port absent.
+    ("http://h.com:/x",
+     {"path": "/x", "query": "", "protocol": "http", "host": "h.com"}),
+    # Scheme-less bare URL: everything is path (no authority possible).
+    ("example.com/no/scheme?y=2",
+     {"path": "example.com/no/scheme", "query": "&y=2"}),
+    # Query-only absolute URL: empty path string (authority present).
+    ("http://h.com?q=1",
+     {"path": "", "query": "&q=1", "protocol": "http", "host": "h.com"}),
+    # Almost-HTML-encoded entity: '#x41;' gains the missing '&' and
+    # unescapes to 'A'.
+    ("/e#x41;nd", {"path": "/eAnd", "query": ""}),
+]
+
+
+@pytest.fixture(scope="module")
+def parser():
+    return TpuBatchParser("common", FIELDS)
+
+
+@pytest.mark.parametrize("uri,expected", CASES, ids=[c[0] for c in CASES])
+def test_oracle_matches_hand_derived(parser, uri, expected):
+    line = f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET {uri} HTTP/1.1" 200 5'
+    rec = parser.oracle.parse(line, _CollectingRecord())
+    got = {
+        k.rpartition(".")[2]: v
+        for k, v in rec.values.items()
+        if k.partition(":")[2].startswith(PREFIX + ".")
+    }
+    for leaf, want in expected.items():
+        value = got.get(leaf)
+        if isinstance(want, int) and value is not None:
+            value = int(value)
+        assert value == want, (uri, leaf, value, want)
+    for leaf in ("path", "query", "ref", "host", "port", "protocol",
+                 "userinfo"):
+        if leaf not in expected:
+            assert got.get(leaf) is None, (uri, leaf, got.get(leaf))
+
+
+def test_device_batch_matches_hand_derived(parser):
+    lines = [
+        f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET {u} HTTP/1.1" 200 5'
+        for u, _ in CASES
+    ]
+    result = parser.parse_batch(lines)
+    cols = {f: result.to_pylist(f) for f in FIELDS}
+    for i, (uri, expected) in enumerate(CASES):
+        assert result.valid[i], uri
+        for f in FIELDS:
+            leaf = f.rpartition(".")[2]
+            want = expected.get(leaf)
+            got = cols[f][i]
+            if isinstance(want, int) and got is not None:
+                got = int(got)
+            assert got == want, (uri, leaf, got, want)
