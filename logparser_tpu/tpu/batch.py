@@ -248,19 +248,24 @@ class _LazyWildcard:
 
         if bool(seg_high.any()):
             return None
+        # One shared pair of cumulative offsets over the filtered segment
+        # lens (used by the duplicate check, the eager splice, and — when
+        # no splice mutates the lens — the final StringArray offsets).
+        nb_off = np.zeros(n_seg + 1, dtype=np.int64)
+        np.cumsum(name_lens, out=nb_off[1:])
+        vb_off = np.zeros(n_seg + 1, dtype=np.int64)
+        np.cumsum(val_lens, out=vb_off[1:])
         if n_seg:
             # Duplicate-name detection by signature (row, len, sum, first,
             # last byte) over the FOLDED bytes — the emitted keys are
             # folded, so "A"/"a" must count as duplicates.  Any collision
             # — including a false positive — bails to the dict path,
             # which dedups exactly.
-            off = np.zeros(n_seg + 1, dtype=np.int64)
-            np.cumsum(name_lens, out=off[1:])
-            sums = np.add.reduceat(folded.astype(np.int64), off[:-1])
+            sums = np.add.reduceat(folded.astype(np.int64), nb_off[:-1])
             sig = np.stack([
                 seg_row, name_lens, sums,
-                folded[off[:-1]].astype(np.int64),
-                folded[off[1:] - 1].astype(np.int64),
+                folded[nb_off[:-1]].astype(np.int64),
+                folded[nb_off[1:] - 1].astype(np.int64),
             ])
             if np.unique(sig, axis=1).shape[1] != n_seg:
                 return None
@@ -278,11 +283,14 @@ class _LazyWildcard:
 
         # Splice the eager rows' items into row order (few rows: python
         # per ROW, still vectorized per segment everywhere else).
+        spliced = False
         if self.eager:
             cut_bytes_n = cut_bytes_v = cut_seg = 0
             inserts = []
             for i in sorted(self.eager):
-                if not (0 <= i < B):
+                if not (0 <= i < B) or i in self.dropped:
+                    # Dropped wins over eager — matching _materialize's
+                    # update-then-pop order.
                     continue
                 d = self.eager[i]
                 if d is None:
@@ -295,10 +303,7 @@ class _LazyWildcard:
                 vals_b = [str(v).encode("utf-8") for v in d.values()]
                 inserts.append((i, keys_b, vals_b))
             if inserts:
-                nb_off = np.zeros(len(name_lens) + 1, dtype=np.int64)
-                np.cumsum(name_lens, out=nb_off[1:])
-                vb_off = np.zeros(len(val_lens) + 1, dtype=np.int64)
-                np.cumsum(val_lens, out=vb_off[1:])
+                spliced = True
                 name_pieces, val_pieces = [], []
                 len_pieces_n, len_pieces_v = [], []
                 for i, keys_b, vals_b in inserts:
@@ -333,10 +338,13 @@ class _LazyWildcard:
                 val_lens = np.concatenate(len_pieces_v)
                 n_seg = len(name_lens)
 
-        non32 = np.zeros(n_seg + 1, dtype=np.int64)
-        np.cumsum(name_lens, out=non32[1:])
-        nov32 = np.zeros(n_seg + 1, dtype=np.int64)
-        np.cumsum(val_lens, out=nov32[1:])
+        if spliced:  # the splice changed the lens: recompute offsets
+            non32 = np.zeros(n_seg + 1, dtype=np.int64)
+            np.cumsum(name_lens, out=non32[1:])
+            nov32 = np.zeros(n_seg + 1, dtype=np.int64)
+            np.cumsum(val_lens, out=nov32[1:])
+        else:
+            non32, nov32 = nb_off, vb_off
         if int(non32[-1]) > np.iinfo(np.int32).max or int(
             nov32[-1]
         ) > np.iinfo(np.int32).max:

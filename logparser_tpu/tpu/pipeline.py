@@ -515,6 +515,13 @@ def compute_rows(
     line_constraints: List[jnp.ndarray] = []
     csr_overflow_rows: List[jnp.ndarray] = []
     false_b = jnp.zeros(B, dtype=bool)
+    # Authority reductions (userinfo/host/port) only run when some plan
+    # actually delivers those parts — path/query-only workloads skip them.
+    need_authority = any(
+        ("uri", part) in plan.steps
+        for plan in plans
+        for part in ("host", "userinfo", "port")
+    )
 
     def clf_dash(s, e):
         """Token-level CLF null: the span is a lone '-'
@@ -548,7 +555,8 @@ def compute_rows(
                 # take '-' literally, like the host.
                 dash = clf_dash(s, e) if len(cache_key) == 1 else None
                 uri = postproc.split_uri_fast(
-                    b32, s, e, extract=extract, shift_fn=shift_fn, dash=dash
+                    b32, s, e, extract=extract, shift_fn=shift_fn, dash=dash,
+                    need_authority=need_authority,
                 )
                 uri_cache[cache_key] = uri
                 # Repair-needing URIs fail the line (unless the chain

@@ -180,6 +180,7 @@ def split_uri_fast(
     extract=None,
     shift_fn=None,
     dash=None,
+    need_authority: bool = True,
 ) -> Dict[str, jnp.ndarray]:
     """Fast-path URI split: repair-free URIs -> sub-spans on device.
 
@@ -319,45 +320,64 @@ def split_uri_fast(
         jnp.where(is_slash & (pos >= auth_start[:, None]), pos, L), axis=1
     ).astype(jnp.int32)
     auth_end = jnp.minimum(jnp.minimum(slash_a, first_sep), end)
-    in_auth = (pos >= auth_start[:, None]) & (pos < auth_end[:, None])
-    at = jnp.max(
-        jnp.where((buf == np.uint8(ord("@"))) & in_auth, pos, -1), axis=1
-    ).astype(jnp.int32)
-    has_at = at >= 0
-    rest_start = jnp.where(has_at, at + 1, auth_start)
-    colon2 = jnp.max(
-        jnp.where(is_colon & (pos >= rest_start[:, None]) & (pos < auth_end[:, None]),
-                  pos, -1),
-        axis=1,
-    ).astype(jnp.int32)
-    has_pcolon = colon2 >= 0
-    port_start = colon2 + 1
-    port_len = auth_end - port_start
-    port_empty = port_len <= 0
-    in_port = has_pcolon[:, None] & (pos >= port_start[:, None]) & (
-        pos < auth_end[:, None]
-    )
-    port_digits = jnp.all(is_digit | ~in_port, axis=1)
-    host_end = jnp.where(
-        has_pcolon & (port_empty | port_digits), colon2, auth_end
-    )
-    in_host = (pos >= rest_start[:, None]) & (pos < host_end[:, None])
-    host_cs = (
-        is_alpha | is_digit
-        | (buf == np.uint8(ord(".")))
-        | (buf == np.uint8(ord("-")))
-    )
-    host_ok_cs = jnp.all(host_cs | ~in_host, axis=1)
-    registry = (~host_ok_cs) | (has_pcolon & ~port_empty & ~port_digits)
+    if need_authority:
+        in_auth = (pos >= auth_start[:, None]) & (pos < auth_end[:, None])
+        at = jnp.max(
+            jnp.where((buf == np.uint8(ord("@"))) & in_auth, pos, -1), axis=1
+        ).astype(jnp.int32)
+        has_at = at >= 0
+        rest_start = jnp.where(has_at, at + 1, auth_start)
+        colon2 = jnp.max(
+            jnp.where(
+                is_colon & (pos >= rest_start[:, None])
+                & (pos < auth_end[:, None]),
+                pos, -1,
+            ),
+            axis=1,
+        ).astype(jnp.int32)
+        has_pcolon = colon2 >= 0
+        port_start = colon2 + 1
+        port_len = auth_end - port_start
+        port_empty = port_len <= 0
+        in_port = has_pcolon[:, None] & (pos >= port_start[:, None]) & (
+            pos < auth_end[:, None]
+        )
+        port_digits = jnp.all(is_digit | ~in_port, axis=1)
+        host_end = jnp.where(
+            has_pcolon & (port_empty | port_digits), colon2, auth_end
+        )
+        in_host = (pos >= rest_start[:, None]) & (pos < host_end[:, None])
+        host_cs = (
+            is_alpha | is_digit
+            | (buf == np.uint8(ord(".")))
+            | (buf == np.uint8(ord("-")))
+        )
+        host_ok_cs = jnp.all(host_cs | ~in_host, axis=1)
+        registry = (~host_ok_cs) | (has_pcolon & ~port_empty & ~port_digits)
 
-    # IPv6 '[...]' literals need no dedicated guard: '[' is in the encode
-    # bad-set, so such spans already fail `clean` and take the oracle.
-    pct_pre = jnp.any(is_pct & (pos < auth_end[:, None]), axis=1)
-    abs_ok = (
-        has_scheme & scheme_ok & dslash
-        & ~pct_pre
-        & ~(has_pcolon & (port_len > MAX_LONG_DIGITS))
-    )
+        # IPv6 '[...]' literals need no dedicated guard: '[' is in the
+        # encode bad-set, so such spans already fail `clean` and take the
+        # oracle.
+        pct_pre = jnp.any(is_pct & (pos < auth_end[:, None]), axis=1)
+        abs_ok = (
+            has_scheme & scheme_ok & dslash
+            & ~pct_pre
+            & ~(has_pcolon & (port_len > MAX_LONG_DIGITS))
+        )
+    else:
+        # Authority details (userinfo/host/port) are not requested: skip
+        # their reductions.  Correct for path/query/protocol/ref because
+        # the repair chain's %-insertions in the authority cannot change
+        # the path/query SPAN CONTENTS (only shift the repaired copy), a
+        # >18-digit port affects only the port parse, and registry-vs-
+        # server validation affects only the authority outputs.
+        false_v = jnp.zeros(B, dtype=bool)
+        zero_v = jnp.zeros(B, dtype=jnp.int32)
+        has_at = false_v
+        at = rest_start = host_end = port_start = zero_v
+        has_pcolon = port_empty = false_v
+        registry = jnp.ones(B, dtype=bool)  # never deliver authority parts
+        abs_ok = has_scheme & scheme_ok & dslash
     is_abs = has_scheme & abs_ok & ~all_null
     # Scheme-less, not starting with '/': no authority possible — the whole
     # head is path (protocol/userinfo/host/port null).

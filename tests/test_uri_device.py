@@ -150,6 +150,67 @@ class TestDeviceUriSplit:
         assert result.oracle_rows == 0
         assert list(result.valid) == [True, True, True]
 
+    def test_absolute_urls_path_query_only(self):
+        # The need_authority=False branch: path/query-only requests skip
+        # the authority reductions AND keep more rows on device (bad
+        # escapes in the authority, >18-digit ports).  Differential vs
+        # the oracle over the same hostile pool.
+        fields = [
+            "HTTP.PATH:request.firstline.uri.path",
+            "HTTP.QUERYSTRING:request.firstline.uri.query",
+        ]
+        parser = TpuBatchParser("common", fields)
+        lines = make_lines(self.ABSOLUTE)
+        result = parser.parse_batch(lines)
+        cols = {f: result.to_pylist(f) for f in fields}
+        for i, line in enumerate(lines):
+            try:
+                rec = parser.oracle.parse(line, _CollectingRecord())
+                expected, ok = rec.values, True
+            except Exception:
+                expected, ok = {}, False
+            assert bool(result.valid[i]) == ok, (i, self.ABSOLUTE[i])
+            if not ok:
+                continue
+            for f in fields:
+                assert cols[f][i] == expected.get(f), (i, self.ABSOLUTE[i], f)
+        # Authority-only hazards must stay device-resident here.
+        idx_pct = self.ABSOLUTE.index("http://enc%41oded.host/x")
+        idx_port = self.ABSOLUTE.index("http://host:123456789012345678901/x")
+        assert result.format_index[idx_pct] >= 0
+        assert result.format_index[idx_port] >= 0
+
+    def test_fuzzed_path_query_only(self):
+        rng = random.Random(911)
+        fields = [
+            "HTTP.PATH:request.firstline.uri.path",
+            "HTTP.QUERYSTRING:request.firstline.uri.query",
+        ]
+        heads = ["http", "https", "1bad", ""]
+        hosts = ["h.com", "my_host", "x%41y", "a@b", "h:99", "h:9999999999999999999"]
+        paths = ["", "/", "/p%20q", "/a?b=c&d=e", "?bare=q", "/a:b"]
+        uris = []
+        for _ in range(200):
+            uris.append(
+                rng.choice(heads) + "://" + rng.choice(hosts)
+                + rng.choice(paths)
+            )
+        parser = TpuBatchParser("common", fields)
+        lines = make_lines(uris)
+        result = parser.parse_batch(lines)
+        cols = {f: result.to_pylist(f) for f in fields}
+        for i, line in enumerate(lines):
+            try:
+                rec = parser.oracle.parse(line, _CollectingRecord())
+                expected, ok = rec.values, True
+            except Exception:
+                expected, ok = {}, False
+            assert bool(result.valid[i]) == ok, (i, uris[i])
+            if not ok:
+                continue
+            for f in fields:
+                assert cols[f][i] == expected.get(f), (i, uris[i], f)
+
     def test_absolute_urls_stay_on_device(self):
         uris = [
             "http://example.com/x?q=1",
