@@ -154,6 +154,7 @@ class Parser:
         # Line-invariant add_dissection routing decisions, keyed by
         # (base, type, name); reset whenever the parser (re)assembles.
         self.dissection_memo: Dict[tuple, tuple] = {}
+        self._store_plans: Dict[Any, Any] = {}
 
         if record_class is not None:
             for name in dir(record_class):
@@ -326,6 +327,7 @@ class Parser:
         if self.root_type is None:
             raise InvalidDissectorException("No root type was set")
         self.dissection_memo = {}  # targets may have changed since last run
+        self._store_plans = {}
 
         # Fixpoint: dissectors may register additional dissectors recursively.
         done: Set[int] = set()
@@ -545,19 +547,23 @@ class Parser:
     # store (setter dispatch)
     # ------------------------------------------------------------------
 
-    def store(self, record: Any, key: str, name: str, value: Value) -> None:
-        called_a_setter = False
+    def _build_store_plan(self, key: str, name: str):
+        """Resolve the per-delivery dispatch for one target key ONCE:
+        AUTO value types and cast-membership checks are line-invariant, so
+        the hot `store` loop reduces to value conversion + policy check +
+        the setter call.  Returns (resolved_specs, casts_to) or None after
+        logging (unknown key / no casts — matching the uncached errors)."""
         specs = self.targets.get(key)
         if specs is None:
             LOG.error("NO methods for key=%s name=%s", key, name)
-            return
+            return None
         casts_to = self.casts_of_targets.get(key)
         if casts_to is None:
             casts_to = self.casts_of_targets.get(name)
             if casts_to is None:
                 LOG.error('NO casts for "%s"', name)
-                return
-
+                return None
+        resolved = []
         for spec in specs:
             vtype = spec.value_type
             if vtype == "AUTO":
@@ -569,44 +575,67 @@ class Parser:
                     vtype = "DOUBLE"
                 else:
                     continue
+            if vtype == "STRING" and Cast.STRING not in casts_to:
+                continue
+            if vtype == "LONG" and Cast.LONG not in casts_to:
+                continue
+            if vtype == "DOUBLE" and Cast.DOUBLE not in casts_to:
+                continue
+            resolved.append((
+                spec.method_name,
+                spec.arg_count,
+                vtype,
+                spec.policy is not SetterPolicy.ALWAYS,     # skip None
+                spec.policy is SetterPolicy.NOT_EMPTY,
+            ))
+        return tuple(resolved), casts_to
 
+    def store(self, record: Any, key: str, name: str, value: Value) -> None:
+        # The dispatch plan is line-invariant per key; wildcard keys fall
+        # back to per-name casts, so those cache under (key, name).
+        plans = self._store_plans
+        plan = plans.get(key)
+        if plan is None:
+            cache_key: Any = key
+            if key not in self.casts_of_targets:
+                cache_key = (key, name)
+                plan = plans.get(cache_key)
+            if plan is None:
+                plan = self._build_store_plan(key, name)
+                if plan is None:
+                    return
+                plans[cache_key] = plan
+        resolved, casts_to = plan
+
+        called_a_setter = False
+        for method_name, arg_count, vtype, skip_null, not_empty in resolved:
             if vtype == "STRING":
-                if Cast.STRING not in casts_to:
-                    continue
                 out: Any = value.get_string()
             elif vtype == "LONG":
-                if Cast.LONG not in casts_to:
-                    continue
                 out = value.get_long()
             else:
-                if Cast.DOUBLE not in casts_to:
-                    continue
                 out = value.get_double()
 
-            if out is None and spec.policy in (SetterPolicy.NOT_NULL, SetterPolicy.NOT_EMPTY):
+            if out is None and skip_null:
                 called_a_setter = True
                 continue
-            if (
-                vtype == "STRING"
-                and out == ""
-                and spec.policy == SetterPolicy.NOT_EMPTY
-            ):
+            if not_empty and vtype == "STRING" and out == "":
                 called_a_setter = True
                 continue
 
-            method = getattr(record, spec.method_name, None)
+            method = getattr(record, method_name, None)
             if method is None:
                 raise FatalErrorDuringCallOfSetterMethod(
-                    f"Record {type(record).__name__} has no method {spec.method_name!r}"
+                    f"Record {type(record).__name__} has no method {method_name!r}"
                 )
             try:
-                if spec.arg_count == 2:
+                if arg_count == 2:
                     method(name, out)
                 else:
                     method(out)
             except Exception as e:  # noqa: BLE001 — mirror FatalError wrapping
                 raise FatalErrorDuringCallOfSetterMethod(
-                    f'{e} when calling "{spec.method_name}" for key="{key}" '
+                    f'{e} when calling "{method_name}" for key="{key}" '
                     f'name="{name}" value="{value}" casts_to="{casts_to}"'
                 ) from e
             called_a_setter = True

@@ -56,11 +56,6 @@ TOKEN_POOL = [
      lambda rng: str(rng.randint(1, 99999))),
     ("%A", ["IP:connection.server.ip"],
      lambda rng: f"10.0.{rng.randint(0, 255)}.{rng.randint(1, 254)}"),
-    ('"%{Referer}i"', ["HTTP.URI:request.referer"],
-     lambda rng: rng.choice([
-         '"-"', '"http://example.com/"', '"https://a.b/c?d=e#f"',
-         '"http://x.y/p q"',
-     ])),
     ('"%{User-Agent}i"', ["HTTP.USERAGENT:request.user-agent"],
      lambda rng: rng.choice([
          '"-"', '"Mozilla/5.0 (X11; Linux) Gecko/2010"', '"curl/8.0.1"',
@@ -93,6 +88,41 @@ TOKEN_POOL = [
      lambda rng: rng.choice(["GET", "POST", "DELETE", "PATCH"])),
     ('"%q"', ["HTTP.QUERYSTRING:request.querystring"],
      lambda rng: rng.choice(['""', '"?a=1"', '"?x=%20y&b"', '"?broken=%zz"'])),
+    # Round-2 device surfaces: Set-Cookie CSR (wildcard + per-cookie
+    # attrs incl. the expires-comma rejoin), absolute-URL referer
+    # sub-fields (authority parsing), query wildcard + adaptive slots.
+    ('"%{Set-Cookie}o"',
+     ["HTTP.SETCOOKIE:response.cookies.*",
+      "HTTP.SETCOOKIE:response.cookies.sid",
+      "STRING:response.cookies.sid.value",
+      "TIME.EPOCH:response.cookies.sid.expires",
+      "STRING:response.cookies.sid.path"],
+     lambda rng: '"%s"' % rng.choice([
+         "-", "sid=abc; path=/", "sid=1, t=2",
+         "sid=x; expires=Thu, 01-Jan-2027 00:00:00 GMT; path=/p, u=9",
+         "sid=y; Expires=Ignored, 02-Jan-2027 00:00:00 GMT",
+         "a=1; max-age=60, sid=z; domain=d.io",
+         "sid=1; expires=Thu, ",          # held trailing part: dropped
+         " sid = pad ; path= /x ",        # edge-trim slow path
+         "set-cookie: sid=5",             # prefix quirk -> oracle
+         ", ".join(f"c{i}={i}" for i in range(19)),  # adaptive slots
+     ])),
+    ('"%{Referer}i"',
+     ["HTTP.URI:request.referer",
+      "HTTP.HOST:request.referer.host",
+      "HTTP.PORT:request.referer.port",
+      "HTTP.PROTOCOL:request.referer.protocol",
+      "HTTP.PATH:request.referer.path",
+      "STRING:request.referer.query.*"],
+     lambda rng: '"%s"' % rng.choice([
+         "-", "http://example.com/", "https://u:p@h.io:8443/c?i=3&r=a",
+         "http://my_host/reg", "HTTP://UP.CASE/k", "example.com/bare",
+         "mailto:a@b.c", "http://[::1]/v6", "ftp://f.io:2121/f",
+         "http://h.com?only=query", "/relative/ref?z=1",
+         "http://x.y/p q",              # space: encode-repair oracle route
+         "https://a.b/c?d=e#f",         # fragment through the header URI
+         "http://h.com/" + "&".join(f"q{i}={i}" for i in range(18)),
+     ])),
 ]
 
 N_FORMATS = 10
@@ -121,9 +151,20 @@ def assert_device_matches_oracle(log_format, fields, lines, label):
         if not ok:
             continue
         for f in fields:
-            got, want = columns[f][i], expected.get(f)
-            if isinstance(got, int) and want is not None:
-                want = int(want)
+            got = columns[f][i]
+            if f.endswith(".*"):
+                # Wildcard columns materialize as the prefix-collected
+                # dict of delivered params ({} when none).
+                prefix = f[:-1]
+                want = {
+                    k[len(prefix):]: v
+                    for k, v in expected.items()
+                    if k.startswith(prefix)
+                }
+            else:
+                want = expected.get(f)
+                if isinstance(got, int) and want is not None:
+                    want = int(want)
             assert got == want, (
                 f"{label} line {i} field {f}: {got!r} != {want!r}\n"
                 f"  format: {log_format}\n  line:   {line!r}"
