@@ -1,6 +1,7 @@
 """Device-mesh parallel execution (DP over batch, SP over line length)."""
 from .mesh import (
     aggregate_counters,
+    batch_parallel_runner,
     data_parallel_runner,
     make_mesh,
     sequence_parallel_runner,
@@ -8,6 +9,7 @@ from .mesh import (
 
 __all__ = [
     "make_mesh",
+    "batch_parallel_runner",
     "data_parallel_runner",
     "sequence_parallel_runner",
     "aggregate_counters",

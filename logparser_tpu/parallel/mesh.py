@@ -60,6 +60,29 @@ def data_parallel_runner(program: DeviceProgram, mesh: Mesh):
     return jax.jit(fn, in_shardings=in_shardings)
 
 
+def batch_parallel_runner(units, mesh: Mesh):
+    """The FULL fused field-extraction step under data parallelism:
+    jitted fn(buf [B, L], lengths [B]) -> packed [K, B] int32 with the
+    batch axis sharded over 'data'.
+
+    Unlike :func:`data_parallel_runner` (split program only), this shards
+    the complete per-parser pipeline — split + chained sub-dissector
+    stages (firstline/URI splits, timestamps, CSR wildcards, GeoIP joins)
+    — exactly what ``TpuBatchParser`` executes per batch.  The per-line
+    computation has no cross-line dependency, so XLA partitions it with
+    zero collectives in the hot loop."""
+    from ..tpu.pipeline import units_fn
+
+    fn = units_fn(units)  # the same executor body TpuBatchParser jits
+
+    in_shardings = (
+        NamedSharding(mesh, P("data", None)),
+        NamedSharding(mesh, P("data")),
+    )
+    out_shardings = NamedSharding(mesh, P(None, "data"))
+    return jax.jit(fn, in_shardings=in_shardings, out_shardings=out_shardings)
+
+
 # ---------------------------------------------------------------------------
 # Sequence-parallel execution: shard L over 'seq' inside shard_map.
 # ---------------------------------------------------------------------------

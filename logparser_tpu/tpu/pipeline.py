@@ -848,9 +848,10 @@ def compute_units_rows(
     return rows
 
 
-def build_units_jnp_fn(units: Sequence[FormatUnit]):
-    """Plain-XLA executor over all formats:
-    (buf [B,L] uint8, lengths [B]) -> [sum K_i, B] int32."""
+def units_fn(units: Sequence[FormatUnit]):
+    """The un-jitted plain-XLA executor body over all formats:
+    (buf [B,L] uint8, lengths [B]) -> [sum K_i, B] int32.  The single
+    source for build_units_jnp_fn and the sharded mesh runners."""
 
     def fn(buf: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
         # buf stays uint8 end-to-end here: the [B, L] passes are HBM-bound
@@ -858,7 +859,13 @@ def build_units_jnp_fn(units: Sequence[FormatUnit]):
         # would 4x the traffic.
         return jnp.stack(compute_units_rows(units, buf, lengths))
 
-    return jax.jit(fn)
+    return fn
+
+
+def build_units_jnp_fn(units: Sequence[FormatUnit]):
+    """Plain-XLA executor over all formats:
+    (buf [B,L] uint8, lengths [B]) -> [sum K_i, B] int32."""
+    return jax.jit(units_fn(units))
 
 
 

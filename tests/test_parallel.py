@@ -132,3 +132,28 @@ class TestSequenceParallelBoundaries:
         buf, lengths = _encode(lines, 128)  # shards 1..3 all padding
         out = _assert_sp_matches(sep3_program, buf, lengths)
         assert np.asarray(out["valid"]).all()
+
+
+def test_full_step_batch_parallel_matches_single():
+    """The complete TpuBatchParser pipeline (split + chained stages + CSR)
+    sharded over the data axis: packed output bit-identical to one device."""
+    from logparser_tpu.parallel import batch_parallel_runner
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    parser = TpuBatchParser("combined", [
+        "IP:connection.client.host",
+        "TIME.EPOCH:request.receive.time.epoch",
+        "HTTP.PATH:request.firstline.uri.path",
+        "STRING:request.firstline.uri.query.*",
+        "BYTES:response.body.bytes",
+    ], use_pallas=False)
+    lines = [
+        f'10.0.0.{i % 200 + 1} - - [07/Mar/2026:10:00:{i % 60:02d} +0000] '
+        f'"GET /p{i}?a={i}&b=x HTTP/1.1" 200 {i + 1} "-" "ua{i}"'
+        for i in range(64)
+    ]
+    buf, lengths, _ = encode_batch(lines, line_len=256)
+    ref = np.asarray(parser._jitted(buf, lengths))
+    mesh = make_mesh(n_data=8)
+    dp = np.asarray(batch_parallel_runner(parser.units, mesh)(buf, lengths))
+    np.testing.assert_array_equal(dp, ref)
