@@ -1159,6 +1159,8 @@ class TpuBatchParser:
                         unit_get(u, fid, "lo_digits"),
                         is_null,
                     )
+                    if plan.kind == "secmillis":
+                        values = values * 1000 + unit_get(u, fid, "milli")
                     if plan.scale != 1:
                         values = values * plan.scale
                     if plan.null_mode == "zero_null":

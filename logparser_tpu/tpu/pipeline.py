@@ -378,8 +378,10 @@ class PackedLayout:
                 aux_needs += [
                     (plan.field_id, "ok", 1),
                     (plan.field_id, "null", 1),
-                    (plan.field_id, "lo_digits", 4),
+                    (plan.field_id, "lo_digits", 5),  # digit count <= 18
                 ]
+                if kind == "secmillis":
+                    aux_needs.append((plan.field_id, "milli", 10))
             elif kind == "ts":
                 key = ts_group_key(plan)
                 if key not in layout.slots:
@@ -629,9 +631,10 @@ def compute_rows(
             put_span(plan.field_id, s, e, chain_ok, null, amp, fix)
         elif plan.kind in ("long", "secmillis"):
             if plan.kind == "secmillis":
-                (hi, lo, lo_digits), is_null, ok = postproc.parse_secmillis_spans(
-                    b32, s, e, extract=extract_fn
+                (hi, lo, lo_digits), milli, is_null, ok = (
+                    postproc.parse_secmillis_spans(b32, s, e, extract=extract_fn)
                 )
+                put(plan.field_id, "milli", milli)
             else:
                 (hi, lo, lo_digits), is_null, ok = postproc.parse_long_spans(
                     b32, s, e,

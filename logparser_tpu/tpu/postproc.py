@@ -26,6 +26,16 @@ import jax.numpy as jnp
 MAX_LONG_DIGITS = 18
 
 
+def pow10_weights(w: int) -> jnp.ndarray:
+    """[w] descending powers of ten (10^(w-1) .. 10^0) for digit-window
+    dot products.  Built from iota rather than a numpy constant so kernels
+    that trace this (the Pallas cross-check path) don't capture an array
+    constant; XLA folds it to a constant either way."""
+    return jnp.int32(10) ** (
+        w - 1 - jax.lax.broadcasted_iota(jnp.int32, (w,), 0)
+    )
+
+
 def shift_zero(x: jnp.ndarray, k: int) -> jnp.ndarray:
     """Left-shift columns by k, zero-filling the tail.  The single shared
     zero-fill shift primitive (pipeline re-exports it; the Pallas path
@@ -73,11 +83,16 @@ def parse_long_spans(
     clf: bool = False,
     extract=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Spans of ASCII digits -> int64.
+    """Spans of ASCII digits -> int64 limbs, fully vectorized.
 
-    Returns (value, is_null, ok).  With ``clf`` a lone '-' yields
-    is_null=True (the reference maps '-' to null, ApacheHttpdLogFormatDissector
-    decodeExtractedValue :176-178).
+    Returns ((hi, lo, ndig), is_null, ok).  The limbs use a FIXED 18-wide
+    left-aligned frame: ``hi`` is the dot product of window columns 0..8
+    with 10^(8-i), ``lo`` of columns 9..17 with 10^(17-i) (bytes past the
+    span masked to digit 0), and ``ndig`` the span's digit count — so the
+    host combine is one exact integer division (combine_long_limbs), and
+    the device needs no per-column scalar rounds.  With ``clf`` a lone '-'
+    yields is_null=True (the reference maps '-' to null,
+    ApacheHttpdLogFormatDissector decodeExtractedValue :176-178).
     """
     extract = extract or gather_span_bytes
     n = end - start
@@ -86,20 +101,11 @@ def parse_long_spans(
     in_span = col < n[:, None]
     digits = (bytes_ - np.uint8(ord("0"))).astype(jnp.int32)
     digit_ok = (digits >= 0) & (digits <= 9)
+    d = jnp.where(in_span, digits, 0)
 
-    # int64 is unavailable on device without global x64; accumulate two int32
-    # limbs (leading digits / trailing 9 digits) and let the host combine:
-    # value = hi * 10^min(n,9) ... see combine_long_limbs.
-    hi = jnp.zeros(buf.shape[0], dtype=jnp.int32)
-    lo = jnp.zeros(buf.shape[0], dtype=jnp.int32)
-    for i in range(MAX_LONG_DIGITS):
-        take = in_span[:, i]
-        # Digit i belongs to the 'lo' limb when it is within the last 9
-        # digits of the span, i.e. i >= n - 9.
-        is_lo = take & (i >= (n - 9))
-        is_hi = take & ~is_lo
-        hi = jnp.where(is_hi, hi * 10 + digits[:, i], hi)
-        lo = jnp.where(is_lo, lo * 10 + digits[:, i], lo)
+    p9 = pow10_weights(9)
+    hi = jnp.sum(d[:, :9] * p9, axis=1).astype(jnp.int32)
+    lo = jnp.sum(d[:, 9:] * p9, axis=1).astype(jnp.int32)
 
     is_dash = (n == 1) & (bytes_[:, 0] == np.uint8(ord("-")))
     all_digits = jnp.all(digit_ok | ~in_span, axis=1)
@@ -108,14 +114,18 @@ def parse_long_spans(
         | (is_dash if clf else False)
     )
     is_null = is_dash & clf
-    return (hi, lo, jnp.minimum(n, 9)), is_null, ok
+    return (hi, lo, jnp.clip(n, 0, MAX_LONG_DIGITS)), is_null, ok
 
 
-def combine_long_limbs(hi, lo, lo_digits, is_null) -> np.ndarray:
-    """Host-side limb combine -> int64 numpy column (null slots -1)."""
-    value = np.asarray(hi, dtype=np.int64) * np.power(
-        10, np.asarray(lo_digits, dtype=np.int64)
-    ) + np.asarray(lo, dtype=np.int64)
+def combine_long_limbs(hi, lo, ndig, is_null) -> np.ndarray:
+    """Host-side limb combine -> int64 numpy column (null slots -1).
+
+    The limbs are the fixed-frame dot products of parse_long_spans: the
+    18-digit left-aligned value is hi*10^9 + lo with (18 - ndig) trailing
+    zero digits, so dividing by 10^(18-ndig) is exact."""
+    wide = np.asarray(hi, dtype=np.int64) * 10**9 + np.asarray(lo, dtype=np.int64)
+    shift = MAX_LONG_DIGITS - np.asarray(ndig, dtype=np.int64)
+    value = wide // np.power(10, shift)
     value[np.asarray(is_null)] = -1
     return value
 
@@ -125,52 +135,40 @@ def parse_secmillis_spans(
     start: jnp.ndarray,
     end: jnp.ndarray,
     extract=None,
-) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
-    """``"<seconds>.<3-digit millis>"`` spans -> epoch-millis int64 limbs.
+) -> Tuple[
+    Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    jnp.ndarray, jnp.ndarray, jnp.ndarray,
+]:
+    """``"<seconds>.<3-digit millis>"`` spans -> (seconds limbs, millis).
 
-    The digit string with the dot removed IS the epoch-millis value
-    ("1483455396.639" -> 1483455396639), so this reuses the hi/lo limb
-    scheme of :func:`parse_long_spans`: returns ((hi, lo, lo_digits),
-    is_null, ok) with is_null always False.  ok requires the exact
-    ``[0-9]+\\.[0-9]{3}`` shape the host regex/converter accepts
-    (ConvertSecondsWithMillisStringDissector semantics).
+    Returns ((hi, lo, ndig), millis, is_null, ok): the seconds part goes
+    through :func:`parse_long_spans` (fixed-frame limbs over the sub-span
+    before the dot), the 3 millis digits decode from a fixed window at the
+    span end; the host combines ``seconds * 1000 + millis``.  ok requires
+    the exact ``[0-9]+\\.[0-9]{3}`` shape the host regex/converter accepts
+    (ConvertSecondsWithMillisStringDissector semantics), incl. the old
+    total-digits cap (w <= 19).
     """
     extract = extract or gather_span_bytes
-    B = buf.shape[0]
     w = end - start
-    # Up to 18 total digits + the dot.
-    bytes_ = extract(buf, start, MAX_LONG_DIGITS + 1)
-    nd = w - 1  # digit count (dot removed)
-
-    hi = jnp.zeros(B, dtype=jnp.int32)
-    lo = jnp.zeros(B, dtype=jnp.int32)
-    digits_ok = jnp.ones(B, dtype=bool)
-    dot_ok = jnp.zeros(B, dtype=bool)
-    for i in range(MAX_LONG_DIGITS + 1):
-        in_span = i < w
-        is_dot = i == (w - 4)
-        d = (bytes_[:, i] - np.uint8(ord("0"))).astype(jnp.int32)
-        is_digit = (d >= 0) & (d <= 9)
-        digits_ok = digits_ok & (~in_span | is_dot | is_digit)
-        dot_ok = dot_ok | (
-            is_dot & (bytes_[:, i] == np.uint8(ord(".")))
-        )
-        # Digit index with the dot removed: i before the dot, i-1 after.
-        j = jnp.where(i < (w - 4), i, i - 1)
-        take = in_span & ~is_dot
-        is_lo = take & (j >= (nd - 9))
-        is_hi = take & ~is_lo
-        hi = jnp.where(is_hi, hi * 10 + d, hi)
-        lo = jnp.where(is_lo, lo * 10 + d, lo)
-
-    ok = (
-        (w >= 5)                       # at least one second digit + ".mmm"
-        & (nd <= MAX_LONG_DIGITS)
-        & digits_ok
-        & dot_ok
+    sec_limbs, _, sec_ok = parse_long_spans(
+        buf, start, jnp.maximum(end - 4, start), extract=extract
     )
-    is_null = jnp.zeros(B, dtype=bool)
-    return (hi, lo, jnp.minimum(nd, 9)), is_null, ok
+    # One width-4 window serves both the dot and the three millis digits.
+    win = extract(buf, jnp.maximum(end - 4, 0), 4)
+    dot = win[:, 0]
+    md = (win[:, 1:4] - np.uint8(ord("0"))).astype(jnp.int32)
+    m_ok = jnp.all((md >= 0) & (md <= 9), axis=1)
+    millis = md[:, 0] * 100 + md[:, 1] * 10 + md[:, 2]
+    ok = (
+        (w >= 5)
+        & (w <= MAX_LONG_DIGITS + 1)   # nd = w-1 <= 18, as before
+        & sec_ok
+        & m_ok
+        & (dot == np.uint8(ord(".")))
+    )
+    is_null = jnp.zeros(buf.shape[0], dtype=bool)
+    return sec_limbs, millis, is_null, ok
 
 
 def split_uri_fast(

@@ -164,12 +164,12 @@ def parse_device_timestamp(
     comp: Dict[str, jnp.ndarray] = {}
 
     def digits(off: int, w: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        val = zeros
-        good = jnp.ones(B, dtype=bool)
-        for i in range(off, off + w):
-            d = (b[:, i] - np.uint8(ord("0"))).astype(jnp.int32)
-            good = good & (d >= 0) & (d <= 9)
-            val = val * 10 + d
+        # One [B, w] vector op chain instead of w scalar-column rounds.
+        from .postproc import pow10_weights
+
+        d = (b[:, off : off + w] - np.uint8(ord("0"))).astype(jnp.int32)
+        good = jnp.all((d >= 0) & (d <= 9), axis=1)
+        val = jnp.sum(d * pow10_weights(w), axis=1).astype(jnp.int32)
         return val, good
 
     lower = b | np.uint8(0x20)
