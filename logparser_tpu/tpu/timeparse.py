@@ -36,6 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .postproc import pow10_weights
 from ..dissectors.timelayout import (
     DAYS_SHORT,
     MONTHS_SHORT,
@@ -165,8 +166,6 @@ def parse_device_timestamp(
 
     def digits(off: int, w: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
         # One [B, w] vector op chain instead of w scalar-column rounds.
-        from .postproc import pow10_weights
-
         d = (b[:, off : off + w] - np.uint8(ord("0"))).astype(jnp.int32)
         good = jnp.all((d >= 0) & (d <= 9), axis=1)
         val = jnp.sum(d * pow10_weights(w), axis=1).astype(jnp.int32)
