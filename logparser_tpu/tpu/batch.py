@@ -1006,6 +1006,11 @@ class TpuBatchParser:
             plausible = (row0 & 2) != 0
             valid = validity.any(axis=0)
             winner = np.where(valid, validity.argmax(axis=0), -1)
+            # Definitely-bad filter: regex-accept implies plausible, so a
+            # line implausible for EVERY registered format cannot be
+            # accepted by any format regex — the oracle would reject it
+            # identically, so it never needs the per-line re-parse.
+            plausible_any = plausible.any(axis=0)
             if len(self.units) > 1:
                 earlier_plausible = np.cumsum(plausible, axis=0) - plausible
                 contested = np.take_along_axis(
@@ -1016,9 +1021,14 @@ class TpuBatchParser:
                 winner = np.where(contested, -1, winner)
                 valid = valid & ~contested
             break
+        if packed is None:
+            plausible_any = np.ones(B, dtype=bool)  # no device verdict
         for i in overflow:
+            # Truncated lines: the device only saw a prefix, so its
+            # plausibility verdict does not apply — always oracle.
             valid[i] = False
             winner[i] = -1
+            plausible_any[i] = True
 
         def unit_get(u: FormatUnit, fid: str, comp: str) -> np.ndarray:
             block = packed[u.row_offset : u.row_offset + u.layout.n_rows]
@@ -1233,10 +1243,17 @@ class TpuBatchParser:
             for fid in self.requested:
                 overrides[fid].pop(i, None)
         trace.add("csr_materialize", time.perf_counter() - t_csr, items=B)
-        bad = 0
-        invalid_rows = set(int(i) for i in np.nonzero(~valid)[0])
-        # Rows the oracle must visit: lines no automaton accepted, plus lines
-        # whose winning format can't supply every requested field on device.
+        # Invalid AND implausible-for-all-formats: definitely bad, counted
+        # without an oracle visit (the single biggest fallback cost on
+        # hostile corpora — garbage lines are almost never plausible).
+        definitely_bad = np.nonzero(~valid & ~plausible_any)[0]
+        bad = int(definitely_bad.size)
+        invalid_rows = set(
+            int(i) for i in np.nonzero(~valid & plausible_any)[0]
+        )
+        # Rows the oracle must visit: lines no automaton accepted (but some
+        # format could still plausibly match), plus lines whose winning
+        # format can't supply every requested field on device.
         need_oracle = set(invalid_rows)
         for ui, flds in enumerate(self._unit_oracle_fields):
             if flds:

@@ -843,10 +843,15 @@ def compute_units_rows(
     range checks identically (the timestamp parser digit-checks every
     numeric byte explicitly for exactly this reason)."""
     rows: List[jnp.ndarray] = []
-    for i, u in enumerate(units):
+    for u in units:
+        # Plausibility is computed for EVERY unit (not just non-final
+        # ones): besides the multi-format winner contest, the host uses
+        # "implausible for all formats" as a sound definitely-bad filter —
+        # regex-accept implies plausible, so such lines skip the per-line
+        # oracle re-parse entirely.
         rows.extend(compute_rows(
             u.program, u.plans, u.layout, buf, lengths, shift_fn,
-            need_plausible=i < len(units) - 1,
+            need_plausible=True,
         ))
     return rows
 

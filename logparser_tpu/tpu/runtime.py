@@ -51,6 +51,12 @@ def encode_batch(
     raw = [
         line.encode("utf-8") if isinstance(line, str) else line for line in lines
     ]
+    # One trailing '\n' is invisible to the host regex (Python '$' matches
+    # before a final newline, so the oracle parses such lines identically)
+    # — strip it so the device automaton and its plausibility anchoring
+    # see exactly what the regex effectively parses.  Only ONE newline:
+    # '$' skips only the last.
+    raw = [r[:-1] if r.endswith(b"\n") else r for r in raw]
     # Native fast path: join + C++ frame/pack (logparser_tpu/native).  Only
     # safe when re-framing the joined blob reproduces the list exactly — no
     # embedded newlines, no trailing '\r' the framer would strip.

@@ -48,6 +48,14 @@ def test_parse_batch_traces_pipeline_stages():
             use_pallas=False,
         )
         lines = generate_combined_lines(32, seed=23, garbage_fraction=0.1)
+        # A PLAUSIBLE-but-device-rejected line (20-digit byte count: the
+        # device limb parser caps at 18 digits), so it must visit the
+        # oracle.  (Pure garbage no longer does — the implausible-for-
+        # all-formats filter counts it bad without a per-line re-parse.)
+        lines[3] = (
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] '
+            '"GET /x HTTP/1.1" 200 99999999999999999999 "-" "-"'
+        )
         parser.parse_batch(lines)
     finally:
         logparser_tpu.disable_tracing()
@@ -55,7 +63,7 @@ def test_parse_batch_traces_pipeline_stages():
     for stage in ("encode", "device", "fetch", "columns", "oracle_fallback"):
         assert stage in report, stage
     assert report["encode"]["items"] == 32
-    # The garbage lines forced the oracle fallback to visit some rows.
+    # The plausible-but-rejected line forced an oracle visit.
     assert report["oracle_fallback"]["items"] > 0
 
 

@@ -423,3 +423,67 @@ class TestZeroNullConverterDevice:
                 assert got[i] == int(want), (i, got[i], want)
             else:
                 assert got[i] == want, (i, got[i], want)
+
+
+class TestDefinitelyBadFilter:
+    """Implausible-for-every-format rejects skip the oracle entirely;
+    plausible rejects still take it.  Validity must match the oracle in
+    both cases (the differential fuzz asserts this across corpora; here
+    the oracle_rows accounting itself is locked)."""
+
+    def test_garbage_skips_oracle(self):
+        batch = TpuBatchParser("combined", FIELDS)
+        lines = [
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /x HTTP/1.1" '
+            '200 5 "-" "-"',
+            "complete garbage with no structure",
+            "",
+            "a b c d e f g h i j k",
+        ]
+        result = batch.parse_batch(lines)
+        assert list(result.valid) == [True, False, False, False]
+        assert result.bad_lines == 3
+        assert result.oracle_rows == 0
+
+    def test_plausible_reject_still_visits_oracle(self):
+        batch = TpuBatchParser("combined", FIELDS)
+        lines = [
+            # 20-digit bytes: device limb cap rejects, oracle accepts.
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /x HTTP/1.1" '
+            '200 99999999999999999999 "-" "-"',
+        ]
+        result = batch.parse_batch(lines)
+        assert result.oracle_rows == 1
+        assert result.valid[0]
+
+    def test_overflow_lines_always_oracle(self):
+        # Truncated lines: the device's plausibility verdict covers only
+        # the prefix, so overflow rows must keep their oracle visit.
+        batch = TpuBatchParser("combined", FIELDS)
+        line = (
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /'
+            + "a" * 8300
+            + ' HTTP/1.1" 200 5 "-" "-"'
+        )
+        result = batch.parse_batch([line])
+        assert result.oracle_rows == 1
+        assert result.valid[0]
+
+    def test_trailing_newline_matches_oracle(self):
+        # Python '$' matches before a final '\n', so the oracle accepts a
+        # newline-terminated line; the device path must agree (and stay
+        # device-resident, not merely rescue via the oracle).
+        base = (
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /x HTTP/1.1" '
+            '200 5 "-" "ua"'
+        )
+        batch = TpuBatchParser("combined", FIELDS)
+        result = batch.parse_batch([base + "\n", base, base + "\n\n"])
+        expected = oracle_parse([base + "\n", base, base + "\n\n"])
+        assert [bool(v) for v in result.valid] == [
+            rec is not None for rec in expected
+        ]
+        assert result.valid[0] and result.valid[1]
+        assert result.oracle_rows == 0
+        ua = result.to_pylist("HTTP.USERAGENT:request.user-agent")
+        assert ua[0] == "ua" == ua[1]
