@@ -586,6 +586,11 @@ class TpuBatchParser:
                 prog, plans, PackedLayout.for_plans(plans, self.csr_slots)
             ))
         assign_row_offsets(self.units)
+        # The definitely-bad filter (implausible for every format -> no
+        # oracle visit) is only sound when EVERY registered format has a
+        # device automaton; an uncompilable format lives oracle-side and
+        # could still accept a device-implausible line.
+        self._device_covers_all_formats = len(self.units) == len(dissectors)
 
         # Merged per-field plan: the first non-host kind across formats (used
         # for numeric coercion of oracle-delivered values).
@@ -1021,8 +1026,10 @@ class TpuBatchParser:
                 winner = np.where(contested, -1, winner)
                 valid = valid & ~contested
             break
-        if packed is None:
-            plausible_any = np.ones(B, dtype=bool)  # no device verdict
+        if packed is None or not self._device_covers_all_formats:
+            # No device verdict — or formats beyond the compiled prefix
+            # exist that the device cannot even judge plausibility for.
+            plausible_any = np.ones(B, dtype=bool)
         for i in overflow:
             # Truncated lines: the device only saw a prefix, so its
             # plausibility verdict does not apply — always oracle.
@@ -1246,10 +1253,10 @@ class TpuBatchParser:
         # Invalid AND implausible-for-all-formats: definitely bad, counted
         # without an oracle visit (the single biggest fallback cost on
         # hostile corpora — garbage lines are almost never plausible).
-        definitely_bad = np.nonzero(~valid & ~plausible_any)[0]
-        bad = int(definitely_bad.size)
+        inv = ~valid
+        bad = int(np.count_nonzero(inv & ~plausible_any))
         invalid_rows = set(
-            int(i) for i in np.nonzero(~valid & plausible_any)[0]
+            int(i) for i in np.nonzero(inv & plausible_any)[0]
         )
         # Rows the oracle must visit: lines no automaton accepted (but some
         # format could still plausibly match), plus lines whose winning
@@ -1782,6 +1789,8 @@ class TpuBatchParser:
             from .pipeline import CSR_SLOTS
 
             self.csr_slots = CSR_SLOTS
+        if "_device_covers_all_formats" not in state:  # pre-filter artifacts
+            self._device_covers_all_formats = False  # conservatively off
         if not getattr(self, "_use_pallas_explicit", False):
             # The defaulted flag described the BUILDER's backend; this
             # process may be a different machine — re-derive locally.

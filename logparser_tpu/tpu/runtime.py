@@ -48,15 +48,17 @@ def encode_batch(
     Returns (buffer, lengths, overflow_indices); overflowing lines are
     truncated in the buffer and reported for host-side handling.
     """
-    raw = [
-        line.encode("utf-8") if isinstance(line, str) else line for line in lines
-    ]
     # One trailing '\n' is invisible to the host regex (Python '$' matches
     # before a final newline, so the oracle parses such lines identically)
     # — strip it so the device automaton and its plausibility anchoring
     # see exactly what the regex effectively parses.  Only ONE newline:
     # '$' skips only the last.
-    raw = [r[:-1] if r.endswith(b"\n") else r for r in raw]
+    raw = []
+    for line in lines:
+        b = line.encode("utf-8") if isinstance(line, str) else line
+        if b.endswith(b"\n"):
+            b = b[:-1]
+        raw.append(b)
     # Native fast path: join + C++ frame/pack (logparser_tpu/native).  Only
     # safe when re-framing the joined blob reproduces the list exactly — no
     # embedded newlines, no trailing '\r' the framer would strip.

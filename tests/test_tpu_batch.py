@@ -487,3 +487,29 @@ class TestDefinitelyBadFilter:
         assert result.oracle_rows == 0
         ua = result.to_pylist("HTTP.USERAGENT:request.user-agent")
         assert ua[0] == "ua" == ua[1]
+
+    def test_uncompilable_format_disables_oracle_skip(self):
+        # A format the device cannot compile ("%h%m": adjacent value
+        # tokens) lives oracle-side; lines only IT accepts must still
+        # reach the oracle even though every DEVICE format finds them
+        # implausible.
+        batch = TpuBatchParser("combined\n%h%m", ["IP:connection.client.host"])
+        assert len(batch.units) < 2  # second format is off-device
+        lines = [
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /x HTTP/1.1" '
+            '200 5 "-" "-"',
+            "7.8.9.1GET",        # only the %h%m format accepts this
+            "total garbage $$$",
+        ]
+        result = batch.parse_batch(lines)
+        vals = result.to_pylist("IP:connection.client.host")
+        for i, line in enumerate(lines):
+            try:
+                rec = batch.oracle.parse(line, _CollectingRecord())
+                ok = True
+            except Exception:
+                rec, ok = None, False
+            assert bool(result.valid[i]) == ok, (i, line)
+            if ok:
+                assert vals[i] == rec.values.get("IP:connection.client.host")
+        assert result.valid[1]  # the %h%m line survived via the oracle
