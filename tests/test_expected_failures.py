@@ -1,0 +1,105 @@
+"""Expected-failure tier: engine misuse fails LOUDLY with actionable
+messages (the reference's expectfailure harness asserts exception texts —
+TestShouldFail / ExpectedFailure; parser-core/.../test/expectfailure/).
+"""
+import pytest
+
+from logparser_tpu.core import field
+from logparser_tpu.core.exceptions import (
+    DissectionFailure,
+    InvalidDissectorException,
+    InvalidFieldMethodSignature,
+    MissingDissectorsException,
+)
+from logparser_tpu.core.parser import Parser
+from logparser_tpu.httpd import HttpdLoglineParser
+
+
+class _Rec:
+    def __init__(self):
+        self.values = {}
+
+    def set_value(self, name, value):
+        self.values[name] = value
+
+
+def test_missing_dissector_names_the_unreachable_field():
+    p = HttpdLoglineParser(_Rec, "common")
+    p.add_parse_target(
+        "set_value",
+        ["IP:connection.client.host", "NOSUCHTYPE:no.such.path"],
+    )
+    with pytest.raises(MissingDissectorsException) as ei:
+        p.assemble_dissectors()
+    assert "NOSUCHTYPE:no.such.path" in str(ei.value)
+
+
+def test_nothing_reachable_is_a_useless_parser():
+    # When NO requested field is reachable the reference reports the
+    # useless-parser message instead of a missing list (Parser.java:341).
+    p = HttpdLoglineParser(_Rec, "common")
+    p.add_parse_target("set_value", ["NOSUCHTYPE:no.such.path"])
+    with pytest.raises(MissingDissectorsException) as ei:
+        p.assemble_dissectors()
+    assert "completely useless parser" in str(ei.value)
+
+
+def test_ignore_missing_dissectors_suppresses_the_failure():
+    p = HttpdLoglineParser(_Rec, "common")
+    p.add_parse_target(
+        "set_value",
+        ["IP:connection.client.host", "NOSUCHTYPE:no.such.path"],
+    )
+    p.ignore_missing_dissectors()
+    p.assemble_dissectors()  # must not raise
+    rec = p.parse('1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET / HTTP/1.1" 200 5')
+    assert rec.values.get("NOSUCHTYPE:no.such.path") is None
+    assert rec.values.get("IP:connection.client.host") == "1.2.3.4"
+
+
+def test_no_root_type_is_invalid():
+    p = Parser(_Rec)
+    p.add_parse_target("set_value", ["STRING:x"])
+    with pytest.raises(InvalidDissectorException):
+        p.assemble_dissectors()
+
+
+def test_bad_setter_arity_rejected():
+    class BadRec:
+        @field(["STRING:request.status.last"])
+        def set_value(self, a, b, c):  # three value params: invalid
+            pass
+
+    with pytest.raises(InvalidFieldMethodSignature):
+        HttpdLoglineParser(BadRec, "common")
+
+
+def test_bad_setter_name_param_type_rejected():
+    class BadRec:
+        @field(["STRING:request.status.last"])
+        def set_value(self, name: int, value):  # name must be str
+            pass
+
+    with pytest.raises(InvalidFieldMethodSignature):
+        HttpdLoglineParser(BadRec, "common")
+
+
+def test_dissection_failure_carries_format_and_line():
+    p = HttpdLoglineParser(_Rec, "common")
+    p.add_parse_target("set_value", ["IP:connection.client.host"])
+    with pytest.raises(DissectionFailure) as ei:
+        p.parse("does not match at all")
+    msg = str(ei.value)
+    assert "does not match" in msg  # the offending line is echoed
+    assert "LogFormat" in msg       # and the active format
+
+
+def test_same_type_remapping_is_a_definition_bug():
+    p = HttpdLoglineParser(_Rec, "common")
+    p.add_parse_target("set_value", ["STRING:request.status.last"])
+    p.add_type_remapping("request.status.last", "STRING")
+    with pytest.raises(DissectionFailure) as ei:
+        p.parse(
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET / HTTP/1.1" 200 5'
+        )
+    assert "mapping definition bug" in str(ei.value)
