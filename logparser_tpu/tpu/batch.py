@@ -58,6 +58,9 @@ from . import postproc, timefields
 # Back-compat alias (plan resolution lives here; packing in pipeline.py).
 _FieldPlan = FieldPlan
 
+# Octet -> string vocab for vectorized dotted-quad formatting.
+_OCTET_STRINGS = np.array([str(i) for i in range(256)], dtype=object)
+
 
 def _default_use_pallas() -> bool:
     """Default to the plain-XLA executor everywhere.  Measured on v5e
@@ -536,6 +539,11 @@ class TpuBatchParser:
         self.oracle.apply_config(type_remappings, extra_dissectors)
         self.oracle.add_parse_target("set_value", list(self.requested))
         self.oracle.assemble_dissectors()
+        # Type remappings by complete name, used by the device plan chase.
+        self._remaps = {
+            k: tuple(sorted(v))
+            for k, v in self.oracle.type_remappings.items()
+        }
 
         # Consumer registry for device plan resolution: every non-root
         # dissector, keyed by input type, deduped per class in registration
@@ -680,6 +688,8 @@ class TpuBatchParser:
             return "numeric"
         if plan.kind == "ts":
             return "numeric" if timefields.is_numeric_output(plan.comp) else "obj"
+        if plan.kind == "muid":
+            return "obj" if plan.comp == "ip" else "numeric"
         if plan.kind == "qscsr":
             return "wild"
         if plan.kind == "geo":
@@ -794,6 +804,11 @@ class TpuBatchParser:
                 "protocol", "userinfo", "host", "path", "query", "ref"
             ):
                 return ("span", vctx, steps + (("uri", oname),), device_ok)
+        from ..dissectors.mod_unique_id import ModUniqueIdDissector
+
+        if isinstance(d, ModUniqueIdDissector) and parse == "":
+            if oname in ("epoch", "ip", "processid", "counter", "threadindex"):
+                return ("muid", vctx, steps, device_ok, oname, None)
         from ..geoip.dissectors import AbstractGeoIPDissector
 
         if isinstance(d, AbstractGeoIPDissector) and parse == "":
@@ -844,7 +859,7 @@ class TpuBatchParser:
 
     def _chase(
         self, field_id, ftype, path, tok, t, name,
-        vctx, steps, device_ok, depth, visited,
+        vctx, steps, device_ok, depth, visited, remapped=False,
     ) -> List[_FieldPlan]:
         """All ways field (t:name) — reachable from `tok` via `steps` and
         `vctx` — leads to the requested (ftype:path).  Device plans where
@@ -866,6 +881,20 @@ class TpuBatchParser:
             plans.append(_FieldPlan(field_id, "host"))
             return plans
         visited = visited | {(t, name)}
+        # Type remappings re-type this name: the engine re-delivers the
+        # same value under each mapped type (Parsable's remap recursion,
+        # NOT nested — hence the `remapped` flag), so every consumer of a
+        # mapped type is a producer path too, and the mapped field itself
+        # is deliverable as a raw span (remapped targets are STRING_ONLY).
+        if not remapped:
+            for ntype in self._remaps.get(name, ()):
+                if ntype == t:
+                    continue
+                plans.extend(self._chase(
+                    field_id, ftype, path, tok, ntype, name,
+                    vctx, steps, device_ok, depth - 1, visited,
+                    remapped=True,
+                ))
         for d in self._consumers.get(t, ()):
             for out in d.get_possible_output():
                 ot, _, oname = out.partition(":")
@@ -932,7 +961,7 @@ class TpuBatchParser:
                     continue
                 spec = self._step_spec(d, oname, vctx, steps, device_ok)
                 kind = spec[0]
-                if kind in ("ts", "geo"):
+                if kind in ("ts", "geo", "muid"):
                     _, nctx, nsteps, ndev, comp, meta = spec
                     if path == new_name and ot == ftype:
                         if ndev:
@@ -1167,6 +1196,42 @@ class TpuBatchParser:
                         values = arr.astype(object)
                         values[arr < 0] = None
                     col["values"] = np.where(sel, values, col["values"])
+                    col["ok"] = np.where(sel, ok, col["ok"])
+                elif plan.kind == "muid":
+                    from .pipeline import muid_group_key
+
+                    key = muid_group_key(plan)
+                    ok = unit_get(u, key, "ok") != 0
+                    if plan.comp == "ip":
+                        u32 = (
+                            unit_get(u, key, "ip").astype(np.int64)
+                            & 0xFFFFFFFF
+                        )
+                        # Vectorized dotted-quad: a 256-entry octet-string
+                        # vocab + object-array concatenation (no per-row
+                        # Python loop).
+                        octs = _OCTET_STRINGS
+                        dot = np.full(B, ".", dtype=object)
+                        vals = (
+                            octs[(u32 >> 24) & 255] + dot
+                            + octs[(u32 >> 16) & 255] + dot
+                            + octs[(u32 >> 8) & 255] + dot
+                            + octs[u32 & 255]
+                        )
+                        values = np.where(ok, vals, None)
+                        col["values"] = np.where(sel, values, col["values"])
+                    else:
+                        comp_row = {
+                            "epoch": "time", "processid": "pid",
+                            "counter": "counter", "threadindex": "thread",
+                        }[plan.comp]
+                        values = (
+                            unit_get(u, key, comp_row).astype(np.int64)
+                            & 0xFFFFFFFF
+                        )
+                        if plan.comp == "epoch":
+                            values = values * 1000
+                        col["values"] = np.where(sel, values, col["values"])
                     col["ok"] = np.where(sel, ok, col["ok"])
                 else:  # long / secmillis
                     is_null = unit_get(u, fid, "null") != 0

@@ -328,6 +328,11 @@ def geo_group_key(plan: FieldPlan) -> str:
     return f"@geo:{plan.token_index}:{plan.meta[0]}:{plan.steps!r}"
 
 
+def muid_group_key(plan: FieldPlan) -> str:
+    """All mod_unique_id plans over the same token+steps share one decode."""
+    return f"@muid:{plan.token_index}:{plan.steps!r}"
+
+
 @dataclass
 class PackedLayout:
     """Bit-slot map for the packed [K, B] int32 output (row 0 = validity).
@@ -400,6 +405,18 @@ class PackedLayout:
                     layout.n_rows += 1
                     layout.slots[key] = {"row": (r, 0, 0)}
                     aux_needs.append((key, "ok", 1))
+            elif kind == "muid":
+                key = muid_group_key(plan)
+                if key not in layout.slots:
+                    r = layout.n_rows
+                    layout.n_rows += 4
+                    layout.slots[key] = {
+                        "time": (r, 0, 0),
+                        "ip": (r + 1, 0, 0),
+                        "pid": (r + 2, 0, 0),
+                        "thread": (r + 3, 0, 0),
+                    }
+                    aux_needs += [(key, "ok", 1), (key, "counter", 16)]
             elif kind == "qscsr":
                 key = csr_group_key(plan)
                 if key not in layout.slots:
@@ -677,6 +694,20 @@ def compute_rows(
             # flattened device table is IPv4-only, so those lines take the
             # oracle.
             valid = valid & ~(has_colon & chain_ok)
+        elif plan.kind == "muid":
+            key = muid_group_key(plan)
+            if key in group_done:
+                continue
+            group_done.add(key)
+            words, ok = postproc.parse_mod_unique_id(
+                b32, s, e, extract=extract_fn
+            )
+            for comp in ("time", "ip", "pid", "thread"):
+                put(key, comp, words[comp])
+            put(key, "counter", words["counter"])
+            put(key, "ok", jnp.where(ok & chain_ok, 1, 0))
+            # A non-decodable token just delivers nothing on the host
+            # (no line failure) — `valid` is untouched.
         elif plan.kind == "qscsr":
             key = csr_group_key(plan)
             if key in group_done:

@@ -692,6 +692,76 @@ def split_setcookie_csr(
     }
 
 
+def parse_mod_unique_id(
+    buf: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+    extract=None,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """mod_unique_id spans -> decoded u32 words, vectorized.
+
+    The host decoder (dissectors/mod_unique_id.py) delivers iff the token
+    is EXACTLY 24 chars of ``[A-Za-z0-9_-]`` (any other byte — incl. the
+    '@'-mapped '+'/'/' — is skipped by the lenient base64 decoder, leaving
+    fewer than 18 bytes, so nothing is delivered).  24 chars x 6 bits =
+    exactly 18 bytes: 32-bit epoch-seconds, 32-bit IPv4, 32-bit pid,
+    16-bit counter, 32-bit thread index.
+
+    Returns ({"time","ip","pid","counter","thread"}, ok): the u32 words
+    bitcast to int32 (host re-widens with ``& 0xFFFFFFFF``), counter as a
+    plain int32.
+    """
+    extract = extract or gather_span_bytes
+    b = extract(buf, start, 24)
+    w = end - start
+
+    is_upper = (b >= np.uint8(ord("A"))) & (b <= np.uint8(ord("Z")))
+    is_lower = (b >= np.uint8(ord("a"))) & (b <= np.uint8(ord("z")))
+    is_digit = (b >= np.uint8(ord("0"))) & (b <= np.uint8(ord("9")))
+    is_dash = b == np.uint8(ord("-"))
+    is_under = b == np.uint8(ord("_"))
+    ok = (w == 24) & jnp.all(
+        is_upper | is_lower | is_digit | is_dash | is_under, axis=1
+    )
+
+    b32 = b.astype(jnp.int32)
+    v = jnp.where(
+        is_upper, b32 - ord("A"),
+        jnp.where(
+            is_lower, b32 - ord("a") + 26,
+            jnp.where(
+                is_digit, b32 - ord("0") + 52,
+                jnp.where(is_dash, 62, 63),  # '-' -> '+', '_' -> '/'
+            ),
+        ),
+    ).astype(jnp.uint32)
+
+    # 4 chars -> one 24-bit group; 6 groups -> the 18 decoded bytes.
+    g = [
+        (v[:, i] << 18) | (v[:, i + 1] << 12) | (v[:, i + 2] << 6) | v[:, i + 3]
+        for i in range(0, 24, 4)
+    ]
+    time_u = (g[0] << 8) | (g[1] >> 16)
+    ip_u = ((g[1] & 0xFFFF) << 16) | (g[2] >> 8)
+    pid_u = ((g[2] & 0xFF) << 24) | g[3]
+    counter = (g[4] >> 8).astype(jnp.int32)
+    thread_u = ((g[4] & 0xFF) << 24) | g[5]
+
+    def cast(x):
+        return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+    return (
+        {
+            "time": cast(time_u),
+            "ip": cast(ip_u),
+            "pid": cast(pid_u),
+            "counter": counter,
+            "thread": cast(thread_u),
+        },
+        ok,
+    )
+
+
 def split_firstline(
     buf: jnp.ndarray,
     lengths: jnp.ndarray,

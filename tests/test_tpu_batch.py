@@ -513,3 +513,66 @@ class TestDefinitelyBadFilter:
             if ok:
                 assert vals[i] == rec.values.get("IP:connection.client.host")
         assert result.valid[1]  # the %h%m line survived via the oracle
+
+
+class TestModUniqueIdDevice:
+    """mod_unique_id via type remapping: the device plan chase follows the
+    remap edge and the fixed 24-char base64 variant decodes on device."""
+
+    FMT = "%h %{unique_id}e %>s"
+    REMAP = {"server.environment.unique_id": "MOD_UNIQUE_ID"}
+    FIELDS = [
+        "TIME.EPOCH:server.environment.unique_id.epoch",
+        "IP:server.environment.unique_id.ip",
+        "PROCESSID:server.environment.unique_id.processid",
+        "COUNTER:server.environment.unique_id.counter",
+        "THREAD_INDEX:server.environment.unique_id.threadindex",
+        "MOD_UNIQUE_ID:server.environment.unique_id",
+    ]
+
+    def _parser(self):
+        return TpuBatchParser(self.FMT, self.FIELDS,
+                              type_remappings=self.REMAP)
+
+    def test_resolves_to_device_plans(self):
+        p = self._parser()
+        kinds = {f.partition(":")[0]: p.plan_by_id[f].kind for f in self.FIELDS}
+        assert kinds["TIME.EPOCH"] == "muid"
+        assert kinds["IP"] == "muid"
+        assert kinds["MOD_UNIQUE_ID"] == "span"  # the remapped raw value
+        assert p._unit_oracle_fields == [[]]
+
+    def test_differential(self):
+        p = self._parser()
+        tokens = [
+            "VaGTKApid0AAALpaNo0AAAAC",   # known decode 1
+            "Ucdv38CoEJwAAEusp6EAAADz",   # known decode 2
+            "AAAAAAAAAAAAAAAAAAAAAAAA",   # all zero
+            "____________------------",   # alphabet extremes
+            "short",                      # wrong length: no delivery
+            "VaGTKApid0AAALpaNo0AAA@C",   # '@': skipped char, no delivery
+            "VaGTKApid0AAALpaNo0AAA+C",   # '+' -> '@': no delivery
+            "VaGTKApid0AAALpaNo0AAA=C",   # '=' mid-token: no delivery
+            "-",                          # CLF null token value
+        ]
+        lines = [f"9.9.9.9 {t} 200" for t in tokens]
+        result = p.parse_batch(lines)
+        assert result.oracle_rows == 0
+        for f in self.FIELDS:
+            got = result.to_pylist(f)
+            for i, line in enumerate(lines):
+                rec = p.oracle.parse(line, _CollectingRecord())
+                want = rec.values.get(f)
+                g = got[i]
+                if isinstance(g, int) and want is not None:
+                    want = int(want)
+                assert g == want, (f, tokens[i], g, want)
+
+    def test_known_values(self):
+        p = self._parser()
+        r = p.parse_batch(["9.9.9.9 VaGTKApid0AAALpaNo0AAAAC 200"])
+        assert r.to_pylist(self.FIELDS[0]) == [1436652328000]
+        assert r.to_pylist(self.FIELDS[1]) == ["10.98.119.64"]
+        assert r.to_pylist(self.FIELDS[2]) == [47706]
+        assert r.to_pylist(self.FIELDS[3]) == [13965]
+        assert r.to_pylist(self.FIELDS[4]) == [2]
