@@ -952,6 +952,15 @@ class TpuBatchParser:
                                 ))
                             else:
                                 plans.append(_FieldPlan(field_id, "host"))
+                    # A wildcard param REMAPPED to another type (the
+                    # reference's query.res -> SCREENRESOLUTION demo): the
+                    # engine re-types the delivered param value, so the
+                    # remapped type's consumers become producers too.
+                    if not remapped:
+                        plans.extend(self._chase_wildcard_remaps(
+                            field_id, ftype, path, tok, d, name,
+                            vctx, steps, device_ok,
+                        ))
                     continue
                 if oname == "":
                     new_name = name
@@ -983,6 +992,72 @@ class TpuBatchParser:
                         field_id, ftype, path, tok, ot, new_name,
                         nctx, nsteps, ndev, depth - 1, visited,
                     ))
+        return plans
+
+    def _chase_wildcard_remaps(
+        self, field_id, ftype, path, tok, d, name, vctx, steps, device_ok,
+    ) -> List[_FieldPlan]:
+        """Producer paths through a remapped wildcard param.
+
+        The wildcard delivers ``STRING:name.<param>``; a type remapping on
+        that complete name re-delivers the value under the mapped type,
+        whose consumers then sub-dissect it.  Device-modeled today: the
+        remapped raw value itself (the CSR segment value span) and
+        ScreenResolutionDissector's width/height (host-side split of the
+        matched segment, like set-cookie attrs).  Anything else counts as
+        a host producer."""
+        from ..dissectors.cookies import RequestCookieListDissector
+        from ..dissectors.query import QueryStringFieldDissector
+        from ..dissectors.screenres import ScreenResolutionDissector
+
+        mode = None
+        if isinstance(d, QueryStringFieldDissector):
+            mode = "query"
+        elif isinstance(d, RequestCookieListDissector):
+            mode = "cookie"
+        plans: List[_FieldPlan] = []
+        prefix = name + "."
+        for remap_key, ntypes in self._remaps.items():
+            if not remap_key.startswith(prefix):
+                continue
+            param = remap_key[len(prefix):]
+            if path == remap_key:
+                # The remapped raw value itself under one of its new types.
+                for ntype in ntypes:
+                    if ftype == ntype:
+                        if mode is not None and vctx[0] == "" and device_ok:
+                            plans.append(_FieldPlan(
+                                field_id, "qscsr", tok.index, steps,
+                                comp=param, meta=mode,
+                            ))
+                        else:
+                            plans.append(_FieldPlan(field_id, "host"))
+                continue
+            if not path.startswith(remap_key + "."):
+                continue
+            sub = path[len(remap_key) + 1:]
+            for ntype in ntypes:
+                for d2 in self._consumers.get(ntype, ()):
+                    for out2 in d2.get_possible_output():
+                        ot2, _, oname2 = out2.partition(":")
+                        if oname2 == sub and ot2 == ftype:
+                            if (
+                                isinstance(d2, ScreenResolutionDissector)
+                                and oname2 in ("width", "height")
+                                and mode is not None
+                                and vctx[0] == "" and device_ok
+                            ):
+                                plans.append(_FieldPlan(
+                                    field_id, "qscsr", tok.index, steps,
+                                    comp=param, meta=mode,
+                                    attr=("sres", d2.separator, oname2),
+                                ))
+                            else:
+                                plans.append(_FieldPlan(field_id, "host"))
+                        elif sub.startswith(oname2 + "."):
+                            # Deeper chains through the remapped type are
+                            # not modeled: count the producer, go host.
+                            plans.append(_FieldPlan(field_id, "host"))
         return plans
 
     # ------------------------------------------------------------------
@@ -1279,23 +1354,8 @@ class TpuBatchParser:
                 except (TypeError, ValueError):
                     return None
             # Host-delivered values arrive as oracle strings; deliver them
-            # typed per the producing dissector's casts (LONG > DOUBLE >
-            # STRING, matching the reference's setter-signature dispatch).
-            casts = self._host_casts.get(fid)
-            if casts is not None:
-                from ..core.casts import Cast
-
-                if Cast.LONG in casts:
-                    try:
-                        return int(value)
-                    except (TypeError, ValueError):
-                        pass
-                if Cast.DOUBLE in casts:
-                    try:
-                        return float(value)
-                    except (TypeError, ValueError):
-                        pass
-            return value
+            # typed per the producing dissector's casts.
+            return self._coerce_casts(fid, value)
 
         overrides: Dict[str, Any] = {
             fid: (_LazyWildcard() if fid.endswith(".*") else {})
@@ -1554,6 +1614,13 @@ class TpuBatchParser:
                     if m is None:
                         m = match_cache[p.comp] = match_comp(p.comp)
                     if getattr(p, "attr", ""):
+                        if isinstance(p.attr, tuple):
+                            # Remapped screen-resolution param: split the
+                            # matched segment's value host-side.
+                            self._deliver_sres_attr(
+                                fid, p, m, s_row, s_vs, s_vl, buf, overrides
+                            )
+                            continue
                         # Per-cookie attribute: parse the matched cookie's
                         # text once per row (host parse_attrs — the exact
                         # per-line semantics) and deliver via overrides.
@@ -1597,6 +1664,57 @@ class TpuBatchParser:
                             for i, d in dicts.items():
                                 tgt[i] = d
         return failed
+
+    def _coerce_casts(self, fid: str, value):
+        """Type a host-materialized value by the producing dissector's
+        casts (LONG > DOUBLE > STRING — the reference's setter-signature
+        dispatch), shared by the oracle-override path and the remapped
+        sub-dissection deliveries."""
+        casts = self._host_casts.get(fid)
+        if casts is not None and value is not None:
+            from ..core.casts import Cast
+
+            if Cast.LONG in casts:
+                try:
+                    return int(value)
+                except (TypeError, ValueError):
+                    pass
+            if Cast.DOUBLE in casts:
+                try:
+                    return float(value)
+                except (TypeError, ValueError):
+                    pass
+        return value
+
+    @staticmethod
+    def _sres_value(attr, text):
+        """ScreenResolutionDissector semantics for one remapped value:
+        split on the configured separator; None when absent/empty (nothing
+        delivered); parts beyond the second are ignored.  The single
+        implementation shared by the vectorized and per-row paths."""
+        _, sep, part = attr
+        if text and sep in text:
+            parts = text.split(sep)
+            return parts[0] if part == "width" else parts[1]
+        return None
+
+    def _deliver_sres_attr(
+        self, fid, p, m, s_row, s_vs, s_vl, buf, overrides
+    ) -> None:
+        """Deliver a remapped screen-resolution width/height for matched
+        segments (last same-name segment wins, like the host cache)."""
+        tgt = overrides[fid]
+        last: Dict[int, int] = {}
+        for j in m.tolist():
+            last[int(s_row[j])] = j
+        for row, j in last.items():
+            v0 = int(s_vs[j])
+            value = bytes(buf[row, v0 : v0 + int(s_vl[j])]).decode(
+                "utf-8", "replace"
+            )
+            out = self._sres_value(p.attr, value)
+            if out is not None:
+                tgt[row] = self._coerce_casts(fid, out)
 
     @staticmethod
     def _setcookie_attr_key(fid: str, attr: str) -> str:
@@ -1714,9 +1832,14 @@ class TpuBatchParser:
                 if p.comp == "*":
                     continue
                 if getattr(p, "attr", ""):
-                    # `d` keeps the last same-name cookie — exactly the
+                    # `d` keeps the last same-name segment — exactly the
                     # one the host's cache-overwrite semantics dissect.
                     text = d.get(p.comp) if d else None
+                    if isinstance(p.attr, tuple):
+                        out = self._sres_value(p.attr, text)
+                        if out is not None:
+                            overrides[fid][i] = self._coerce_casts(fid, out)
+                        continue
                     if text:
                         key = self._setcookie_attr_key(fid, p.attr)
                         attrs = attrs_cache.get(text)

@@ -375,3 +375,90 @@ def test_concrete_match_survives_unicode_lower():
         want.append(rec.values.get("STRING:request.firstline.uri.query.k"))
     assert col == want, (col, want)
     assert col[0] == "kelvin" and col[1] == "plain" and col[2] is None
+
+
+class TestScreenResolutionRemapDevice:
+    """The reference's canonical remap demo (query.res -> SCREENRESOLUTION
+    -> width/height) resolves through the wildcard remap chase: the CSR
+    segment match finds the param, the split happens host-side on only the
+    matched rows, values typed by the producing dissector's casts."""
+
+    FIELDS = [
+        "SCREENWIDTH:request.firstline.uri.query.res.width",
+        "SCREENHEIGHT:request.firstline.uri.query.res.height",
+        "SCREENRESOLUTION:request.firstline.uri.query.res",
+    ]
+    REMAP = {"request.firstline.uri.query.res": "SCREENRESOLUTION"}
+
+    def _parser(self):
+        from logparser_tpu.dissectors.screenres import (
+            ScreenResolutionDissector,
+        )
+
+        return TpuBatchParser(
+            "common", self.FIELDS, type_remappings=self.REMAP,
+            extra_dissectors=[ScreenResolutionDissector()],
+        )
+
+    def test_resolves_to_device_plans(self):
+        p = self._parser()
+        plans = {f.partition(":")[0]: p.plan_by_id[f] for f in self.FIELDS}
+        assert plans["SCREENWIDTH"].kind == "qscsr"
+        assert plans["SCREENWIDTH"].attr == ("sres", "x", "width")
+        assert plans["SCREENRESOLUTION"].kind == "qscsr"  # remapped raw
+        assert p._unit_oracle_fields == [[]]
+
+    def test_differential(self):
+        p = self._parser()
+        uris = [
+            "/x?res=1024x768&a=1",
+            "/x?res=800x600x32",     # extra parts ignored (split[0]/[1])
+            "/x?res=nores",          # no separator: nothing delivered
+            "/x?a=1",                # param absent
+            "/x?res=",               # empty value: nothing delivered
+            "/x?res=007x5",          # int coercion drops leading zeros
+            "/x?res=axb",            # non-numeric: delivered as strings
+            "/x?res=1024x768&res=640x480",  # duplicate: last wins
+            "/x?RES=2048x1536",      # case-folded param name
+        ]
+        lines = [
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET {u} HTTP/1.1" '
+            f"200 5"
+            for u in uris
+        ]
+        result = p.parse_batch(lines)
+        assert result.oracle_rows == 0
+        for f in self.FIELDS:
+            got = result.to_pylist(f)
+            for i, line in enumerate(lines):
+                rec = p.oracle.parse(line, _CollectingRecord())
+                want = rec.values.get(f)
+                g = got[i]
+                if isinstance(g, int) and want is not None:
+                    want = int(want)
+                assert g == want, (uris[i], f, g, want)
+        assert result.to_pylist(self.FIELDS[0]) == [
+            1024, 800, None, None, None, 7, "a", 640, 2048,
+        ]
+
+    def test_configurable_separator_with_colon(self):
+        # The separator is settings-configurable and may contain ':' —
+        # the structured plan attr must carry it intact.
+        from logparser_tpu.dissectors.screenres import (
+            ScreenResolutionDissector,
+        )
+
+        p = TpuBatchParser(
+            "common", self.FIELDS, type_remappings=self.REMAP,
+            extra_dissectors=[ScreenResolutionDissector(separator=":")],
+        )
+        lines = [
+            '1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] '
+            '"GET /x?res=640:480 HTTP/1.1" 200 5',
+        ]
+        result = p.parse_batch(lines)
+        got = result.to_pylist(self.FIELDS[0])
+        rec = p.oracle.parse(lines[0], _CollectingRecord())
+        want = rec.values.get(self.FIELDS[0])
+        assert got == [int(want)]
+        assert got == [640]
