@@ -690,6 +690,8 @@ class TpuBatchParser:
             return "numeric" if timefields.is_numeric_output(plan.comp) else "obj"
         if plan.kind == "muid":
             return "obj" if plan.comp == "ip" else "numeric"
+        if plan.kind == "ulist":
+            return "span"
         if plan.kind == "qscsr":
             return "wild"
         if plan.kind == "geo":
@@ -804,6 +806,25 @@ class TpuBatchParser:
                 "protocol", "userinfo", "host", "path", "query", "ref"
             ):
                 return ("span", vctx, steps + (("uri", oname),), device_ok)
+        from ..httpd.nginx_modules.upstream import UpstreamListDissector
+
+        if isinstance(d, UpstreamListDissector) and parse == "":
+            # Indexed upstream-list elements: device-eligible when the
+            # output is STRING_ONLY (numeric-casted lists deliver typed
+            # values through the oracle's casts dispatch).
+            from ..core.casts import STRING_ONLY as _SO
+
+            u_idx, _, u_which = oname.partition(".")
+            if u_which in ("value", "redirected") and u_idx.isdigit():
+                casts = (
+                    d.output_original_casts if u_which == "value"
+                    else d.output_redirected_casts
+                )
+                return (
+                    "ulist", vctx, steps,
+                    device_ok and casts == _SO,
+                    oname, (int(u_idx), u_which),
+                )
         from ..dissectors.mod_unique_id import ModUniqueIdDissector
 
         if isinstance(d, ModUniqueIdDissector) and parse == "":
@@ -970,7 +991,7 @@ class TpuBatchParser:
                     continue
                 spec = self._step_spec(d, oname, vctx, steps, device_ok)
                 kind = spec[0]
-                if kind in ("ts", "geo", "muid"):
+                if kind in ("ts", "geo", "muid", "ulist"):
                     _, nctx, nsteps, ndev, comp, meta = spec
                     if path == new_name and ot == ftype:
                         if ndev:
@@ -1698,20 +1719,27 @@ class TpuBatchParser:
             return parts[0] if part == "width" else parts[1]
         return None
 
-    def _deliver_sres_attr(
-        self, fid, p, m, s_row, s_vs, s_vl, buf, overrides
-    ) -> None:
-        """Deliver a remapped screen-resolution width/height for matched
-        segments (last same-name segment wins, like the host cache)."""
-        tgt = overrides[fid]
+    @staticmethod
+    def _last_matched_texts(m, s_row, s_vs, s_vl, buf):
+        """Yield (row, segment text) for the LAST matched segment per row
+        — the host cache-overwrite rule shared by every qscsr attr
+        delivery (duplicate same-name segments dissect only the last)."""
         last: Dict[int, int] = {}
         for j in m.tolist():
             last[int(s_row[j])] = j
         for row, j in last.items():
             v0 = int(s_vs[j])
-            value = bytes(buf[row, v0 : v0 + int(s_vl[j])]).decode(
+            yield row, bytes(buf[row, v0 : v0 + int(s_vl[j])]).decode(
                 "utf-8", "replace"
             )
+
+    def _deliver_sres_attr(
+        self, fid, p, m, s_row, s_vs, s_vl, buf, overrides
+    ) -> None:
+        """Deliver a remapped screen-resolution width/height for matched
+        segments."""
+        tgt = overrides[fid]
+        for row, value in self._last_matched_texts(m, s_row, s_vs, s_vl, buf):
             out = self._sres_value(p.attr, value)
             if out is not None:
                 tgt[row] = self._coerce_casts(fid, out)
@@ -1738,14 +1766,7 @@ class TpuBatchParser:
 
         key = self._setcookie_attr_key(fid, p.attr)
         tgt = overrides[fid]
-        last: Dict[int, int] = {}
-        for j in m.tolist():
-            last[int(s_row[j])] = j
-        for row, j in last.items():
-            v0 = int(s_vs[j])
-            text = bytes(buf[row, v0 : v0 + int(s_vl[j])]).decode(
-                "utf-8", "replace"
-            )
+        for row, text in self._last_matched_texts(m, s_row, s_vs, s_vl, buf):
             attrs = attrs_cache.get(text)
             if attrs is None:
                 attrs = attrs_cache[text] = (

@@ -357,7 +357,7 @@ class PackedLayout:
             kind = plan.kind
             if kind == "host":
                 continue
-            if kind == "span":
+            if kind in ("span", "ulist"):
                 r = layout.n_rows
                 layout.n_rows += 1
                 layout.slots[plan.field_id] = {
@@ -694,6 +694,19 @@ def compute_rows(
             # flattened device table is IPv4-only, so those lines take the
             # oracle.
             valid = valid & ~(has_colon & chain_ok)
+        elif plan.kind == "ulist":
+            # Indexed nginx upstream-list element: ", "-split on device,
+            # ": " redirect handling + whitespace trim per element.
+            u_idx, u_which = plan.meta
+            seg_s, seg_e, exists, high = postproc.upstream_segment(
+                b32, s, e, u_idx, u_which, shift_fn=shift_fn
+            )
+            u_dash = clf_dash(s, e) if not plan.steps else false_b
+            u_ok = chain_ok & exists & ~u_dash
+            put_span(plan.field_id, seg_s, seg_e, u_ok)
+            # Post-trim high bytes at the edges: host str.strip() may eat
+            # unicode whitespace the device does not model -> oracle.
+            valid = valid & ~(high & u_ok)
         elif plan.kind == "muid":
             key = muid_group_key(plan)
             if key in group_done:

@@ -121,3 +121,75 @@ def test_upstream_list():
         rec.results["UPSTREAM_ADDR:nginxmodule.upstream.addr.1.redirected"]
         == "192.168.10.1:80"
     )
+
+
+class TestUpstreamListDevice:
+    """Indexed upstream-list elements on device (UpstreamListDissector):
+    single-element lists (the common case) stay device-resident; lists
+    containing ", " fail the linear split and take the exact oracle."""
+
+    FMT = '$remote_addr [$time_local] $upstream_addr $upstream_status $status'
+    FIELDS = [
+        "UPSTREAM_ADDR:nginxmodule.upstream.addr.0.value",
+        "UPSTREAM_ADDR:nginxmodule.upstream.addr.0.redirected",
+        "UPSTREAM_ADDR:nginxmodule.upstream.addr.1.value",
+        "UPSTREAM_STATUS:nginxmodule.upstream.status.0.value",
+    ]
+
+    def test_plans_and_differential(self):
+        from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+        p = TpuBatchParser(self.FMT, self.FIELDS)
+        for f in self.FIELDS:
+            assert p.plan_by_id[f].kind == "ulist", f
+        assert p._unit_oracle_fields == [[]]
+        ups = [
+            "10.0.0.1:80",                        # single element
+            "unix:/tmp/sock.9",                   # socket path
+            "10.0.0.1:80, 10.0.0.2:81",           # two elements -> oracle
+            "10.0.0.1:80 : 10.0.0.2:81",          # redirect pair
+            "a:80 : b:81 : c:82",                 # extra ': ' parts dropped
+            "-",                                  # null token
+        ]
+        lines = [
+            f"1.2.3.4 [07/Mar/2026:10:00:00 +0000] {u} 200 200" for u in ups
+        ]
+        result = p.parse_batch(lines)
+        cols = {f: result.to_pylist(f) for f in self.FIELDS}
+        for i, line in enumerate(lines):
+            try:
+                rec = p.oracle.parse(line, _CollectingRecord())
+                expected, ok = rec.values, True
+            except Exception:
+                expected, ok = {}, False
+            assert bool(result.valid[i]) == ok, (i, ups[i])
+            if not ok:
+                continue
+            for f in self.FIELDS:
+                assert cols[f][i] == expected.get(f), (ups[i], f, cols[f][i])
+
+    def test_single_element_stays_on_device(self):
+        # Plain single-element lists (no space-bearing ", "/" : ") are the
+        # common case and must not touch the oracle; a redirect pair
+        # contains spaces, fails the linear split, and is rescued exactly.
+        from logparser_tpu.tpu.batch import TpuBatchParser
+
+        p = TpuBatchParser(self.FMT, self.FIELDS)
+        lines = [
+            "1.2.3.4 [07/Mar/2026:10:00:00 +0000] 10.0.0.1:80 200 200",
+            "9.9.9.9 [07/Mar/2026:10:00:02 +0000] unix:/s.sock 502 502",
+            # The token regex only allows a redirect on comma-continuation
+            # elements, so a valid redirect list always contains ", " and
+            # takes the oracle rescue.
+            "5.6.7.8 [07/Mar/2026:10:00:01 +0000] u0, h1:80 : h2:81 "
+            "304, 200 304",
+        ]
+        result = p.parse_batch(lines)
+        assert result.oracle_rows == 1  # only the multi-element line
+        assert result.to_pylist(self.FIELDS[0]) == [
+            "10.0.0.1:80", "unix:/s.sock", "u0",
+        ]
+        assert result.to_pylist(self.FIELDS[1]) == [
+            "10.0.0.1:80", "unix:/s.sock", "u0",
+        ]
+        assert result.to_pylist(self.FIELDS[2]) == [None, None, "h1:80"]
