@@ -177,6 +177,8 @@ def _sp_program_body(program: DeviceProgram, l_total: int, axis: str,
                 & _sp_charset_ok(buf_local, start, end, cs_row, offset, axis)
                 & ((end - start) >= op.min_len)
             )
+            if op.max_len:
+                valid = valid & ((end - start) <= op.max_len)
             starts = starts.at[op.token_index].set(start)
             ends = ends.at[op.token_index].set(end)
             cursor = next_cursor

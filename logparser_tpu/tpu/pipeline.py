@@ -188,14 +188,20 @@ def compute_split(
         for name, cid in program.charset_ids.items()
     }
 
-    def check_charset(start, end, spec_charset, spec_min_len, valid):
-        cs_ok = cs_masks[spec_charset]
+    def check_charset(start, end, op, valid):
+        cs_ok = cs_masks[op.charset]
         outside = (pos < start[:, None]) | (pos >= end[:, None])
         span_ok = jnp.all(cs_ok | outside, axis=1)
         width = end - start
         # CLF alternations ('number|-'): a lone '-' is legal even though the
         # charset also admits digits; min_len floor of 1 covers both arms.
-        return valid & span_ok & (width >= spec_min_len)
+        ok = valid & span_ok & (width >= op.min_len)
+        if op.max_len:
+            # Fixed/bounded-width regexes (e.g. '.' for $pipe matches ONE
+            # byte): without this the device accepts longer spans the real
+            # regex rejects — silently diverging instead of falling back.
+            ok = ok & (width <= op.max_len)
+        return ok
 
     # Plausibility: chase each separator's FIRST occurrence at/after a free
     # cursor — subsequence existence, for which greedy first-occurrence
@@ -259,15 +265,14 @@ def compute_split(
             token_valid = found < L
             start = cursor
             end = jnp.where(token_valid, found, cursor)
-            valid = check_charset(start, end, op.charset, op.min_len,
-                                  valid & token_valid)
+            valid = check_charset(start, end, op, valid & token_valid)
             starts[op.token_index] = start
             ends[op.token_index] = end
             cursor = end + len(op.lit)
         elif op.kind == "to_end":
             start = cursor
             end = lengths
-            valid = check_charset(start, end, op.charset, op.min_len, valid)
+            valid = check_charset(start, end, op, valid)
             starts[op.token_index] = start
             ends[op.token_index] = end
             cursor = end

@@ -24,6 +24,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field as dataclass_field
 from typing import Dict, List, Optional, Tuple
 
+
 import numpy as np
 
 from ..dissectors.tokenformat import (
@@ -60,6 +61,8 @@ CS_IP = "ip"                        # hex digits, ':', '.', '-'
 CS_TIME_US = "time_us"              # 0-9 A-Za-z / : + - and space
 CS_TIME_ISO = "time_iso"
 CS_NUM_DECIMAL = "num_decimal"      # digits and '.'
+CS_LIST = "list"                    # no-space elements + ' ,:' separators
+CS_NUM_LIST = "num_list"            # numeric elements + ' ,:.' separators
 
 _KNOWN_REGEX_CHARSETS = {
     FORMAT_NUMBER: (CS_DIGITS, 1),
@@ -78,6 +81,25 @@ _KNOWN_REGEX_CHARSETS = {
     ".*": (CS_ANY, 0),
     ".*?": (CS_ANY, 0),
 }
+
+# nginx upstream list regexes (", "-separated elements with ": " redirect
+# groups): the element charset forbids whitespace, so the LIST admits
+# everything non-whitespace plus the plain space inside separators —
+# tabs/newlines inside a list must fail the split like the host regex.
+
+
+def _register_list_regexes() -> None:
+    from ..httpd.nginx_modules.upstream import _upstream_list_of
+
+    for elem, cs in (
+        (FORMAT_NO_SPACE_STRING, CS_LIST),
+        (FORMAT_NUMBER, CS_NUM_LIST),
+        (FORMAT_NUMBER_DECIMAL, CS_NUM_LIST),
+    ):
+        _KNOWN_REGEX_CHARSETS[_upstream_list_of(elem)] = (cs, 0)
+
+
+_register_list_regexes()
 
 
 def _charset_bytes(name: str) -> np.ndarray:
@@ -120,6 +142,14 @@ def _charset_bytes(name: str) -> np.ndarray:
     elif name == CS_NUM_DECIMAL:
         table[ord("0") : ord("9") + 1] = True
         table[ord(".")] = True
+    elif name == CS_LIST:
+        table[:] = True
+        for ws in b"\t\n\r\x0b\x0c":
+            table[ws] = False
+    elif name == CS_NUM_LIST:
+        table[ord("0") : ord("9") + 1] = True
+        for c in b". ,:":
+            table[c] = True
     else:  # pragma: no cover
         raise ValueError(name)
     return table
@@ -132,6 +162,7 @@ class SplitOp:
     token_index: int = -1         # capture slot for until_lit/to_end
     charset: str = CS_ANY
     min_len: int = 0
+    max_len: int = 0              # 0 = unbounded
 
 
 @dataclass
@@ -141,6 +172,7 @@ class TokenSpec:
     index: int
     charset: str
     min_len: int
+    max_len: int = 0              # 0 = unbounded
     # (type, name) pairs this token emits (TokenOutputField list)
     outputs: List[Tuple[str, str]] = dataclass_field(default_factory=list)
 
@@ -167,11 +199,19 @@ class DeviceProgram:
         return None
 
 
-def _token_charset(token: Token) -> Tuple[str, int]:
+def _token_charset(token: Token) -> Tuple[str, int, int]:
     known = _KNOWN_REGEX_CHARSETS.get(token.regex)
     if known is not None:
-        return known
-    return CS_ANY, 0
+        return known[0], known[1], 0
+    # The "." regex ($pipe) matches EXACTLY one byte; without the max
+    # bound the device would accept arbitrarily long spans the real regex
+    # rejects — which can silently diverge instead of falling back (a
+    # lazy token further left absorbs the difference).  Only the literal
+    # dot is modeled: other single-char classes/escapes would need their
+    # byte set as the charset to stay sound.
+    if token.regex == ".":
+        return CS_ANY, 1, 1
+    return CS_ANY, 0, 0
 
 
 def compile_device_program(dissector: TokenFormatDissector) -> DeviceProgram:
@@ -191,8 +231,8 @@ def compile_device_program(dissector: TokenFormatDissector) -> DeviceProgram:
             ops.append(SplitOp("lit", tok.regex.encode("utf-8")))
             i += 1
             continue
-        charset, min_len = _token_charset(tok)
-        spec = TokenSpec(len(specs), charset, min_len,
+        charset, min_len, max_len = _token_charset(tok)
+        spec = TokenSpec(len(specs), charset, min_len, max_len,
                          [(f.type, f.name) for f in tok.output_fields])
         specs.append(spec)
         # Find the terminating separator: the next fixed token.
@@ -201,7 +241,7 @@ def compile_device_program(dissector: TokenFormatDissector) -> DeviceProgram:
             if isinstance(nxt, FixedStringToken):
                 ops.append(
                     SplitOp("until_lit", nxt.regex.encode("utf-8"),
-                            spec.index, charset, min_len)
+                            spec.index, charset, min_len, max_len)
                 )
                 i += 2  # the separator is consumed by until_lit
                 continue
@@ -211,7 +251,8 @@ def compile_device_program(dissector: TokenFormatDissector) -> DeviceProgram:
             raise UnsupportedFormatError(
                 f"adjacent value tokens without separator in {dissector.get_log_format()!r}"
             )
-        ops.append(SplitOp("to_end", b"", spec.index, charset, min_len))
+        ops.append(SplitOp("to_end", b"", spec.index, charset, min_len,
+                           max_len))
         i += 1
 
     charset_names = sorted({s.charset for s in specs} | {CS_ANY})

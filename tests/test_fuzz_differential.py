@@ -242,6 +242,19 @@ NGINX_POOL = [
      )),
     ("$status", ["STRING:request.status.last"],
      lambda rng: rng.choice(["200", "404", "502"])),
+    ("$upstream_addr",
+     ["UPSTREAM_ADDR:nginxmodule.upstream.addr.0.value",
+      "UPSTREAM_ADDR:nginxmodule.upstream.addr.0.redirected",
+      "UPSTREAM_ADDR:nginxmodule.upstream.addr.1.value"],
+     lambda rng: rng.choice([
+         "10.0.0.1:80", "unix:/tmp/be.sock", "-",
+         "10.0.0.1:80, 10.0.0.2:81",            # multi-element -> oracle
+         "u0, h1:80 : h2:81",                   # redirect on element 1
+         "a:1, b:2, c:3",
+     ])),
+    ("$upstream_status",
+     ["UPSTREAM_STATUS:nginxmodule.upstream.status.0.value"],
+     lambda rng: rng.choice(["200", "502", "-", "200, 304", "404, -"])),
     ("$body_bytes_sent", ["BYTES:response.body.bytes"],
      lambda rng: str(rng.randint(0, 10**10))),
     ("$bytes_sent", ["BYTES:response.bytes"],

@@ -193,3 +193,27 @@ class TestUpstreamListDevice:
             "10.0.0.1:80", "unix:/s.sock", "u0",
         ]
         assert result.to_pylist(self.FIELDS[2]) == [None, None, "h1:80"]
+
+    def test_whitespace_inside_list_rejected_like_host(self):
+        # The host list regex forbids tabs/newlines inside elements; the
+        # device list charset must reject them identically (a CS_ANY
+        # charset would fabricate values for unparseable lines).
+        from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+        p = TpuBatchParser(
+            "$remote_addr [$time_local] $upstream_addr $status",
+            ["UPSTREAM_ADDR:nginxmodule.upstream.addr.0.value"],
+        )
+        lines = [
+            "1.2.3.4 [07/Mar/2026:10:00:00 +0000] a\tb 200",
+            "1.2.3.4 [07/Mar/2026:10:00:00 +0000] 10.1.1.1:80 200",
+        ]
+        result = p.parse_batch(lines)
+        for i, line in enumerate(lines):
+            try:
+                p.oracle.parse(line, _CollectingRecord())
+                ok = True
+            except Exception:
+                ok = False
+            assert bool(result.valid[i]) == ok, i
+        assert not result.valid[0] and result.valid[1]

@@ -576,3 +576,27 @@ class TestModUniqueIdDevice:
         assert r.to_pylist(self.FIELDS[2]) == [47706]
         assert r.to_pylist(self.FIELDS[3]) == [13965]
         assert r.to_pylist(self.FIELDS[4]) == [2]
+
+
+def test_single_char_token_width_enforced():
+    """'.'-regex tokens ($pipe) match EXACTLY one byte: without the max
+    bound the device accepted longer spans and SILENTLY diverged from the
+    regex (a lazy token to the left absorbed the difference) instead of
+    falling back.  Found by differential fuzz."""
+    batch = TpuBatchParser(
+        "$upstream_status $host $remote_user $pipe",
+        ["STRING:connection.client.user", "STRING:connection.nginx.pipe"],
+    )
+    lines = [
+        "404, - example.com - .",   # ambiguous: must go to the oracle
+        "200 h.com bob p",          # clean: device-resident
+    ]
+    result = batch.parse_batch(lines)
+    assert result.oracle_rows == 1
+    user = result.to_pylist("STRING:connection.client.user")
+    pipe = result.to_pylist("STRING:connection.nginx.pipe")
+    for i, line in enumerate(lines):
+        rec = batch.oracle.parse(line, _CollectingRecord())
+        assert user[i] == rec.values.get("STRING:connection.client.user")
+        assert pipe[i] == rec.values.get("STRING:connection.nginx.pipe")
+    assert user[0] == "example.com -"  # the regex's greedy-backtrack answer
