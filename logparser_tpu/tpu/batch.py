@@ -750,9 +750,11 @@ class TpuBatchParser:
             # types them via Casts at the setter).
             if steps:
                 return _FieldPlan(field_id, "span", tok.index, steps)
-            if tok.charset == CS_DIGITS:
+            # NARROW charsets under-approximate the regex (list tokens):
+            # the host types those by casts (STRING), not by charset.
+            if tok.charset == CS_DIGITS and not tok.narrow:
                 return _FieldPlan(field_id, "long", tok.index)
-            if tok.charset == CS_CLF_DIGITS:
+            if tok.charset == CS_CLF_DIGITS and not tok.narrow:
                 return _FieldPlan(
                     field_id, "long", tok.index, null_mode="dash_null"
                 )

@@ -236,7 +236,10 @@ def compute_split(
                 usable = usable & (pos == lengths[:, None] - k)
             elif is_final_sep and remaining[0].kind == "to_end":
                 tail = remaining[0]
-                if tail.charset != CS_ANY:
+                if tail.charset != CS_ANY and not tail.narrow:
+                    # A NARROW charset under-approximates the regex's set,
+                    # so it must not anchor plausibility (regex-accept
+                    # must still imply plausible).
                     # The to_end token spans [q + k, length); it can only
                     # satisfy its charset if q + k is past the last
                     # violating byte.
@@ -700,18 +703,19 @@ def compute_rows(
             # oracle.
             valid = valid & ~(has_colon & chain_ok)
         elif plan.kind == "ulist":
-            # Indexed nginx upstream-list element: ", "-split on device,
-            # ": " redirect handling + whitespace trim per element.
-            u_idx, u_which = plan.meta
-            seg_s, seg_e, exists, high = postproc.upstream_segment(
-                b32, s, e, u_idx, u_which, shift_fn=shift_fn
-            )
+            # Indexed nginx upstream-list element.  The list token's
+            # NARROW charset excludes every separator and whitespace byte,
+            # so a device-valid row is necessarily a SINGLE untrimmable
+            # element: element 0 (value and redirected alike) is the token
+            # span itself, any higher index is absent.  Multi-element and
+            # redirect lists contain charset-rejected bytes and take the
+            # oracle, which indexes them exactly.
+            u_idx, _u_which = plan.meta
             u_dash = clf_dash(s, e) if not plan.steps else false_b
-            u_ok = chain_ok & exists & ~u_dash
-            put_span(plan.field_id, seg_s, seg_e, u_ok)
-            # Post-trim high bytes at the edges: host str.strip() may eat
-            # unicode whitespace the device does not model -> oracle.
-            valid = valid & ~(high & u_ok)
+            if u_idx == 0:
+                put_span(plan.field_id, s, e, chain_ok & ~u_dash)
+            else:
+                put_span(plan.field_id, s, s, jnp.zeros(B, dtype=bool))
         elif plan.kind == "muid":
             key = muid_group_key(plan)
             if key in group_done:

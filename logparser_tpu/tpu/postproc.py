@@ -692,84 +692,6 @@ def split_setcookie_csr(
     }
 
 
-def upstream_segment(
-    buf: jnp.ndarray,
-    start: jnp.ndarray,
-    end: jnp.ndarray,
-    index: int,
-    which: str,
-    shift_fn=shift_zero,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One indexed element of an nginx upstream list span
-    (UpstreamListDissector semantics, UpstreamListDissector.java:78-109):
-    elements split on the literal ``", "``; within an element the first
-    ``": "`` separates original from redirected — the redirected part
-    itself ends at the NEXT ``": "`` (the host keeps split(": ")[1] only,
-    dropping later parts); both values are whitespace-trimmed.
-
-    Returns (s, e, exists, high_edge): the requested sub-span, whether
-    element ``index`` exists at all, and whether a post-trim edge byte is
-    >= 0x80 — host str.strip() also eats unicode whitespace, so those
-    rare rows must take the oracle."""
-    B, L = buf.shape
-    shift = shift_fn
-    pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
-    in_span = (pos >= start[:, None]) & (pos < end[:, None])
-
-    def lit2(a: str, b: str) -> jnp.ndarray:
-        return (
-            (buf == np.uint8(ord(a)))
-            & (shift(buf, 1) == np.uint8(ord(b)))
-            & in_span
-            & (pos + 2 <= end[:, None])
-        )
-
-    is_sep = lit2(",", " ")
-    is_col = lit2(":", " ")
-
-    cursor = start
-    exists = jnp.ones(B, dtype=bool)
-    for _ in range(index):
-        usable = is_sep & (pos >= cursor[:, None])
-        nxt = jnp.min(jnp.where(usable, pos, L), axis=1).astype(jnp.int32)
-        exists = exists & (nxt < L)
-        cursor = jnp.minimum(nxt, end) + 2
-    usable = is_sep & (pos >= cursor[:, None])
-    nxt = jnp.min(jnp.where(usable, pos, L), axis=1).astype(jnp.int32)
-    s0 = jnp.minimum(cursor, end)
-    e0 = jnp.minimum(nxt, end)
-
-    cu = is_col & (pos >= s0[:, None]) & (pos < e0[:, None])
-    c1 = jnp.min(jnp.where(cu, pos, L), axis=1).astype(jnp.int32)
-    has_c = c1 < L
-    if which == "redirected":
-        c2u = is_col & (pos >= (c1 + 2)[:, None]) & (pos < e0[:, None])
-        c2 = jnp.min(jnp.where(c2u, pos, L), axis=1).astype(jnp.int32)
-        s1 = jnp.where(has_c, c1 + 2, s0)
-        e1 = jnp.where(has_c, jnp.minimum(c2, e0), e0)
-    else:
-        s1 = s0
-        e1 = jnp.where(has_c, c1, e0)
-
-    # ASCII-whitespace trim (str.strip's ASCII subset).
-    is_ws = (buf == np.uint8(0x20)) | (
-        (buf >= np.uint8(0x09)) & (buf <= np.uint8(0x0D))
-    )
-    inn = (pos >= s1[:, None]) & (pos < e1[:, None])
-    nonws = ~is_ws & inn
-    first_n = jnp.min(jnp.where(nonws, pos, L), axis=1).astype(jnp.int32)
-    last_n = jnp.max(jnp.where(nonws, pos, -1), axis=1).astype(jnp.int32)
-    all_ws = first_n >= L
-    s2 = jnp.where(all_ws, s1, first_n)
-    e2 = jnp.where(all_ws, s1, last_n + 1)
-
-    high = buf >= np.uint8(0x80)
-    edge_high = jnp.any(high & (pos == s2[:, None]), axis=1) | jnp.any(
-        high & (pos == (e2 - 1)[:, None]), axis=1
-    )
-    return s2, e2, exists, edge_high
-
-
 def parse_mod_unique_id(
     buf: jnp.ndarray,
     start: jnp.ndarray,

@@ -14,7 +14,12 @@ of vectorizable ops over ``[B, L]`` uint8 buffers —
 - ``to_end``    capture the rest of the line.
 
 Every op advances a per-line cursor; validation (separators matched, token
-charsets respected, the whole line consumed) yields a per-line validity mask.
+charsets respected, the whole line consumed) yields a per-line validity
+mask.  Charsets are supersets of the token regex languages EXCEPT ops
+marked ``narrow`` (single-element list approximations): those may
+false-invalidate lines the regex accepts — the oracle rescues them —
+and must never be used as proof of regex acceptance (plausibility
+skips them).
 Lines that fail validation are re-parsed on the host oracle path — the
 optimistic device split plus oracle fallback is bit-exact with the Java regex
 semantics while keeping the hot path free of backtracking.
@@ -61,8 +66,6 @@ CS_IP = "ip"                        # hex digits, ':', '.', '-'
 CS_TIME_US = "time_us"              # 0-9 A-Za-z / : + - and space
 CS_TIME_ISO = "time_iso"
 CS_NUM_DECIMAL = "num_decimal"      # digits and '.'
-CS_LIST = "list"                    # no-space elements + ' ,:' separators
-CS_NUM_LIST = "num_list"            # numeric elements + ' ,:.' separators
 
 _KNOWN_REGEX_CHARSETS = {
     FORMAT_NUMBER: (CS_DIGITS, 1),
@@ -83,20 +86,28 @@ _KNOWN_REGEX_CHARSETS = {
 }
 
 # nginx upstream list regexes (", "-separated elements with ": " redirect
-# groups): the element charset forbids whitespace, so the LIST admits
-# everything non-whitespace plus the plain space inside separators —
-# tabs/newlines inside a list must fail the split like the host regex.
+# groups) use their SINGLE-element charset: a one-element list is then
+# validated exactly, while any multi-element list (or whitespace-corrupted
+# value) contains separator bytes the charset rejects and takes the
+# oracle — which is also where multi-element indexing must happen anyway.
+# A charset that admitted the separators would make the first-occurrence
+# split ambiguous against the regex's backtracking (found by fuzz).
+
+
+_NARROW_REGEXES: set = set()
 
 
 def _register_list_regexes() -> None:
     from ..httpd.nginx_modules.upstream import _upstream_list_of
 
-    for elem, cs in (
-        (FORMAT_NO_SPACE_STRING, CS_LIST),
-        (FORMAT_NUMBER, CS_NUM_LIST),
-        (FORMAT_NUMBER_DECIMAL, CS_NUM_LIST),
+    for elem, cs, mn in (
+        (FORMAT_NO_SPACE_STRING, CS_NO_SPACE, 0),
+        (FORMAT_NUMBER, CS_DIGITS, 1),
+        (FORMAT_NUMBER_DECIMAL, CS_NUM_DECIMAL, 3),
     ):
-        _KNOWN_REGEX_CHARSETS[_upstream_list_of(elem)] = (cs, 0)
+        regex = _upstream_list_of(elem)
+        _KNOWN_REGEX_CHARSETS[regex] = (cs, mn)
+        _NARROW_REGEXES.add(regex)
 
 
 _register_list_regexes()
@@ -142,14 +153,6 @@ def _charset_bytes(name: str) -> np.ndarray:
     elif name == CS_NUM_DECIMAL:
         table[ord("0") : ord("9") + 1] = True
         table[ord(".")] = True
-    elif name == CS_LIST:
-        table[:] = True
-        for ws in b"\t\n\r\x0b\x0c":
-            table[ws] = False
-    elif name == CS_NUM_LIST:
-        table[ord("0") : ord("9") + 1] = True
-        for c in b". ,:":
-            table[c] = True
     else:  # pragma: no cover
         raise ValueError(name)
     return table
@@ -163,6 +166,11 @@ class SplitOp:
     charset: str = CS_ANY
     min_len: int = 0
     max_len: int = 0              # 0 = unbounded
+    # True when `charset` is NARROWER than the token regex's true set
+    # (single-element list approximation): validity may use it to route
+    # rejects to the oracle, but PLAUSIBILITY must not — its anchoring
+    # assumes charset >= regex so that regex-accept implies plausible.
+    narrow: bool = False
 
 
 @dataclass
@@ -173,6 +181,7 @@ class TokenSpec:
     charset: str
     min_len: int
     max_len: int = 0              # 0 = unbounded
+    narrow: bool = False
     # (type, name) pairs this token emits (TokenOutputField list)
     outputs: List[Tuple[str, str]] = dataclass_field(default_factory=list)
 
@@ -199,10 +208,10 @@ class DeviceProgram:
         return None
 
 
-def _token_charset(token: Token) -> Tuple[str, int, int]:
+def _token_charset(token: Token) -> Tuple[str, int, int, bool]:
     known = _KNOWN_REGEX_CHARSETS.get(token.regex)
     if known is not None:
-        return known[0], known[1], 0
+        return known[0], known[1], 0, token.regex in _NARROW_REGEXES
     # The "." regex ($pipe) matches EXACTLY one byte; without the max
     # bound the device would accept arbitrarily long spans the real regex
     # rejects — which can silently diverge instead of falling back (a
@@ -210,8 +219,8 @@ def _token_charset(token: Token) -> Tuple[str, int, int]:
     # dot is modeled: other single-char classes/escapes would need their
     # byte set as the charset to stay sound.
     if token.regex == ".":
-        return CS_ANY, 1, 1
-    return CS_ANY, 0, 0
+        return CS_ANY, 1, 1, False
+    return CS_ANY, 0, 0, False
 
 
 def compile_device_program(dissector: TokenFormatDissector) -> DeviceProgram:
@@ -231,8 +240,8 @@ def compile_device_program(dissector: TokenFormatDissector) -> DeviceProgram:
             ops.append(SplitOp("lit", tok.regex.encode("utf-8")))
             i += 1
             continue
-        charset, min_len, max_len = _token_charset(tok)
-        spec = TokenSpec(len(specs), charset, min_len, max_len,
+        charset, min_len, max_len, narrow = _token_charset(tok)
+        spec = TokenSpec(len(specs), charset, min_len, max_len, narrow,
                          [(f.type, f.name) for f in tok.output_fields])
         specs.append(spec)
         # Find the terminating separator: the next fixed token.
@@ -241,7 +250,7 @@ def compile_device_program(dissector: TokenFormatDissector) -> DeviceProgram:
             if isinstance(nxt, FixedStringToken):
                 ops.append(
                     SplitOp("until_lit", nxt.regex.encode("utf-8"),
-                            spec.index, charset, min_len, max_len)
+                            spec.index, charset, min_len, max_len, narrow)
                 )
                 i += 2  # the separator is consumed by until_lit
                 continue
@@ -252,7 +261,7 @@ def compile_device_program(dissector: TokenFormatDissector) -> DeviceProgram:
                 f"adjacent value tokens without separator in {dissector.get_log_format()!r}"
             )
         ops.append(SplitOp("to_end", b"", spec.index, charset, min_len,
-                           max_len))
+                           max_len, narrow))
         i += 1
 
     charset_names = sorted({s.charset for s in specs} | {CS_ANY})
