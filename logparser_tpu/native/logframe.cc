@@ -126,6 +126,40 @@ void lp_gather_spans(const uint8_t* buf, int64_t B, int64_t L,
   for (auto& th : pool) th.join();
 }
 
+// Multi-column span gather: K span columns over the SAME [B, L] buffer in
+// one threaded fan-out, amortizing the thread-pool spawn across columns
+// (the Arrow bridge materializes every string column of a batch at once).
+// `starts` is [K*B] laid out column-major (column k's rows begin at k*B);
+// `offsets` is [K*B+1] cumulative over that layout, so each column's bytes
+// land contiguously in `out` and Python can slice per-column views
+// zero-copy.
+void lp_gather_spans_multi(const uint8_t* buf, int64_t B, int64_t L,
+                           const int32_t* starts, const int64_t* offsets,
+                           uint8_t* out, int64_t K, int32_t threads) {
+  if (threads < 1) threads = 1;
+  int64_t n = K * B;
+  int64_t chunk = (n + threads - 1) / threads;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t len = offsets[i + 1] - offsets[i];
+      if (len <= 0) continue;
+      int64_t r = i % B;
+      std::memcpy(out + offsets[i], buf + r * L + starts[i], len);
+    }
+  };
+  if (threads == 1 || n < 4096) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  for (int32_t t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back(work, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+}
+
 // One-shot convenience: frame + pack a whole blob.  Returns line count.
 int64_t lp_frame_pack(const uint8_t* data, int64_t size,
                       uint8_t* out, int32_t* lengths,

@@ -24,25 +24,37 @@ if TYPE_CHECKING:  # pragma: no cover
 
 
 
-def _spans_to_string_array(result: "BatchResult", field_id: str) -> Optional[Any]:
+def _spans_to_string_array(
+    result: "BatchResult", field_id: str, flat: Optional[Any] = None
+) -> Optional[Any]:
     """Vectorized span -> pa.StringArray built on BatchResult.span_bytes
     (the single flat-gather implementation: validity mask, native gather,
-    ?&-normalization).  Returns None when the column needs the per-row path
-    or the gathered bytes are not valid UTF-8."""
+    ?&-normalization).  ``flat`` carries a prefetched (data, offsets,
+    valid) triple from the batch-wide multi-column gather.  Returns None
+    when the column needs the per-row path or the gathered bytes are not
+    valid UTF-8."""
     import pyarrow as pa
 
     B = result.lines_read
     if B == 0:
         return pa.array([], type=pa.string())
-    flat = result.span_bytes(field_id)
+    if flat is None:
+        flat = result.span_bytes(field_id)
     if flat is None:
         return None
     data, offsets64, valid = flat
+    data, offsets64 = _splice_fix_rows(result, field_id, data, offsets64, valid)
     if int(offsets64[-1]) > np.iinfo(np.int32).max:
         # int32 StringArray offsets would wrap; don't rely on validate()
         # catching it after the full gather — take the fallback path now.
         return None
     data = np.ascontiguousarray(data)
+    if data.base is not None:
+        # A view into the batch-wide multi-column gather buffer: wrapping
+        # it zero-copy into the Arrow buffer would pin EVERY span
+        # column's bytes for as long as this one column lives.  Copy the
+        # column's own bytes (one memcpy, small next to the gather).
+        data = data.copy()
     offsets = offsets64.astype(np.int32)
     null_bitmap = np.packbits(valid, bitorder="little")
     # pa.py_buffer wraps the numpy arrays zero-copy (buffer protocol);
@@ -53,6 +65,11 @@ def _spans_to_string_array(result: "BatchResult", field_id: str) -> Optional[Any
         pa.py_buffer(data),
         pa.py_buffer(null_bitmap),
     )
+    if result.ascii_only:
+        # Every source byte is < 0x80, so every gathered span is valid
+        # UTF-8 by construction — the per-column validate pass (a third
+        # of the column build cost) is provably redundant.
+        return arr
     try:
         arr.validate(full=True)  # UTF-8 check happens here
     except pa.ArrowInvalid:
@@ -60,7 +77,171 @@ def _spans_to_string_array(result: "BatchResult", field_id: str) -> Optional[Any
     return arr
 
 
-def _column_to_arrow(result: "BatchResult", field_id: str):
+_HEX_VAL = np.full(256, -1, dtype=np.int16)
+for _c in b"0123456789":
+    _HEX_VAL[_c] = _c - ord("0")
+for _c in b"abcdef":
+    _HEX_VAL[_c] = _c - ord("a") + 10
+for _c in b"ABCDEF":
+    _HEX_VAL[_c] = _c - ord("A") + 10
+_IS_HEX = _HEX_VAL >= 0
+
+
+def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid):
+    """Patch URI-repair (`fix`) rows into gathered flat span bytes.
+
+    The flat gather copies repair rows RAW; the repair semantics
+    (%-bad-escape rewrite + path %XX decode, HttpUriDissector.java:166-167
+    / java.net.URI decode) run here VECTORIZED over the concatenated
+    fix-row bytes: rows whose escapes are all well-formed ``%XX`` decode
+    with numpy scatter/gather; only rows with bad escapes, non-ASCII raw
+    bytes, or non-ASCII decode results (UTF-8 replacement semantics) take
+    the per-row ``_fix_uri_part`` path.  Spliced python-row values
+    re-encode through UTF-8, so they are valid by construction."""
+    from .batch import _fix_uri_part
+
+    col = result.column(field_id)
+    fix = col.get("fix")
+    B = result.lines_read
+    if fix is None:
+        return data, offsets
+    rows = np.nonzero(np.asarray(fix[:B], dtype=bool) & valid)[0]
+    if rows.size == 0:
+        return data, offsets
+    mode = col["fix_mode"]
+    lens = np.diff(offsets)
+    seg_lens = lens[rows]
+    n_rows = rows.size
+    seg_off = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(seg_lens, out=seg_off[1:])
+    total = int(seg_off[-1])
+    idx = np.repeat(offsets[rows] - seg_off[:-1], seg_lens) + np.arange(
+        total, dtype=np.int64
+    )
+    seg = data[idx]
+    row_id = np.repeat(np.arange(n_rows, dtype=np.int64), seg_lens)
+
+    # Classify every '%' as a well-formed %XX escape or a bad escape
+    # (reference _BAD_ESCAPE_PATTERN: % not followed by two hex digits,
+    # including at end-of-value).
+    nxt1 = np.zeros(total, dtype=np.uint8)
+    nxt2 = np.zeros(total, dtype=np.uint8)
+    same1 = np.zeros(total, dtype=bool)
+    same2 = np.zeros(total, dtype=bool)
+    if total > 1:
+        nxt1[:-1] = seg[1:]
+        same1[:-1] = row_id[1:] == row_id[:-1]
+    if total > 2:
+        nxt2[:-2] = seg[2:]
+        same2[:-2] = row_id[2:] == row_id[:-2]
+    pct = seg == ord("%")
+    good = pct & same1 & same2 & _IS_HEX[nxt1] & _IS_HEX[nxt2]
+    bad = pct & ~good
+
+    def row_any(mask):
+        out = np.zeros(n_rows, dtype=bool)
+        if mask.any():
+            out[np.unique(row_id[mask])] = True
+        return out
+
+    # Rows needing the exact per-row semantics: raw non-ASCII bytes (the
+    # UTF-8 decode-replace round trip can rewrite invalid sequences) and,
+    # in path mode, non-ASCII decode results (multi-escape runs decode as
+    # one UTF-8 unit).  Everything else vectorizes:
+    # - The reference's TWICE-applied sequential %25 rewrite
+    #   (HttpUriDissector.java:166-167) is equivalent to ONE simultaneous
+    #   "insert 25 after every originally-bad %": pass-1 consumption can
+    #   only defer a bad escape's rewrite to pass 2 (never prevent it),
+    #   a rewritten escape is %25-good and never rematched, and no
+    #   insertion can land between a good % and its two hex digits.
+    # - In path mode, repairing a bad escape then decoding it
+    #   (%zz -> %25zz -> %zz) is the identity, so bad escapes simply stay
+    #   literal and only good %XX escapes substitute their byte.
+    py_rows = row_any(seg >= 0x80)
+    if mode == "path":
+        dec = ((_HEX_VAL[nxt1] << 4) | np.maximum(_HEX_VAL[nxt2], 0)).astype(
+            np.int16
+        )
+        py_rows |= row_any(good & (dec >= 0x80))
+        vec_changed = row_any(good) & ~py_rows
+    else:
+        # Repair-only mode: well-formed escapes are untouched; only rows
+        # with bad escapes change.
+        vec_changed = row_any(bad) & ~py_rows
+
+    py_idx = np.nonzero(py_rows)[0]
+    changed_local = np.nonzero(vec_changed | py_rows)[0]
+    if changed_local.size == 0:
+        return data, offsets
+
+    pieces = [data]
+    src_base = offsets[:-1].astype(np.int64, copy=True)
+    new_lens = lens.copy()
+    if vec_changed.any():
+        in_vec = vec_changed[row_id]
+        if mode == "path":
+            # Drop the two hex tail bytes of each good escape, replace
+            # the '%' with the decoded byte.
+            g = good & in_vec
+            tail = np.zeros(total, dtype=bool)
+            tail[1:] |= g[:-1]
+            tail[2:] |= g[:-2]
+            keep = in_vec & ~tail
+            new_seg = np.where(g, dec.astype(np.uint8), seg)[keep]
+            row_counts = np.bincount(row_id[keep], minlength=n_rows)
+        else:
+            # Simultaneous bad-escape rewrite: every bad '%' expands to
+            # three output bytes ('%' repeated, then patched to %25).
+            sel = in_vec
+            sv = seg[sel]
+            bv = (bad & in_vec)[sel]
+            rid_v = row_id[sel]
+            counts = np.where(bv, 3, 1).astype(np.int64)
+            out_pos = np.zeros(sv.size + 1, dtype=np.int64)
+            np.cumsum(counts, out=out_pos[1:])
+            new_seg = np.repeat(sv, counts)
+            ins = out_pos[:-1][bv]
+            new_seg[ins + 1] = ord("2")
+            new_seg[ins + 2] = ord("5")
+            row_counts = np.bincount(
+                rid_v, weights=counts, minlength=n_rows
+            ).astype(np.int64)
+        vloc = np.nonzero(vec_changed)[0]
+        voff = np.zeros(vloc.size + 1, dtype=np.int64)
+        np.cumsum(row_counts[vloc], out=voff[1:])
+        src_base[rows[vloc]] = len(data) + voff[:-1]
+        new_lens[rows[vloc]] = row_counts[vloc]
+        pieces.append(new_seg)
+    if py_idx.size:
+        py_bytes = [
+            _fix_uri_part(
+                bytes(seg[seg_off[j] : seg_off[j + 1]]).decode("utf-8", "replace"),
+                mode,
+            ).encode("utf-8")
+            for j in py_idx.tolist()
+        ]
+        py_buf = np.frombuffer(b"".join(py_bytes), dtype=np.uint8)
+        base = sum(len(p) for p in pieces)
+        off = 0
+        for j, v in zip(py_idx.tolist(), py_bytes):
+            src_base[rows[j]] = base + off
+            new_lens[rows[j]] = len(v)
+            off += len(v)
+        pieces.append(py_buf)
+
+    combined = np.concatenate(pieces) if len(pieces) > 1 else data
+    new_off = np.zeros_like(offsets)
+    np.cumsum(new_lens, out=new_off[1:])
+    new_total = int(new_off[-1])
+    out_idx = np.repeat(src_base - new_off[:-1], new_lens) + np.arange(
+        new_total, dtype=np.int64
+    )
+    return combined[out_idx], new_off
+
+
+def _column_to_arrow(
+    result: "BatchResult", field_id: str, flat: Optional[Any] = None
+):
     import pyarrow as pa
 
     col = result.column(field_id)
@@ -89,17 +270,11 @@ def _column_to_arrow(result: "BatchResult", field_id: str):
 
     # Device span columns with no host overrides: build the StringArray
     # straight from (offsets, gathered bytes) with numpy — no per-row
-    # Python.  Falls through to the slow path for override rows (host
-    # fallback), rows needing URI micro-materialization (`fix`), wildcard
-    # maps, and non-UTF-8 data.
-    fix = col.get("fix")
-    if (
-        kind == "span"
-        and not field_id.endswith(".*")
-        and not overrides
-        and (fix is None or not fix[: result.lines_read].any())
-    ):
-        arr = _spans_to_string_array(result, field_id)
+    # Python; URI-repair (`fix`) rows are spliced in individually.  Falls
+    # through to the slow path for override rows (host fallback),
+    # wildcard maps, and non-UTF-8 data.
+    if kind == "span" and not field_id.endswith(".*") and not overrides:
+        arr = _spans_to_string_array(result, field_id, flat)
         if arr is not None:
             return arr
 
@@ -120,6 +295,35 @@ def _column_to_arrow(result: "BatchResult", field_id: str):
             ],
             type=pa.map_(pa.string(), pa.string()),
         )
+
+    # Host-delivered obj columns (GeoIP range-join results, muid decodes):
+    # the values already sit in an object ndarray of Python str/int/float —
+    # mask the dead rows vectorized and let pyarrow's C-level inference
+    # build the array; only mixed-type columns fall back to the per-row
+    # stringify path below.
+    if kind == "obj":
+        vals = np.asarray(col["values"], dtype=object)[:B]
+        dead = ~(
+            np.asarray(result.valid[:B], dtype=bool)
+            & np.asarray(col["ok"][:B], dtype=bool)
+        )
+        if dead.any() or overrides:
+            vals = vals.copy()
+            vals[dead] = None
+            for row, v in overrides.items():
+                vals[row] = v
+        try:
+            arr = pa.array(vals, from_pandas=True)
+            # Keep the batch-to-batch schema stable: an all-null batch
+            # must stay a string column (as the per-row path types it),
+            # not pa.null() — pa.concat_tables across batches depends on
+            # it.  Booleans likewise stringify on the per-row path.
+            if not (
+                pa.types.is_null(arr.type) or pa.types.is_boolean(arr.type)
+            ):
+                return arr
+        except (pa.ArrowInvalid, pa.ArrowTypeError):
+            pass  # mixed types: per-row inference below
 
     # Host-delivered / span columns: type from the materialized values
     # (host-path numerics — e.g. dissector-produced numbers like GeoIP
@@ -144,10 +348,17 @@ def batch_to_arrow(result: "BatchResult", include_validity: bool = True):
     """BatchResult -> pyarrow.Table (one column per requested field)."""
     import pyarrow as pa
 
+    # One threaded multi-column gather covers every flat-eligible span
+    # column; ineligible columns (overrides/fix/wildcards) fall through
+    # to their per-column paths inside _column_to_arrow.
+    flats = result.span_bytes_many(
+        [f for f in result.field_ids() if not f.endswith(".*")],
+        include_fix=True,
+    )
     arrays = []
     names = []
     for field_id in result.field_ids():
-        arrays.append(_column_to_arrow(result, field_id))
+        arrays.append(_column_to_arrow(result, field_id, flats.get(field_id)))
         names.append(field_id)
     if include_validity:
         arrays.append(pa.array(np.asarray(result.valid, dtype=bool)))

@@ -405,6 +405,20 @@ class BatchResult:
             if format_index is not None
             else np.full(self.lines_read, -1, dtype=np.int64)
         )
+        self._ascii_only: Optional[bool] = None
+
+    @property
+    def ascii_only(self) -> bool:
+        """True when every byte of the batch buffer is < 0x80 — then any
+        gathered span is trivially valid UTF-8 and the Arrow bridge can
+        skip its per-column validate pass.  One SIMD max over the buffer,
+        computed lazily and cached for the batch."""
+        if self._ascii_only is None:
+            B = self.lines_read
+            self._ascii_only = bool(
+                B == 0 or int(self.buf[:B].max(initial=0)) < 0x80
+            )
+        return self._ascii_only
 
     def field_ids(self) -> List[str]:
         return list(self._columns.keys())
@@ -466,13 +480,27 @@ class BatchResult:
         per-row path (:meth:`to_pylist`)."""
         from ..native import gather_spans
 
+        inputs = self._span_flat_inputs(field_id)
+        if inputs is None:
+            return None
+        starts, lens, valid = inputs
+        B = self.lines_read
+        data, offsets = gather_spans(self.buf[:B], starts, lens)
+        self._amp_normalize(field_id, data, offsets, lens, valid)
+        return data, offsets, valid
+
+    def _span_flat_inputs(self, field_id: str, include_fix: bool = False):
+        """(starts, lens, valid) for a flat-gather-eligible span column;
+        None when the column needs the per-row path (overrides, repair
+        rows unless ``include_fix`` — the Arrow bridge gathers those raw
+        and splices the repaired values in afterwards)."""
         field_id = cleanup_field_value(field_id)
         col = self._columns[field_id]
         if col["kind"] != "span" or self._overrides.get(field_id):
             return None
         B = self.lines_read
         fix = col.get("fix")
-        if fix is not None and fix[:B].any():
+        if not include_fix and fix is not None and fix[:B].any():
             return None
         valid = (
             np.asarray(self.valid[:B]).astype(bool)
@@ -483,14 +511,51 @@ class BatchResult:
         lens = np.where(
             valid, np.asarray(col["ends"][:B]) - starts, 0
         ).astype(np.int64)
-        data, offsets = gather_spans(self.buf[:B], starts, lens)
+        return starts, lens, valid
+
+    def _amp_normalize(self, field_id, data, offsets, lens, valid) -> None:
+        """In-place ?& query normalization on gathered bytes (offsets are
+        column-local, length B+1)."""
+        col = self._columns[cleanup_field_value(field_id)]
         amp = col.get("amp")
+        B = self.lines_read
         if amp is not None and amp[:B].any():
             swap = valid & np.asarray(amp[:B]).astype(bool) & (lens > 0)
             at = offsets[:-1][swap]
             at = at[data[at] == np.uint8(ord("?"))]
-            data[at] = np.uint8(ord("&"))  # the ?& query normalization
-        return data, offsets, valid
+            data[at] = np.uint8(ord("&"))
+
+    def span_bytes_many(self, field_ids, include_fix: bool = False):
+        """Gather several span columns in ONE native call.
+
+        Returns {field_id: (data_view, offsets, valid)} covering the
+        subset of ``field_ids`` eligible for the flat path (same
+        eligibility as :meth:`span_bytes`, except repair rows when
+        ``include_fix``); ineligible columns are simply absent.  The
+        threaded memcpy fan-out is paid once per batch instead of once
+        per column — the difference between ~3M and ~7M rows/s through
+        the Arrow bridge at 16k-row batches."""
+        from ..native import gather_spans_multi
+
+        B = self.lines_read
+        elig = []
+        for fid in field_ids:
+            inputs = self._span_flat_inputs(fid, include_fix=include_fix)
+            if inputs is not None:
+                elig.append((cleanup_field_value(fid), inputs))
+        if not elig:
+            return {}
+        starts = np.stack([e[1][0] for e in elig])
+        lens = np.stack([e[1][1] for e in elig])
+        data, goff = gather_spans_multi(self.buf[:B], starts, lens)
+        out = {}
+        for k, (fid, (_s, lens_k, valid_k)) in enumerate(elig):
+            base = goff[k * B]
+            offsets = goff[k * B : k * B + B + 1] - base
+            col_data = data[base : int(goff[(k + 1) * B])]
+            self._amp_normalize(fid, col_data, offsets, lens_k, valid_k)
+            out[fid] = (col_data, offsets, valid_k)
+        return out
 
     def to_arrow(self, include_validity: bool = True):
         """Materialize as a pyarrow.Table (see tpu/arrow_bridge.py)."""

@@ -84,6 +84,50 @@ class TestGatherSpans:
             want = bytes(buf[r, starts[r]:starts[r] + lens[r]])
             assert got == want, r
 
+    def test_multi_matches_single(self):
+        from logparser_tpu import native
+
+        rng = np.random.default_rng(11)
+        B, L, K = 193, 80, 5
+        buf = rng.integers(32, 127, size=(B, L), dtype=np.uint8)
+        starts = rng.integers(0, L // 2, size=(K, B)).astype(np.int32)
+        lens = rng.integers(0, L // 2, size=(K, B)).astype(np.int64)
+        lens[:, ::5] = 0
+        data, goff = native.gather_spans_multi(buf, starts, lens)
+        assert goff[-1] == lens.sum()
+        for k in range(K):
+            d1, o1 = native.gather_spans(buf, starts[k], lens[k])
+            base = goff[k * B]
+            off_k = goff[k * B : k * B + B + 1] - base
+            dk = data[base : int(goff[(k + 1) * B])]
+            assert (off_k == o1).all()
+            assert bytes(dk) == bytes(d1)
+
+    def test_batchresult_span_bytes_many(self):
+        from logparser_tpu.tpu.batch import TpuBatchParser
+
+        fids = [
+            "HTTP.USERAGENT:request.user-agent",
+            "HTTP.METHOD:request.firstline.method",
+            "STRING:request.status.last",
+        ]
+        p = TpuBatchParser("combined", fids)
+        lines = [
+            '1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET /x HTTP/1.1" '
+            f'200 5 "-" "agent/{i}"'
+            for i in range(23)
+        ]
+        result = p.parse_batch(lines)
+        flats = result.span_bytes_many(fids)
+        assert len(flats) == len(fids)
+        for fid in fids:
+            key = [k for k in flats if fid.endswith(k)][0]
+            data, offsets, valid = flats[key]
+            s_data, s_off, s_valid = result.span_bytes(fid)
+            assert (np.asarray(offsets) == s_off).all()
+            assert bytes(data) == bytes(s_data)
+            assert (valid == s_valid).all()
+
     def test_batchresult_span_bytes(self):
         from logparser_tpu.tpu.batch import TpuBatchParser
 
