@@ -25,8 +25,13 @@ from ..core.exceptions import DissectionFailure
 
 # Bytes that URIUtil.encode must escape: control, space, unwise, <>", 0xFF
 # (HttpUriDissector.java:111-121 builds the allowed set; this is its complement).
-_ENCODE_BYTES = set(range(0x00, 0x20)) | {0x7F, 0x20, 0xFF}
-_ENCODE_BYTES |= {ord(c) for c in '{}|\\^[]`<>"'}
+# ENCODE_PRINTABLE is the printable subset the DEVICE tier models without the
+# oracle (postproc.split_uri_fast / split_csr masks, arrow_bridge splice) —
+# those masks are built from THIS constant so the device/host bit-exactness
+# argument cannot drift when the set changes.
+ENCODE_PRINTABLE = b' {}|\\^[]`<>"'
+_ENCODE_BYTES = set(range(0x00, 0x20)) | {0x7F, 0xFF}
+_ENCODE_BYTES |= set(ENCODE_PRINTABLE)
 
 _BAD_ESCAPE_PATTERN = re.compile("%([^0-9a-fA-F]|[0-9a-fA-F][^0-9a-fA-F]|.$|$)")
 _EQUALS_HASH_PATTERN = re.compile("=#")

@@ -85,6 +85,15 @@ for _c in b"abcdef":
 for _c in b"ABCDEF":
     _HEX_VAL[_c] = _c - ord("A") + 10
 _IS_HEX = _HEX_VAL >= 0
+# Printable URI encode-set bytes (postproc.split_uri_fast's `enc`): the
+# host %-escapes these before any other repair stage.  Built from the
+# host dissector's own constant so device and host cannot drift.
+from ..dissectors.uri import ENCODE_PRINTABLE as _ENCODE_PRINTABLE
+
+_IS_ENC = np.zeros(256, dtype=bool)
+for _c in _ENCODE_PRINTABLE:
+    _IS_ENC[_c] = True
+_HEX_UPPER = np.frombuffer(b"0123456789ABCDEF", dtype=np.uint8)
 
 
 def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid):
@@ -157,17 +166,22 @@ def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid)
     # - In path mode, repairing a bad escape then decoding it
     #   (%zz -> %25zz -> %zz) is the identity, so bad escapes simply stay
     #   literal and only good %XX escapes substitute their byte.
+    enc = _IS_ENC[seg]
     py_rows = row_any(seg >= 0x80)
-    if mode == "path":
+    if mode in ("path", "userinfo"):
+        # Decoding modes: good %XX escapes substitute their byte; bad
+        # escapes stay literal (the %25-repair and the later decode
+        # cancel); encode-set bytes are an encode->decode identity.
         dec = ((_HEX_VAL[nxt1] << 4) | np.maximum(_HEX_VAL[nxt2], 0)).astype(
             np.int16
         )
         py_rows |= row_any(good & (dec >= 0x80))
         vec_changed = row_any(good) & ~py_rows
     else:
-        # Repair-only mode: well-formed escapes are untouched; only rows
-        # with bad escapes change.
-        vec_changed = row_any(bad) & ~py_rows
+        # Escaping modes (query): well-formed escapes are untouched; bad
+        # escapes gain a '25' insertion and encode-set bytes expand to
+        # their uppercase %XX triple.
+        vec_changed = row_any(bad | enc) & ~py_rows
 
     py_idx = np.nonzero(py_rows)[0]
     changed_local = np.nonzero(vec_changed | py_rows)[0]
@@ -179,7 +193,7 @@ def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid)
     new_lens = lens.copy()
     if vec_changed.any():
         in_vec = vec_changed[row_id]
-        if mode == "path":
+        if mode in ("path", "userinfo"):
             # Drop the two hex tail bytes of each good escape, replace
             # the '%' with the decoded byte.
             g = good & in_vec
@@ -190,19 +204,24 @@ def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid)
             new_seg = np.where(g, dec.astype(np.uint8), seg)[keep]
             row_counts = np.bincount(row_id[keep], minlength=n_rows)
         else:
-            # Simultaneous bad-escape rewrite: every bad '%' expands to
-            # three output bytes ('%' repeated, then patched to %25).
+            # Simultaneous bad-escape rewrite + encode: a bad '%' expands
+            # to '%25', an encode-set byte to its uppercase '%XX' triple.
             sel = in_vec
             sv = seg[sel]
             bv = (bad & in_vec)[sel]
+            ev = (enc & in_vec)[sel]
             rid_v = row_id[sel]
-            counts = np.where(bv, 3, 1).astype(np.int64)
+            counts = np.where(bv | ev, 3, 1).astype(np.int64)
             out_pos = np.zeros(sv.size + 1, dtype=np.int64)
             np.cumsum(counts, out=out_pos[1:])
             new_seg = np.repeat(sv, counts)
             ins = out_pos[:-1][bv]
             new_seg[ins + 1] = ord("2")
             new_seg[ins + 2] = ord("5")
+            ein = out_pos[:-1][ev]
+            new_seg[ein] = ord("%")
+            new_seg[ein + 1] = _HEX_UPPER[sv[ev] >> 4]
+            new_seg[ein + 2] = _HEX_UPPER[sv[ev] & 0x0F]
             row_counts = np.bincount(
                 rid_v, weights=counts, minlength=n_rows
             ).astype(np.int64)

@@ -81,13 +81,20 @@ def _default_use_pallas() -> bool:
 def _fix_uri_part(value: str, mode: str) -> str:
     """Per-row URI micro-materialization for device `fix` rows: the exact
     host repair semantics, applied to one sub-span instead of re-parsing
-    the whole line (HttpUriDissector.java:166-167 %-repair; java.net.URI
-    path decode).  The %-repair runs twice like the host (overlaps)."""
-    from ..dissectors.uri import _BAD_ESCAPE_PATTERN, _percent_decode
+    the whole line (HttpUriDissector.java:111-121 encode, :166-167
+    %-repair; java.net.URI path/userinfo decode).  The encode step is
+    byte-local, so running it on the sub-span equals running it on the
+    whole URI; the %-repair runs twice like the host (overlaps)."""
+    from ..dissectors.uri import (
+        _BAD_ESCAPE_PATTERN,
+        _encode_bad_uri_chars,
+        _percent_decode,
+    )
 
+    value = _encode_bad_uri_chars(value)
     value = _BAD_ESCAPE_PATTERN.sub(r"%25\1", value)
     value = _BAD_ESCAPE_PATTERN.sub(r"%25\1", value)
-    if mode == "path":
+    if mode in ("path", "userinfo"):
         value = _percent_decode(value)
     return value
 

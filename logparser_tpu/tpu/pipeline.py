@@ -608,7 +608,7 @@ def compute_rows(
             if part == "userinfo":
                 return (
                     uri["userinfo_start"], uri["userinfo_end"], step_ok,
-                    uri["userinfo_null"], false_b, false_b,
+                    uri["userinfo_null"], false_b, uri["userinfo_fix"],
                 )
             if part == "host":
                 return (
@@ -775,6 +775,11 @@ def compute_rows(
                 b32, s, e, layout.csr_slots,
                 sep=_CSR_SEPARATORS[plan.meta or "query"],
                 shift_fn=None if shift_fn is shift_zero else shift_fn,
+                # URI-chained query strings pass through the URI encode
+                # step before the host dissector sees them — encode-set
+                # bytes flag the per-row path.  Direct token captures
+                # (nginx $args) and cookies are raw header text: no.
+                uri_encoded=bool(plan.steps) and plan.steps[-1][0] == "uri",
             )
             if not plan.steps:
                 # Direct token capture of the query string: CLF null ->

@@ -183,45 +183,55 @@ def split_uri_fast(
     """Fast-path URI split: repair-free URIs -> sub-spans on device.
 
     Mirrors HttpUriDissector (dissectors/uri.py; HttpUriDissector.java:52-63)
-    for spans the repair chain would pass through unchanged: relative URIs,
-    scheme-less paths, and absolute URLs with a server-based (or cleanly
-    registry-based) authority.  ``clean`` is False whenever ANY repair stage
-    could fire; such lines must be re-parsed by the host oracle (the caller
-    folds ``ok`` into line validity).  Conditions checked:
+    for spans whose repair-chain outcome the device (plus per-row fix
+    materialization) can model exactly: relative URIs, scheme-less paths,
+    absolute URLs with a server-based or registry-based authority
+    (IPv6 ``[...]`` literals included — see the inline note: the encode
+    step makes them registry-based on the host too), and opaque
+    scheme-URIs (``mailto:``).  ``clean`` is False only for rows whose
+    repair stages the device cannot reproduce; those re-parse on the host
+    oracle (the caller folds ``ok`` into line validity):
 
-    - no byte the URIUtil encode step would %-escape (control, space, DEL,
-      0xFF, ``{}|\\^[]`<>"``),
-    - no ``#`` (fragment handling, =#/#&/double-# artifacts rewrite),
-    - no ``;`` (sound over-approximation of the HTML-entity unescape:
+    - bytes >= 0x7F or < 0x20 (the host passes high bytes through
+      byte-to-latin-1 mojibake-preserving, which a UTF-8 span decode
+      cannot reproduce),
+    - ``#`` (fragment handling, =#/#&/double-# artifacts rewrite),
+    - ``;`` (sound over-approximation of the HTML-entity unescape:
       every entity needs a ``;``),
-    - at most one ``?``, and only as the first query-separator occurrence
-      (otherwise the ?->& normalization rewrites bytes inside the span).
+    - more than one ``?``, or a ``?`` that is not the first
+      query-separator occurrence (the ?->& normalization would rewrite
+      bytes inside the span),
+    - a scheme that fails ``[A-Za-z][A-Za-z0-9+.-]*`` (raises on the
+      host — the oracle rejects the line identically),
+    - an absolute URL with an actual digits-only port longer than 18
+      digits (the host parses it with arbitrary precision).
 
     Absolute URLs (JavaUri semantics, dissectors/uri.py:105-168): scheme =
-    up to the first ``:`` when it precedes any ``/``/separator and matches
-    ``[A-Za-z][A-Za-z0-9+.-]*`` (an invalid scheme raises on the host, so
-    those rows go to the oracle, which rejects them identically); a
-    ``://`` introduces an authority ending at the next ``/`` or query
-    separator; the LAST ``@`` splits userinfo; the last ``:`` in the
-    remainder splits a digits-only port.  A non-server authority (host
-    charset outside ``[A-Za-z0-9.-]``, or a non-numeric port) is
-    registry-based: userinfo/host/port are all null, path/query still
-    deliver.  Rows the device cannot model exactly take the oracle:
-    IPv6 ``[...]`` literals, ``%`` anywhere before the path (userinfo is
-    percent-decoded on the host), opaque URIs (scheme without ``//``),
-    and ports longer than 18 digits.  Scheme-less spans not starting with
-    ``/`` ("example.com/x") have no authority: the whole head is path,
-    protocol/userinfo/host/port null (exactly _URI_SPLIT's behavior).
+    up to the first ``:`` when it precedes any ``/``/separator; a ``://``
+    introduces an authority ending at the next ``/`` or query separator;
+    the LAST ``@`` splits userinfo; the last ``:`` in the remainder splits
+    a digits-only port.  A non-server authority (host charset outside
+    ``[A-Za-z0-9.-]`` — which covers ``%`` and every encode-set byte, so
+    IPv6 literals and %-escaped hosts land here — or a non-numeric port)
+    is registry-based: userinfo/host/port are all null, path/query still
+    deliver.  Opaque URIs deliver protocol + path (``first_colon+1`` to
+    the first separator) + query; authority parts are null.  Scheme-less
+    spans not starting with ``/`` ("example.com/x") have no authority:
+    the whole head is path, protocol/userinfo/host/port null (exactly
+    _URI_SPLIT's behavior).
 
-    Percent signs in path/query do NOT force the oracle: they only flag
-    per-row host micro-materialization (orders of magnitude cheaper than a
-    full oracle re-parse).  ``path_fix`` marks rows whose path contains
-    ``%`` (the host delivers the path percent-DECODED, and bad escapes are
-    first repaired to ``%25``); ``query_fix`` marks rows whose query
-    contains a bad escape (repaired to ``%25``; well-formed query escapes
-    are delivered raw).  The ``%``-repair inserts only the digits ``25``,
-    so it cannot create or destroy separators — span boundaries are
-    unaffected.
+    Percent signs and printable encode-set bytes in path/query/userinfo
+    do NOT force the oracle: they only flag per-row host
+    micro-materialization (orders of magnitude cheaper than a full oracle
+    re-parse).  ``path_fix`` marks rows whose path contains ``%`` (the
+    host delivers the path percent-DECODED after the encode + %25-repair
+    steps; encode-set bytes alone are an encode->decode identity and need
+    no fix).  ``query_fix`` marks rows whose query contains a bad escape
+    (repaired to ``%25``) or an encode-set byte (delivered %-escaped).
+    ``userinfo_fix`` marks rows with ``%`` in the userinfo (the host
+    percent-decodes it).  The ``%``-repair inserts only digits and the
+    encode step only ``%XX`` triples, so neither can create or destroy
+    separators — span boundaries are unaffected.
 
     An empty span — or a lone ``-`` when the caller passes the token-level
     CLF ``dash`` mask — is clean: every output is null (the host dissector
@@ -246,15 +256,30 @@ def split_uri_fast(
     ).astype(jnp.int32)
     first_sep = jnp.minimum(first_sep, end)
 
-    # Encode-set membership (the complement of URIUtil's allowed set).
-    # Everything >= 0x7F is excluded too: the host chain passes raw
-    # high bytes through byte-to-latin-1 (mojibake-preserving), which a
-    # UTF-8 span decode cannot reproduce — those rows take the oracle.
-    bad = (buf <= np.uint8(0x20)) | (buf >= np.uint8(0x7F))
-    for ch in b'{}|\\^[]`<>"':
-        bad = bad | (buf == np.uint8(ch))
+    # Oracle-only bytes: controls and >= 0x7F (the host chain passes raw
+    # high bytes through byte-to-latin-1 — mojibake-preserving — which a
+    # UTF-8 span decode cannot reproduce), '#' (fragment handling and the
+    # =#/#&/double-# rewrites) and ';' (sound over-approximation of the
+    # HTML-entity unescape: every entity needs a ';').
+    bad = (buf < np.uint8(0x20)) | (buf >= np.uint8(0x7F))
     bad = bad | (buf == np.uint8(ord("#"))) | (buf == np.uint8(ord(";")))
     clean = ~jnp.any(bad & in_span, axis=1)
+
+    # Printable encode-set bytes (URIUtil's escape set minus the oracle
+    # bytes above).  These no longer force the oracle: the encode step
+    # %-escapes them, after which (a) in an authority the host charset /
+    # port-digit checks fail exactly as they do on the RAW bytes, so the
+    # registry-based outcome is identical, (b) in the path (and userinfo)
+    # the later percent-DECODE undoes the escape — a byte-identity round
+    # trip — and (c) in the query they are delivered ESCAPED, which the
+    # per-row fix materializer reproduces (fix modes run the encode step
+    # first).
+    from ..dissectors.uri import ENCODE_PRINTABLE
+
+    enc = None
+    for ch in ENCODE_PRINTABLE:
+        m = (buf == np.uint8(ch)) & in_span
+        enc = m if enc is None else (enc | m)
 
     # '?' discipline: at most one, and only at the first separator.
     q_count = jnp.sum(jnp.where(is_q, 1, 0), axis=1)
@@ -353,14 +378,30 @@ def split_uri_fast(
         host_ok_cs = jnp.all(host_cs | ~in_host, axis=1)
         registry = (~host_ok_cs) | (has_pcolon & ~port_empty & ~port_digits)
 
-        # IPv6 '[...]' literals need no dedicated guard: '[' is in the
-        # encode bad-set, so such spans already fail `clean` and take the
-        # oracle.
-        pct_pre = jnp.any(is_pct & (pos < auth_end[:, None]), axis=1)
+        # IPv6 '[...]' literals need no dedicated branch: the host chain
+        # %-escapes '[' and ']' BEFORE java.net.URI ever sees the
+        # authority, so "[::1]" can never take the URI IPv6-literal parse —
+        # the escaped host fails the charset check and the authority is
+        # registry-based (host/userinfo/port null).  On device the RAW
+        # '['/':' bytes fail host_cs / port_digits the same way, landing
+        # on the identical registry outcome.  A '%' in the host or port
+        # region likewise survives the repair ('%25' keeps the '%') and
+        # fails the same checks — no oracle needed.  Userinfo is the one
+        # authority part the host percent-DECODES, so rows with '%' there
+        # flag per-row fix materialization instead.
+        ui_fix = jnp.any(
+            is_pct & (pos >= auth_start[:, None]) & (pos < at[:, None]),
+            axis=1,
+        )
+        # Only an actual >18-digit DIGITS port needs the oracle (the host
+        # parses it with arbitrary precision); a non-digit port region of
+        # any length is just registry-based.
         abs_ok = (
             has_scheme & scheme_ok & dslash
-            & ~pct_pre
-            & ~(has_pcolon & (port_len > MAX_LONG_DIGITS))
+            & ~(
+                has_pcolon & ~port_empty & port_digits
+                & (port_len > MAX_LONG_DIGITS)
+            )
         )
     else:
         # Authority details (userinfo/host/port) are not requested: skip
@@ -375,28 +416,41 @@ def split_uri_fast(
         at = rest_start = host_end = port_start = zero_v
         has_pcolon = port_empty = false_v
         registry = jnp.ones(B, dtype=bool)  # never deliver authority parts
+        ui_fix = false_v
         abs_ok = has_scheme & scheme_ok & dslash
     is_abs = has_scheme & abs_ok & ~all_null
+    # Opaque URIs (scheme but no '//': mailto:, urn:, news:): java.net.URI
+    # leaves the authority None, so protocol + path (+ query past the
+    # first separator) deliver and host/userinfo/port are null
+    # (HttpUriDissector.java:190-199 via the _URI_SPLIT no-authority arm).
+    opaque = has_scheme & scheme_ok & ~dslash & ~all_null
     # Scheme-less, not starting with '/': no authority possible — the whole
     # head is path (protocol/userinfo/host/port null).
     case3 = (~has_scheme) & (~relative) & (~all_null)
-    handled = all_null | relative | case3 | is_abs
+    handled = all_null | relative | case3 | is_abs | opaque
     ok = clean & handled
 
     zero_span = start
     show_auth = is_abs & ~registry
-    path_begin = jnp.where(is_abs, auth_end, start)
+    path_begin = jnp.where(
+        is_abs, auth_end, jnp.where(opaque, first_colon + 1, start)
+    )
     path_fix = jnp.any(
         is_pct & (pos >= path_begin[:, None]) & (pos < first_sep[:, None]),
         axis=1,
     )
-    query_fix = jnp.any(pct_bad & (pos >= first_sep[:, None]), axis=1)
+    # Query rows change under the host chain when they hold a bad escape
+    # (repaired to %25) OR an encode-set byte (delivered %-ESCAPED — the
+    # query, unlike the path, is never percent-decoded).
+    query_fix = jnp.any(
+        (pct_bad | enc) & (pos >= first_sep[:, None]), axis=1
+    )
     has_query = (~all_null) & (first_sep < end)
 
     def span(show, s, e):
         return jnp.where(show, s, zero_span), jnp.where(show, e, zero_span)
 
-    proto_s, proto_e = span(is_abs, start, first_colon)
+    proto_s, proto_e = span(is_abs | opaque, start, first_colon)
     ui_show = show_auth & has_at
     ui_s, ui_e = span(ui_show, auth_start, at)
     host_s, host_e = span(show_auth, rest_start, host_end)
@@ -414,10 +468,11 @@ def split_uri_fast(
         "query_amp": has_query,
         "proto_start": proto_s,
         "proto_end": proto_e,
-        "proto_null": all_null | ~is_abs,
+        "proto_null": all_null | ~(is_abs | opaque),
         "userinfo_start": ui_s,
         "userinfo_end": ui_e,
         "userinfo_null": all_null | ~ui_show,
+        "userinfo_fix": ui_fix & ui_show,
         "host_start": host_s,
         "host_end": host_e,
         "host_null": all_null | ~show_auth,
@@ -495,6 +550,7 @@ def split_csr(
     sep: bytes = b"&",
     kv: int = ord("="),
     shift_fn=None,
+    uri_encoded: bool = False,
 ) -> Dict[str, object]:
     """CSR segment split of spans on device: the vectorized core of the
     wildcard dissectors (QueryStringFieldDissector.java:76-108 splits on
@@ -528,6 +584,18 @@ def split_csr(
     ) & in_span
 
     is_pct = (buf == np.uint8(ord("%"))) & in_span
+    if uri_encoded:
+        # Query strings reach the host dissector AFTER the URI encode
+        # step, so segments holding printable encode-set bytes differ
+        # from the raw device span (names stay %-escaped-and-lowercased,
+        # values escape then resilient-decode) — flag them for the
+        # per-row path alongside %/+.
+        from ..dissectors.uri import ENCODE_PRINTABLE
+
+        for ch in ENCODE_PRINTABLE:
+            m = (buf == np.uint8(ch)) & in_span
+            is_dec = is_dec | m
+            is_pct = is_pct | m
 
     seg_start: list = []
     seg_end: list = []

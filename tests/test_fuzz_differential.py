@@ -41,6 +41,11 @@ TOKEN_POOL = [
          rng.choice([
              "/", "/a/b.html", "/x?q=1&r=2", "/p%20q", "/broken=50%-off",
              "/deep/path/with/много/utf8", "/q?a=%%%",
+             # Round-3 device surfaces: encode-set bytes in path/query,
+             # bracketed segments, spaces, opaque/absolute firstline URIs.
+             "/a[1].jpg", "/x?k=[v]&s=^1^", "/a%20b?c=d%zze",
+             "http://[2001:db8::1]:8080/dev?q=1", "mailto:someone@ex.com",
+             "/sp ace?b c=d e", "/t?quote=`cmd`",
          ]),
          rng.randint(0, 1),
      )),
@@ -119,9 +124,15 @@ TOKEN_POOL = [
          "http://my_host/reg", "HTTP://UP.CASE/k", "example.com/bare",
          "mailto:a@b.c", "http://[::1]/v6", "ftp://f.io:2121/f",
          "http://h.com?only=query", "/relative/ref?z=1",
-         "http://x.y/p q",              # space: encode-repair oracle route
+         "http://x.y/p q",              # space: now device via encode model
          "https://a.b/c?d=e#f",         # fragment through the header URI
          "http://h.com/" + "&".join(f"q{i}={i}" for i in range(18)),
+         # Round-3 device surfaces: IPv6/opaque/%-authority/encode bytes.
+         "http://[2001:db8::1]:8080/p?q=1", "http://user@[::1]:80/p",
+         "news:comp.lang?x=1", "urn:a%41b", "http:",
+         "http://u%41ser@ex.com:80/p", "http://ex%41mple.com/p",
+         "http://ex.com:8%410/p", "http://ex.com:123456789012345678901/p",
+         "http://ex.com/a[1].jpg?x=[1]", "ex.com:8080/opaque-ish",
      ])),
 ]
 

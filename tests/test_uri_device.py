@@ -106,15 +106,15 @@ class TestDeviceUriSplit:
         "http://:8080/empty-host",
         "http://host?q=no-path",
         "http://host&amp-in-authority/x",
-        "http://[::1]:80/ipv6",               # oracle: IPv6 literal
-        "mailto:someone@example.com",         # oracle: opaque (no //)
+        "http://[::1]:80/ipv6",               # device: registry-based (r3)
+        "mailto:someone@example.com",         # device: opaque (r3)
         "1http://bad.scheme/x",               # oracle: invalid scheme -> bad line
         "http//missing.colon/x",
         "example.com/no/scheme?y=2",
-        "a:b",                                # opaque -> oracle
+        "a:b",                                # device: opaque (r3)
         ":leading-colon",
-        "http://enc%41oded.host/x",           # oracle: % before path
-        "http://user%40x@host/x",             # oracle: % in userinfo
+        "http://enc%41oded.host/x",           # device: registry-based (r3)
+        "http://user%40x@host/x",             # device: userinfo fix row (r3)
         "http://host:123456789012345678901/x",  # >18-digit port -> oracle
         "http://host/%41path?with=%2Fenc",
         "scheme+ext.1://host.name/x",
@@ -229,3 +229,59 @@ class TestDeviceUriSplit:
         assert result.to_pylist("HTTP.PORT:request.firstline.uri.port") == [
             None, 8443, None, None, None,
         ]
+
+
+class TestRound3DeviceCoverage:
+    """VERDICT round-2 item 2: IPv6 literals, opaque scheme-URIs,
+    %-before-path and printable encode-set bytes must be DEVICE-resident
+    (oracle_fraction 0.0) and bit-exact vs the host chain."""
+
+    POOL = [
+        "http://[2001:db8::1]:8080/p?q=1",
+        "http://[::1]/p",
+        "http://[::1]",
+        "http://[::1]x/p",
+        "http://user@[::1]:80/p",
+        "mailto:foo@bar.com",
+        "news:comp.lang?x=1",
+        "urn:a%41b",
+        "urn:a%zzb",
+        "mailto:a&b=1",
+        "http:",
+        "http://u%41ser@ex.com:80/p",
+        "http://u%zz@ex.com/p",
+        "http://ex%41mple.com/p",
+        "http://ex.com:8%410/p",
+        "http://ex.com/a[1].jpg",
+        "http://ex.com/a?x=[1]",
+        "/a b/c",
+        "/a?x=b c",
+        "ex.com:8080/x",
+        "/a?x=^1^",
+        "/pi|pe?a=|b|",
+        "/tick`t?c=`d`",
+    ]
+
+    def test_pool_is_device_resident(self):
+        parser = TpuBatchParser("common", FIELDS)
+        result = parser.parse_batch(make_lines(self.POOL))
+        assert result.oracle_rows == 0
+        assert all(result.valid)
+
+    def test_pool_matches_oracle(self):
+        parser = TpuBatchParser("common", FIELDS)
+        assert_matches(parser, make_lines(self.POOL))
+
+    def test_fuzzed_mixed_pool(self):
+        rng = random.Random(31337)
+        atoms = [
+            "[2001:db8::1]", "[::1]", "ex.com", "u@h", "u%41@h", "h|i",
+        ]
+        schemes = ["http://", "mailto:", "news:", "", "urn:"]
+        paths = ["/a[0]", "/p q", "/x?y=[z]", "?a=^b^", "/pl", ""]
+        uris = [
+            rng.choice(schemes) + rng.choice(atoms) + rng.choice(paths)
+            for _ in range(200)
+        ]
+        parser = TpuBatchParser("common", FIELDS)
+        assert_matches(parser, make_lines(uris))
