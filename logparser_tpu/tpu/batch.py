@@ -645,14 +645,18 @@ class TpuBatchParser:
 
         # Device programs: one FormatUnit per registered format, in
         # registration order (SURVEY §7.7 "run k format automata, pick the
-        # per-line winner").  Only the compilable PREFIX of the format list
-        # runs on device: a line must never be claimed by format k while an
-        # earlier, uncompilable format j < k would also have matched it —
-        # stopping at the first uncompilable format preserves the reference's
-        # registration-priority semantics; the rest is oracle territory.
+        # per-line winner").  An UNCOMPILABLE format does not truncate the
+        # list: it contributes a plausibility-only probe unit
+        # (separator-order automaton, valid bit never set) at its ordinal,
+        # so (a) later compilable formats still run on device, and (b) a
+        # line is never claimed by format k while the uncompilable format
+        # j < k is still plausible — those lines go to the oracle, which
+        # applies the reference's registration-priority semantics
+        # (HttpdLogFormatDissector.java:174-204) with the real regexes.
         fmt = self.oracle.all_dissectors[0]
         dissectors = getattr(fmt, "dissectors", [fmt])
         from .pipeline import CSR_SLOTS
+        from .program import compile_plausibility_program
 
         self.csr_slots = CSR_SLOTS
         self.units: List[FormatUnit] = []
@@ -660,16 +664,20 @@ class TpuBatchParser:
             try:
                 prog = compile_device_program(d)
             except UnsupportedFormatError:
-                break
+                self.units.append(FormatUnit(
+                    compile_plausibility_program(d), [],
+                    PackedLayout.for_plans([], self.csr_slots),
+                    plausibility_only=True,
+                ))
+                continue
             plans = [self._resolve(prog, fid) for fid in self.requested]
             self.units.append(FormatUnit(
                 prog, plans, PackedLayout.for_plans(plans, self.csr_slots)
             ))
         assign_row_offsets(self.units)
         # The definitely-bad filter (implausible for every format -> no
-        # oracle visit) is only sound when EVERY registered format has a
-        # device automaton; an uncompilable format lives oracle-side and
-        # could still accept a device-implausible line.
+        # oracle visit) is sound because EVERY registered format has a
+        # device automaton — full or plausibility-only probe.
         self._device_covers_all_formats = len(self.units) == len(dissectors)
 
         # Merged per-field plan: the first non-host kind across formats (used

@@ -870,6 +870,11 @@ class FormatUnit:
     plans: List[FieldPlan]
     layout: PackedLayout
     row_offset: int = 0
+    # True for an uncompilable format's separator-order probe
+    # (compile_plausibility_program): its single row carries ONLY the
+    # plausibility bit — the valid bit stays 0, so it can never claim a
+    # line, only contest later formats' claims.
+    plausibility_only: bool = False
 
     def plan_for(self, field_id: str) -> FieldPlan:
         for p in self.plans:
@@ -907,6 +912,14 @@ def compute_units_rows(
         # "implausible for all formats" as a sound definitely-bad filter —
         # regex-accept implies plausible, so such lines skip the per-line
         # oracle re-parse entirely.
+        if u.plausibility_only:
+            # Uncompilable format: one row, plausible bit only (bit 1);
+            # the valid bit is never set so the probe cannot win a line.
+            _, _, _, plausible = compute_split(
+                u.program, buf, lengths, shift_fn, need_plausible=True
+            )
+            rows.append(jnp.where(plausible, 2, 0).astype(jnp.int32))
+            continue
         rows.extend(compute_rows(
             u.program, u.plans, u.layout, buf, lengths, shift_fn,
             need_plausible=True,

@@ -264,6 +264,14 @@ def compile_device_program(dissector: TokenFormatDissector) -> DeviceProgram:
                            max_len, narrow))
         i += 1
 
+    return _finish_program(dissector, ops, specs)
+
+
+def _finish_program(
+    dissector: TokenFormatDissector,
+    ops: List[SplitOp],
+    specs: List[TokenSpec],
+) -> DeviceProgram:
     charset_names = sorted({s.charset for s in specs} | {CS_ANY})
     charset_ids = {name: idx for idx, name in enumerate(charset_names)}
     table = np.stack([_charset_bytes(name) for name in charset_names])
@@ -277,3 +285,55 @@ def compile_device_program(dissector: TokenFormatDissector) -> DeviceProgram:
         charset_ids=charset_ids,
         max_lit_len=max_lit,
     )
+
+
+def compile_plausibility_program(
+    dissector: TokenFormatDissector,
+) -> DeviceProgram:
+    """Separator-order program for a format compile_device_program rejects.
+
+    Used ONLY for the plausibility bit (multi-format registration-priority
+    contest + the definitely-bad filter), never for value capture.  The
+    constructs that make a format uncompilable — adjacent value tokens
+    with no separator — collapse into ONE ``CS_ANY`` capture, which keeps
+    every literal separator in order.  Plausibility's contract
+    (regex-accept implies plausible, compute_split docstring) survives
+    the collapse: it only needs charset >= regex and separator
+    subsequence existence, and ``CS_ANY`` is a superset of everything.
+    An empty format compiles to a zero-op program whose plausibility is
+    True everywhere (sound: over-approximation)."""
+    tokens = dissector.log_format_tokens
+    ops: List[SplitOp] = []
+    specs: List[TokenSpec] = []
+    i = 0
+    n = len(tokens)
+    while i < n:
+        tok = tokens[i]
+        if isinstance(tok, FixedStringToken):
+            ops.append(SplitOp("lit", tok.regex.encode("utf-8")))
+            i += 1
+            continue
+        # A run of adjacent value tokens becomes one CS_ANY capture; a
+        # single value token keeps its real charset (better final-to_end
+        # anchoring; still a superset of the regex language).
+        j = i
+        while j < n and not isinstance(tokens[j], FixedStringToken):
+            j += 1
+        if j - i == 1:
+            charset, min_len, max_len, narrow = _token_charset(tok)
+        else:
+            charset, min_len, max_len, narrow = CS_ANY, 0, 0, False
+        spec = TokenSpec(len(specs), charset, min_len, max_len, narrow, [])
+        specs.append(spec)
+        if j < n:
+            nxt = tokens[j]
+            ops.append(
+                SplitOp("until_lit", nxt.regex.encode("utf-8"),
+                        spec.index, charset, min_len, max_len, narrow)
+            )
+            i = j + 1
+        else:
+            ops.append(SplitOp("to_end", b"", spec.index, charset, min_len,
+                               max_len, narrow))
+            i = j
+    return _finish_program(dissector, ops, specs)

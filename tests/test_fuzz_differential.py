@@ -227,6 +227,31 @@ def test_random_format_device_matches_oracle(seed):
     assert_device_matches_oracle(log_format, fields, lines, f"seed={seed}")
 
 
+# An uncompilable format (adjacent value tokens) registered FIRST: later
+# formats keep their device path, and the registration-priority contest
+# against the probe's plausibility bit must stay bit-exact (VERDICT
+# round-2 item 3; HttpdLogFormatDissector.java:174-204).
+UNCOMPILABLE_FMT = "%h%l %u %>s"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_uncompilable_first_format_device_matches_oracle(seed):
+    rng = random.Random(3000 + seed)
+    log_format, fields, lines = make_case(3000 + seed)
+    log_format = UNCOMPILABLE_FMT + "\n" + log_format
+    fields = sorted(set(fields) | {"STRING:request.status.last"})
+    # Mix in lines of the uncompilable shape (oracle territory) and lines
+    # contested between the shapes.
+    extra = [
+        f"7.7.7.{rng.randint(1, 254)} u{rng.randint(0, 9)} "
+        f"{rng.randint(100, 599)}"
+        for _ in range(8)
+    ]
+    assert_device_matches_oracle(
+        log_format, fields, lines + extra, f"unc-seed={seed}"
+    )
+
+
 # --------------------------------------------------------------------------
 # NGINX $-variable fuzzing (same contract, the other dialect)
 # --------------------------------------------------------------------------

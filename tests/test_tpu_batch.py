@@ -488,13 +488,13 @@ class TestDefinitelyBadFilter:
         ua = result.to_pylist("HTTP.USERAGENT:request.user-agent")
         assert ua[0] == "ua" == ua[1]
 
-    def test_uncompilable_format_disables_oracle_skip(self):
+    def test_uncompilable_format_gets_plausibility_probe(self):
         # A format the device cannot compile ("%h%m": adjacent value
-        # tokens) lives oracle-side; lines only IT accepts must still
-        # reach the oracle even though every DEVICE format finds them
-        # implausible.
+        # tokens) contributes a plausibility-only probe unit; lines only
+        # IT accepts must still reach the oracle.
         batch = TpuBatchParser("combined\n%h%m", ["IP:connection.client.host"])
-        assert len(batch.units) < 2  # second format is off-device
+        assert len(batch.units) == 2
+        assert [u.plausibility_only for u in batch.units] == [False, True]
         lines = [
             '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /x HTTP/1.1" '
             '200 5 "-" "-"',
@@ -513,6 +513,62 @@ class TestDefinitelyBadFilter:
             if ok:
                 assert vals[i] == rec.values.get("IP:connection.client.host")
         assert result.valid[1]  # the %h%m line survived via the oracle
+
+    def test_uncompilable_format_does_not_truncate_later_formats(self):
+        # VERDICT round-2 item 3: a compilable format listed AFTER an
+        # uncompilable one keeps its device path — only lines plausible
+        # under the higher-priority uncompilable format go to the oracle.
+        fields = ["IP:connection.client.host", "STRING:request.status.last",
+                  "BYTES:response.body.bytes"]
+        batch = TpuBatchParser('%h%l %u %t "%r" %>s %b\ncombined', fields)
+        assert [u.plausibility_only for u in batch.units] == [True, False]
+        assert batch._device_covers_all_formats
+
+        combined = (
+            '1.2.3.4 - frank [10/Oct/2026:13:55:36 -0700] '
+            '"GET /x HTTP/1.1" 200 23 "-" "ua"'
+        )
+        first_only = (
+            '1.2.3.4- frank [10/Oct/2026:13:55:36 -0700] '
+            '"GET /x HTTP/1.1" 200 23'
+        )
+        lines = [combined, first_only, "garbage"]
+        result = batch.parse_batch(lines)
+        # The combined line is claimed ON DEVICE by format 1 (implausible
+        # under format 0: its trailing %b wants a digits/'-' line end).
+        assert result.format_index[0] == 1
+        for i, line in enumerate(lines):
+            try:
+                want = batch.oracle.parse(line, _CollectingRecord()).values
+                ok = True
+            except Exception:
+                want, ok = {}, False
+            assert bool(result.valid[i]) == ok, (i, line)
+            for f in fields:
+                got = result.to_pylist(f)[i]
+                w = want.get(f) if ok else None
+                assert got == w or (w is not None and str(got) == str(w)), (
+                    i, f, got, w,
+                )
+
+        # A pure combined corpus stays fully device-resident.
+        pure = [combined] * 32
+        assert batch.parse_batch(pure).oracle_rows == 0
+
+    def test_line_plausible_under_uncompilable_format_takes_oracle(self):
+        # Both formats could accept the line shape-wise; registration
+        # priority belongs to the uncompilable format, so the device must
+        # NOT claim it for the later format.
+        fields = ["STRING:request.status.last"]
+        batch = TpuBatchParser('%h%l %u %>s\n%h %u %>s', fields)
+        assert [u.plausibility_only for u in batch.units] == [True, False]
+        # Accepted by BOTH formats' regexes; format 0 wins by priority.
+        line = "1.2.3.4 frank 200"
+        result = batch.parse_batch([line])
+        want = batch.oracle.parse(line, _CollectingRecord()).values
+        assert result.oracle_rows == 1          # contested -> oracle
+        assert bool(result.valid[0])
+        assert result.to_pylist(fields[0])[0] == want.get(fields[0])
 
 
 class TestModUniqueIdDevice:
