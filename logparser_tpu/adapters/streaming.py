@@ -73,12 +73,28 @@ class ParserMapOperator:
 
     def map_batch(self, lines: Sequence[Any]) -> List[Optional[ParsedRecord]]:
         self.open()
+        result = self.parser.parse_batch(lines)
+        return self._account(result)
+
+    def map_batch_stream(
+        self, batches: Iterator[Sequence[Any]], depth: int = 1
+    ) -> Iterator[List[Optional[ParsedRecord]]]:
+        """Batches-in-flight bulk path: up to ``depth`` micro-batches'
+        device work stays dispatched ahead of the records being emitted,
+        overlapping H2D/compute with host materialization
+        (TpuBatchParser.parse_batch_stream).  Yields one record list per
+        input batch, in order; counters update exactly as in
+        :meth:`map_batch`, as each result is materialized."""
+        self.open()
+        for result in self.parser.parse_batch_stream(batches, depth=depth):
+            yield self._account(result)
+
+    def _account(self, result) -> List[Optional[ParsedRecord]]:
         if self._casts is None:
             self._casts = {
                 fid: self.parser.oracle.get_casts(fid)
                 for fid in self.parser.requested
             }
-        result = self.parser.parse_batch(lines)
         self.counters.lines_read += result.lines_read
         self.counters.good_lines += result.good_lines
         self.counters.bad_lines += result.bad_lines
@@ -118,10 +134,44 @@ class MicroBatcher:
 def parse_stream(
     lines: Iterator[Any],
     config: ParserConfig,
+    depth: int = 0,
 ) -> Iterator[Tuple[Any, Optional[ParsedRecord]]]:
-    """End-to-end streaming helper: lines in, (line, record|None) out."""
+    """End-to-end streaming helper: lines in, (line, record|None) out.
+
+    ``depth=0`` (default) emits each micro-batch's records as soon as the
+    batch fills — the right latency profile for LIVE sources (a tailed
+    log that pauses must not hold finished records hostage to the next
+    batch arriving).  ``depth>=1`` pipelines through
+    ``map_batch_stream``: batch k's records are emitted while batch k+1
+    computes on device, which raises throughput on BOUNDED sources
+    (files, queues with backlog) at the cost of one batch of emission
+    latency."""
     operator = ParserMapOperator(config)
-    batcher = MicroBatcher(operator)
-    for line in lines:
-        yield from batcher.feed(line)
-    yield from batcher.flush()
+    if depth <= 0:
+        batcher = MicroBatcher(operator)
+        for line in lines:
+            yield from batcher.feed(line)
+        yield from batcher.flush()
+        return
+    size = config.micro_batch_size
+
+    def chunks():
+        batch: List[Any] = []
+        for line in lines:
+            batch.append(line)
+            if len(batch) >= size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+    pending: List[Sequence[Any]] = []
+
+    def tee():
+        for batch in chunks():
+            pending.append(batch)
+            yield batch
+
+    for records in operator.map_batch_stream(tee(), depth=depth):
+        batch = pending.pop(0)
+        yield from zip(batch, records)

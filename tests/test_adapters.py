@@ -298,6 +298,69 @@ def test_parse_stream_and_config_pickles(logfile):
     assert good[0].get_string("connection.client.host")
     assert good[0].get_long("response.body.bytes") is not None or True
 
+    # The pipelined mode (depth>=1) yields identical pairs in order.
+    piped = list(parse_stream(iter(lines[:150]), config, depth=2))
+    assert [l for l, _ in piped] == [l for l, _ in out]
+    assert [
+        None if r is None else (r.strings, r.longs) for _, r in piped
+    ] == [None if r is None else (r.strings, r.longs) for _, r in out]
+
+
+def test_map_batch_stream_matches_serialized(logfile):
+    """Batches-in-flight must yield the SAME records, in order, with the
+    SAME counters as one map_batch call per batch."""
+    from logparser_tpu.adapters.streaming import ParserMapOperator
+
+    _, lines = logfile
+    batches = [lines[i : i + 40] for i in range(0, 200, 40)]
+
+    op_serial = ParserMapOperator(ParserConfig("combined", FIELDS))
+    serial = [op_serial.map_batch(b) for b in batches]
+
+    op_stream = ParserMapOperator(ParserConfig("combined", FIELDS))
+    streamed = list(op_stream.map_batch_stream(iter(batches), depth=3))
+
+    assert len(streamed) == len(serial)
+    for got, want in zip(streamed, serial):
+        assert [
+            None if r is None else (r.strings, r.longs) for r in got
+        ] == [None if r is None else (r.strings, r.longs) for r in want]
+    assert op_stream.counters.lines_read == op_serial.counters.lines_read
+    assert op_stream.counters.good_lines == op_serial.counters.good_lines
+    assert op_stream.counters.bad_lines == op_serial.counters.bad_lines
+
+
+def test_parse_batch_stream_csr_growth_mid_stream():
+    """A batch that forces adaptive CSR slot growth while LATER batches
+    are already in flight: the stale dispatches must transparently
+    re-dispatch under the new layout and stay bit-exact."""
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    def line(q):
+        return (
+            f'1.1.1.1 - - [07/Mar/2026:10:00:00 +0000] "GET /x?{q} '
+            f'HTTP/1.1" 200 7 "-" "ua"'
+        )
+
+    wide = line("&".join(f"k{i}={i}" for i in range(40)))  # > default slots
+    narrow = line("a=1&b=2")
+    p = TpuBatchParser(
+        "combined", ["STRING:request.firstline.uri.query.*"]
+    )
+    slots_before = p.csr_slots
+    batches = [[narrow] * 4, [wide, narrow], [narrow] * 3]
+    results = list(p.parse_batch_stream(iter(batches), depth=3))
+    assert p.csr_slots > slots_before  # growth actually happened
+    assert [r.lines_read for r in results] == [4, 2, 3]
+    w = "STRING:request.firstline.uri.query.*"
+    assert results[1].to_pylist(w)[0] == {f"k{i}": str(i) for i in range(40)}
+    assert results[2].to_pylist(w) == [{"a": "1", "b": "2"}] * 3
+    # ... and every batch matches a fresh serialized parse.
+    p2 = TpuBatchParser("combined", [w])
+    for got_r, batch in zip(results, batches):
+        want = p2.parse_batch(batch)
+        assert got_r.to_pylist(w) == want.to_pylist(w)
+
 
 def test_wildcard_multi_value_with_dotted_relative_name():
     """Wildcard values whose relative names contain dots (e.g. query param
