@@ -170,11 +170,9 @@ def marginal_device_rate(parser, buf, lengths, batch, n_lo=16, n_hi=144):
     from logparser_tpu.tpu import pipeline
 
     units = parser.units
-    if parser.use_pallas:
-        inner = pipeline.build_units_pallas_fn(units, batch, buf.shape[1])
-    else:
-        def inner(b, lens):
-            return jnp.stack(pipeline.compute_units_rows(units, b, lens))
+
+    def inner(b, lens):
+        return jnp.stack(pipeline.compute_units_rows(units, b, lens))
 
     @partial(jax.jit, static_argnums=2)
     def loop_fn(b0, lens, n):
@@ -324,7 +322,7 @@ def main():
     parser = TpuBatchParser("combined", HEADLINE_FIELDS)
     buf, lengths, _ = encode_batch(lines)
 
-    fn = parser.device_fn(BATCH, buf.shape[1])
+    fn = parser.device_fn()
     jbuf = jnp.asarray(buf)
     jlengths = jnp.asarray(lengths)
     for _ in range(WARMUP_ITERS):
@@ -396,7 +394,6 @@ def main():
            if pipelined < 0.2 * device_resident else {}),
         "batch": BATCH,
         "fields": len(HEADLINE_FIELDS),
-        "pallas": parser.use_pallas,
         "device": str(device),
         "host_oracle_lines_per_sec": round(oracle_lps, 1),
         "configs": configs,

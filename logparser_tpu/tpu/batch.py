@@ -43,7 +43,6 @@ from .pipeline import (
     PackedLayout,
     assign_row_offsets,
     build_units_jnp_fn,
-    build_units_pallas_fn,
 )
 from .program import (
     CS_CLF_DIGITS,
@@ -60,22 +59,6 @@ _FieldPlan = FieldPlan
 
 # Octet -> string vocab for vectorized dotted-quad formatting.
 _OCTET_STRINGS = np.array([str(i) for i in range(256)], dtype=object)
-
-
-def _default_use_pallas() -> bool:
-    """Default to the plain-XLA executor everywhere.  Measured on v5e
-    (L=384, combined, in-jit marginal rate so dispatch overhead is excluded):
-    XLA's own fusion of the masked-reduction pipeline runs ~4.5x faster than
-    the hand-written Pallas kernel (~45M vs ~10M lines/s/chip) — the workload
-    is exactly the elementwise+reduce shape XLA fuses best.  The kernel is
-    EXPERIMENTAL (see the ADR in ROADMAP.md): it remains available via
-    LOGPARSER_TPU_PALLAS=1 or use_pallas=True as a semantics cross-check,
-    but chained plans (timestamp components, URI splits, CSR) do not lower
-    through Mosaic on current toolchains."""
-    env = os.environ.get("LOGPARSER_TPU_PALLAS")
-    if env is not None:
-        return env.strip().lower() not in ("0", "false", "no")
-    return False
 
 
 def _fix_uri_part(value: str, mode: str) -> str:
@@ -589,17 +572,9 @@ class TpuBatchParser:
         timestamp_format: Optional[str] = None,
         type_remappings: Optional[Dict[str, Any]] = None,
         extra_dissectors: Optional[Sequence[Any]] = None,
-        use_pallas: Optional[bool] = None,
     ):
         self.log_format = log_format
         self.requested = [cleanup_field_value(f) for f in fields]
-        # Remember whether the caller pinned the execution path: a defaulted
-        # flag is re-derived from the LOCAL backend when an artifact is
-        # loaded on a different machine (see __setstate__).
-        self._use_pallas_explicit = use_pallas is not None
-        self.use_pallas = (
-            _default_use_pallas() if use_pallas is None else use_pallas
-        )
 
         # Host oracle parser (also the metadata source).  Pinned STATELESS:
         # the batch path guarantees deterministic per-line registration
@@ -710,7 +685,6 @@ class TpuBatchParser:
             for u in self.units
         ]
         self._jitted = self._build_jitted()
-        self._pallas_fns: Dict[tuple, Any] = {}  # (B, L) -> jitted pallas fn
 
     def _build_jitted(self):
         # No point running the device programs when every field is host-only.
@@ -721,19 +695,13 @@ class TpuBatchParser:
             return build_units_jnp_fn(self.units)
         return None
 
-    def device_fn(self, B: int, L: int):
-        """The fused device executor for one [B, L] shape bucket: Pallas on
-        TPU (one VMEM-resident kernel), plain XLA elsewhere."""
-        if self._jitted is None:
-            return None
-        if not self.use_pallas:
-            return self._jitted
-        key = (B, L)
-        fn = self._pallas_fns.get(key)
-        if fn is None:
-            fn = build_units_pallas_fn(self.units, B, L)
-            self._pallas_fns[key] = fn
-        return fn
+    def device_fn(self):
+        """The fused plain-XLA device executor, or None when every field
+        is host-only (shape-polymorphic jit; each [B, L] bucket compiles
+        once).  XLA is the product path: a hand-written Pallas kernel of
+        this pipeline measured ~4.5x slower on v5e and Mosaic cannot
+        lower the chained stages — see the ADR in COMPONENTS.md."""
+        return self._jitted
 
     def _grow_csr_slots(self) -> bool:
         """Adaptive CSR: double the wildcard segment-slot count (bounded by
@@ -750,7 +718,6 @@ class TpuBatchParser:
             u.layout = PackedLayout.for_plans(u.plans, self.csr_slots)
         assign_row_offsets(self.units)
         self._jitted = self._build_jitted()
-        self._pallas_fns = {}
         return True
 
     # ------------------------------------------------------------------
@@ -1239,7 +1206,7 @@ class TpuBatchParser:
         trace = tracer()
         lines, buf, lengths, overflow, B, padded_b = enc
         out = None
-        fn = self.device_fn(padded_b, buf.shape[1])
+        fn = self.device_fn()
         if fn is not None:
             with trace.stage("device", items=B):
                 out = fn(jnp.asarray(buf), jnp.asarray(lengths))
@@ -1270,7 +1237,7 @@ class TpuBatchParser:
             # result was produced under a stale CSR slot layout (another
             # batch's materialization grew the slots mid-stream).
             if out is None or out_slots != self.csr_slots:
-                fn = self.device_fn(padded_b, buf.shape[1])
+                fn = self.device_fn()
                 if fn is None:
                     packed = None
                     valid = np.zeros(B, dtype=bool)
@@ -2165,11 +2132,15 @@ class TpuBatchParser:
     def __getstate__(self) -> Dict[str, Any]:
         state = self.__dict__.copy()
         state["_jitted"] = None
-        state["_pallas_fns"] = {}
         state["_oracle_pool"] = None  # worker pools never ship in artifacts
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
+        # Legacy artifact keys (use_pallas/_pallas_fns from pre-round-3
+        # builds, when an experimental Pallas executor existed) are
+        # dropped on load.
+        for legacy in ("_pallas_fns", "use_pallas", "_use_pallas_explicit"):
+            state.pop(legacy, None)
         self.__dict__.update(state)
         if "csr_slots" not in state:  # pre-adaptive-CSR artifacts
             from .pipeline import CSR_SLOTS
@@ -2177,10 +2148,6 @@ class TpuBatchParser:
             self.csr_slots = CSR_SLOTS
         if "_device_covers_all_formats" not in state:  # pre-filter artifacts
             self._device_covers_all_formats = False  # conservatively off
-        if not getattr(self, "_use_pallas_explicit", False):
-            # The defaulted flag described the BUILDER's backend; this
-            # process may be a different machine — re-derive locally.
-            self.use_pallas = _default_use_pallas()
         self._jitted = self._build_jitted()
 
     def to_bytes(self) -> bytes:

@@ -1,25 +1,24 @@
 """Fused device pipeline: split + typed post-stages -> ONE packed [K, B] int32.
 
-Two executions of the SAME computation (single-source, so they cannot drift):
-
-- **jnp path**: plain XLA, used on CPU (tests / virtual meshes) and as the
-  fallback when Pallas is unavailable.
-- **Pallas path** (TPU): the whole pipeline runs as one kernel over [BB, L]
-  line blocks resident in VMEM — the input is read from HBM exactly once and
-  every mask/intermediate lives on-chip.  This is the rebuild's answer to the
-  reference's per-line `Matcher.find()` hot loop
-  (TokenFormatDissector.java:243-275): a compiled split program executed as a
-  vector automaton, not a backtracking regex.
+Plain-XLA execution everywhere (TPU and the CPU test meshes).  This is the
+rebuild's answer to the reference's per-line `Matcher.find()` hot loop
+(TokenFormatDissector.java:243-275): a compiled split program executed as a
+vector automaton, not a backtracking regex.  The workload — elementwise
+compares + masked reductions — is exactly the shape XLA's fusion engine
+schedules near-optimally on the VPU; a hand-written Pallas kernel of the
+same pipeline measured ~4.5x SLOWER on v5e (one HBM pass either way, and
+the kernel's lane rolls cost more than XLA's fused selects) and Mosaic
+cannot lower the chained stages at all, so the kernel was removed (see
+COMPONENTS.md, "Pallas kernel" ADR; round-2 measurements in git history).
 
 The output is a single packed ``[K, B]`` int32 array (one row per output
 component, described by :class:`PackedLayout`) so the host needs exactly ONE
 device->host fetch per batch — transfer round-trips, not bandwidth, dominate
 on tunneled/virtualized TPU attachments.
 
-Shift discipline: both paths express every data movement as a left-shift of
-the line axis.  The jnp path zero-fills the tail; the Pallas path uses the
-lane roll (wrap-around).  Callers mask every position that could differ, so
-the two are equivalent (asserted by tests/test_tpu_batch.py golden runs).
+Shift discipline: every data movement is a left-shift of the line axis with
+a zero-filled tail (``shift_zero``); callers mask every position past the
+span/line end.
 """
 from __future__ import annotations
 
@@ -75,39 +74,6 @@ class FieldPlan:
 from .postproc import shift_zero  # the shared zero-fill shift primitive
 
 
-def shift_wrap(x: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Left-shift columns by k with wrap-around (Pallas lane roll).
-
-    Wrapped-in tail bytes are garbage; every consumer masks positions past
-    the span/line end, so wrap and zero-fill are interchangeable there."""
-    if k <= 0:
-        return x
-    from jax.experimental.pallas import tpu as pltpu
-
-    L = x.shape[1]
-    return pltpu.roll(x, L - (k % L), axis=1)
-
-
-def make_extract(shift_fn) -> Callable:
-    """Span-window extractor from a shift primitive (log-shift alignment).
-
-    extract(buf, start, width) -> [B, width]: bytes at [start, start+width).
-    Decomposes the per-row shift into its bits — log2(L) select+shift passes,
-    no gather (TPU gathers are scalar-slow)."""
-
-    def extract(buf: jnp.ndarray, start: jnp.ndarray, width: int) -> jnp.ndarray:
-        B, L = buf.shape
-        width = min(width, L)
-        x = buf
-        for j in reversed(range(max(1, (L - 1).bit_length()))):
-            k = 1 << j
-            bit = ((start >> j) & 1) == 1
-            x = jnp.where(bit[:, None], shift_fn(x, k), x)
-        return x[:, :width]
-
-    return extract
-
-
 # ---------------------------------------------------------------------------
 # Split program (shared by runtime.run_program and the packed pipeline).
 # ---------------------------------------------------------------------------
@@ -146,7 +112,6 @@ def compute_split(
     program: DeviceProgram,
     b32: jnp.ndarray,
     lengths: jnp.ndarray,
-    shift_fn=shift_zero,
     need_plausible: bool = False,
 ) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], jnp.ndarray, Optional[jnp.ndarray]]:
     """Run the split program over int32 byte rows.
@@ -179,7 +144,7 @@ def compute_split(
     for lit in sorted({op.lit for op in program.ops if op.lit}):
         m = None
         for k, byte in enumerate(lit):
-            part = shift_fn(b32, k) == byte if k else (b32 == byte)
+            part = shift_zero(b32, k) == byte if k else (b32 == byte)
             m = part if m is None else (m & part)
         lit_masks[lit] = m & (pos + len(lit) <= lengths[:, None])
 
@@ -496,20 +461,16 @@ def compute_rows(
     layout: PackedLayout,
     b32: jnp.ndarray,
     lengths: jnp.ndarray,
-    shift_fn=shift_zero,
     need_plausible: bool = False,
 ) -> List[jnp.ndarray]:
     """The fused computation: split + per-plan post-stages -> K rows of [B]
     int32 (row 0: bit 0 = line validity, bit 1 = plausibility when
-    requested).  Returned as a list so the Pallas kernel can write rows to
-    the output ref one by one (Mosaic miscompiles a wide 1-D stack) while
-    the jnp path stacks them."""
+    requested).  Returned as a list; the executor stacks them."""
     B = b32.shape[0]
     starts, ends, valid, plausible = compute_split(
-        program, b32, lengths, shift_fn, need_plausible
+        program, b32, lengths, need_plausible
     )
-    extract_fn = make_extract(shift_fn) if shift_fn is not shift_zero else None
-    extract = extract_fn or postproc.gather_span_bytes
+    extract = postproc.gather_span_bytes
 
     rows: List[Optional[jnp.ndarray]] = [None] * layout.n_rows
 
@@ -563,7 +524,7 @@ def compute_rows(
             fl = fl_cache.get(cache_key)
             if fl is None:
                 fl = postproc.split_firstline(
-                    b32, lengths, s, e, extract=extract_fn
+                    b32, lengths, s, e, extract=extract
                 )
                 fl_cache[cache_key] = fl
             if part == "protocol":
@@ -582,7 +543,7 @@ def compute_rows(
                 # take '-' literally, like the host.
                 dash = clf_dash(s, e) if len(cache_key) == 1 else None
                 uri = postproc.split_uri_fast(
-                    b32, s, e, extract=extract, shift_fn=shift_fn, dash=dash,
+                    b32, s, e, extract=extract, dash=dash,
                     need_authority=need_authority,
                 )
                 uri_cache[cache_key] = uri
@@ -657,14 +618,14 @@ def compute_rows(
         elif plan.kind in ("long", "secmillis"):
             if plan.kind == "secmillis":
                 (hi, lo, lo_digits), milli, is_null, ok = (
-                    postproc.parse_secmillis_spans(b32, s, e, extract=extract_fn)
+                    postproc.parse_secmillis_spans(b32, s, e, extract=extract)
                 )
                 put(plan.field_id, "milli", milli)
             else:
                 (hi, lo, lo_digits), is_null, ok = postproc.parse_long_spans(
                     b32, s, e,
                     clf=plan.null_mode in ("dash_null", "dash_zero"),
-                    extract=extract_fn,
+                    extract=extract,
                 )
             put(plan.field_id, "hi", hi)
             put(plan.field_id, "lo", lo)
@@ -693,7 +654,7 @@ def compute_rows(
             group_done.add(key)
             table = plan.meta[2]
             u32, ip_ok, has_colon = postproc.parse_ipv4_spans(
-                b32, s, e, extract=extract_fn
+                b32, s, e, extract=extract
             )
             rows_idx = table.lookup_rows(u32)
             put(key, "row", jnp.where(ip_ok & chain_ok, rows_idx, 0))
@@ -722,7 +683,7 @@ def compute_rows(
                 continue
             group_done.add(key)
             words, ok = postproc.parse_mod_unique_id(
-                b32, s, e, extract=extract_fn
+                b32, s, e, extract=extract
             )
             for comp in ("time", "ip", "pid", "thread"):
                 put(key, comp, words[comp])
@@ -740,7 +701,6 @@ def compute_rows(
                     chain_ok = chain_ok & ~clf_dash(s, e)
                 sc = postproc.split_setcookie_csr(
                     b32, s, e, layout.csr_slots,
-                    shift_fn=None if shift_fn is shift_zero else shift_fn,
                 )
                 for k in range(layout.csr_slots):
                     seg_s = sc["seg_start"][k]
@@ -774,7 +734,6 @@ def compute_rows(
             csr = postproc.split_csr(
                 b32, s, e, layout.csr_slots,
                 sep=_CSR_SEPARATORS[plan.meta or "query"],
-                shift_fn=None if shift_fn is shift_zero else shift_fn,
                 # URI-chained query strings pass through the URI encode
                 # step before the host dissector sees them — encode-set
                 # bytes flag the per-row path.  Direct token captures
@@ -847,7 +806,7 @@ def compute_rows(
 
 
 # ---------------------------------------------------------------------------
-# Entry points: jnp and Pallas executors of the packed pipeline.
+# Entry points: the jnp executor of the packed pipeline.
 #
 # Multi-format (SURVEY §7.7): the reference keeps ONE active format and
 # switches on DissectionFailure (HttpdLogFormatDissector.java:174-204) — a
@@ -896,12 +855,11 @@ def compute_units_rows(
     units: Sequence[FormatUnit],
     buf: jnp.ndarray,
     lengths: jnp.ndarray,
-    shift_fn=shift_zero,
 ) -> List[jnp.ndarray]:
     """All formats' packed rows for one batch — the single executor body
-    shared by the jnp path (uint8 buf), the Pallas kernel (int32 buf +
-    shift_wrap), and bench.py.  Every compare and range check is correct
-    under BOTH dtypes: uint8 wraparound "negatives" land >= 230 and int32
+    shared by the jnp path (via :func:`units_fn`), the mesh runners, and
+    bench.py.  Every compare and range check is correct under both uint8
+    and int32 inputs: uint8 wraparound "negatives" land >= 230 and int32
     gives true negatives, and each fails the <= 9 / < 26 digit and letter
     range checks identically (the timestamp parser digit-checks every
     numeric byte explicitly for exactly this reason)."""
@@ -916,12 +874,12 @@ def compute_units_rows(
             # Uncompilable format: one row, plausible bit only (bit 1);
             # the valid bit is never set so the probe cannot win a line.
             _, _, _, plausible = compute_split(
-                u.program, buf, lengths, shift_fn, need_plausible=True
+                u.program, buf, lengths, need_plausible=True
             )
             rows.append(jnp.where(plausible, 2, 0).astype(jnp.int32))
             continue
         rows.extend(compute_rows(
-            u.program, u.plans, u.layout, buf, lengths, shift_fn,
+            u.program, u.plans, u.layout, buf, lengths,
             need_plausible=True,
         ))
     return rows
@@ -945,55 +903,3 @@ def build_units_jnp_fn(units: Sequence[FormatUnit]):
     """Plain-XLA executor over all formats:
     (buf [B,L] uint8, lengths [B]) -> [sum K_i, B] int32."""
     return jax.jit(units_fn(units))
-
-
-
-
-def _block_lines(L: int) -> int:
-    """Lines per Pallas block: keep the [BB, L] working set VMEM-friendly.
-    Measured on v5e (L=384, combined): BB=128 beats 256 by ~12% and 512+
-    overflows VMEM, so target ~64K elements per block."""
-    bb = max(32, (64 * 1024) // max(L, 1))
-    # power of two
-    return 1 << (bb.bit_length() - 1)
-
-
-def build_units_pallas_fn(units: Sequence[FormatUnit], B: int, L: int,
-                          interpret: Optional[bool] = None):
-    """Pallas executor for a fixed [B, L] shape: one fused VMEM-resident
-    kernel over line blocks running every format's automaton.
-    (buf, lengths[B,1]) -> [sum K_i, B] int32.
-
-    ``interpret`` defaults to True off-TPU so the kernel stays testable on
-    the CPU mesh (pltpu.roll & friends run in the Pallas interpreter)."""
-    from jax.experimental import pallas as pl
-
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    K = sum(u.layout.n_rows for u in units)
-    BB = min(_block_lines(L), B)
-
-    def kernel(buf_ref, len_ref, out_ref):
-        b32 = buf_ref[...].astype(jnp.int32)
-        lengths = len_ref[...][:, 0]
-        rows = compute_units_rows(units, b32, lengths, shift_wrap)
-        for i, row in enumerate(rows):
-            out_ref[i, :] = row
-
-    grid = (B // BB,)
-    call = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((BB, L), lambda i: (i, 0)),
-            pl.BlockSpec((BB, 1), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((K, BB), lambda i: (0, i)),
-        out_shape=jax.ShapeDtypeStruct((K, B), jnp.int32),
-        interpret=interpret,
-    )
-
-    def fn(buf: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
-        return call(buf, lengths.reshape(-1, 1))
-
-    return jax.jit(fn)

@@ -28,19 +28,17 @@ MAX_LONG_DIGITS = 18
 
 def pow10_weights(w: int) -> jnp.ndarray:
     """[w] descending powers of ten (10^(w-1) .. 10^0) for digit-window
-    dot products.  Built from iota rather than a numpy constant so kernels
-    that trace this (the Pallas cross-check path) don't capture an array
-    constant; XLA folds it to a constant either way."""
+    dot products.  Built from iota rather than a numpy constant; XLA
+    folds it to a constant either way."""
     return jnp.int32(10) ** (
         w - 1 - jax.lax.broadcasted_iota(jnp.int32, (w,), 0)
     )
 
 
 def shift_zero(x: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Left-shift columns by k, zero-filling the tail.  The single shared
-    zero-fill shift primitive (pipeline re-exports it; the Pallas path
-    substitutes the lane-roll variant, which differs only in bytes past the
-    span end — every consumer masks those)."""
+    """Left-shift columns by k, zero-filling the tail — the single shared
+    shift primitive (pipeline re-exports it); every consumer masks bytes
+    past the span/line end."""
     if k <= 0:
         return x
     B, L = x.shape
@@ -176,7 +174,6 @@ def split_uri_fast(
     start: jnp.ndarray,
     end: jnp.ndarray,
     extract=None,
-    shift_fn=None,
     dash=None,
     need_authority: bool = True,
 ) -> Dict[str, jnp.ndarray]:
@@ -289,7 +286,7 @@ def split_uri_fast(
     )
 
     is_pct = (buf == np.uint8(ord("%"))) & in_span
-    shift = shift_fn or shift_zero
+    shift = shift_zero
     nxt1 = shift(buf, 1)
     nxt2 = shift(buf, 2)
 
@@ -549,7 +546,6 @@ def split_csr(
     max_segments: int,
     sep: bytes = b"&",
     kv: int = ord("="),
-    shift_fn=None,
     uri_encoded: bool = False,
 ) -> Dict[str, object]:
     """CSR segment split of spans on device: the vectorized core of the
@@ -570,7 +566,7 @@ def split_csr(
     """
     B, L = buf.shape
     n_sep = len(sep)
-    shift = shift_fn or shift_zero
+    shift = shift_zero
     pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
     in_span = (pos >= start[:, None]) & (pos < end[:, None])
     is_sep = None
@@ -650,7 +646,6 @@ def split_setcookie_csr(
     start: jnp.ndarray,
     end: jnp.ndarray,
     max_segments: int,
-    shift_fn=None,
 ) -> Dict[str, object]:
     """Device split of a Set-Cookie response header list: ``", "`` separated
     cookies with the expires-comma rejoin quirk
@@ -675,7 +670,7 @@ def split_setcookie_csr(
     from ..dissectors.cookies import _MINIMAL_EXPIRES_LENGTH
 
     B, L = buf.shape
-    shift = shift_fn or shift_zero
+    shift = shift_zero
     pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
     in_span = (pos >= start[:, None]) & (pos < end[:, None])
 

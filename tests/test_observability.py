@@ -45,7 +45,6 @@ def test_parse_batch_traces_pipeline_stages():
         parser = TpuBatchParser(
             "combined",
             ["IP:connection.client.host", "BYTES:response.body.bytes"],
-            use_pallas=False,
         )
         lines = generate_combined_lines(32, seed=23, garbage_fraction=0.1)
         # A PLAUSIBLE-but-device-rejected line (20-digit byte count: the

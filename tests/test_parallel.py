@@ -146,7 +146,7 @@ def test_full_step_batch_parallel_matches_single():
         "HTTP.PATH:request.firstline.uri.path",
         "STRING:request.firstline.uri.query.*",
         "BYTES:response.body.bytes",
-    ], use_pallas=False)
+    ])
     lines = [
         f'10.0.0.{i % 200 + 1} - - [07/Mar/2026:10:00:{i % 60:02d} +0000] '
         f'"GET /p{i}?a={i}&b=x HTTP/1.1" 200 {i + 1} "-" "ua{i}"'

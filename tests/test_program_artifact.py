@@ -38,13 +38,13 @@ def assert_same_results(a: TpuBatchParser, b: TpuBatchParser, lines) -> None:
 
 
 def test_pickle_round_trip(lines):
-    parser = TpuBatchParser("combined", FIELDS, use_pallas=False)
+    parser = TpuBatchParser("combined", FIELDS)
     clone = pickle.loads(pickle.dumps(parser))
     assert_same_results(parser, clone, lines)
 
 
 def test_artifact_file_round_trip(tmp_path, lines):
-    parser = TpuBatchParser("combined", FIELDS, use_pallas=False)
+    parser = TpuBatchParser("combined", FIELDS)
     path = str(tmp_path / "combined.lpprog")
     parser.save(path)
     loaded = TpuBatchParser.load(path)
@@ -57,9 +57,9 @@ def test_artifact_file_round_trip(tmp_path, lines):
 def test_artifact_round_trip_before_first_parse(tmp_path, lines):
     # Serialize IMMEDIATELY after construction (no jit has ever run) and
     # parse only on the loaded copy — the ship-to-worker pattern.
-    blob = TpuBatchParser("combined", FIELDS, use_pallas=False).to_bytes()
+    blob = TpuBatchParser("combined", FIELDS).to_bytes()
     loaded = TpuBatchParser.from_bytes(blob)
-    fresh = TpuBatchParser("combined", FIELDS, use_pallas=False)
+    fresh = TpuBatchParser("combined", FIELDS)
     assert_same_results(fresh, loaded, lines)
 
 
@@ -70,7 +70,7 @@ def test_artifact_rejects_garbage(tmp_path):
 
 def test_multiformat_artifact(lines):
     multi = "combined\ncommon"
-    parser = TpuBatchParser(multi, FIELDS[:4], use_pallas=False)
+    parser = TpuBatchParser(multi, FIELDS[:4])
     clone = pickle.loads(pickle.dumps(parser))
     ra = parser.parse_batch(lines)
     rb = clone.parse_batch(lines)

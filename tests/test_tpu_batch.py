@@ -205,28 +205,6 @@ def test_negative_epoch_strftime():
     assert compile_strftime("%s").parse("86400").epoch_millis == 86400000
 
 
-def test_pallas_kernel_matches_jnp_pipeline():
-    """The Pallas kernel (interpret mode on CPU) and the plain-XLA pipeline
-    are the same single-source computation; this asserts the wrap-shift vs
-    zero-shift discipline really is observationally equivalent."""
-    lines = generate_combined_lines(48, seed=7) + [
-        b"garbage that does not parse",
-        b'1.2.3.4 - - [31/Dec/2019:23:59:59 -1130] "HEAD / HTTP/1.0" 301 - "-" "-"',
-    ]
-    fields = [
-        "IP:connection.client.host",
-        "TIME.EPOCH:request.receive.time.epoch",
-        "HTTP.METHOD:request.firstline.method",
-        "HTTP.URI:request.firstline.uri",
-        "STRING:request.status.last",
-        "BYTES:response.body.bytes",
-    ]
-    jnp_parser = TpuBatchParser("combined", fields, use_pallas=False)
-    pallas_parser = TpuBatchParser("combined", fields, use_pallas=True)
-    assert jnp_parser.parse_batch(lines).to_dict() == \
-        pallas_parser.parse_batch(lines).to_dict()
-
-
 COMMON = '%h %l %u %t "%r" %>s %b'
 
 
@@ -266,21 +244,19 @@ class TestMultiFormat:
         assert parser.units[1].row_offset == parser.units[0].layout.n_rows
 
     def test_winner_per_line(self):
-        parser = TpuBatchParser("combined\n" + COMMON, self.FIELDS,
-                                use_pallas=False)
+        parser = TpuBatchParser("combined\n" + COMMON, self.FIELDS)
         res = parser.parse_batch(self._mixed())
         # Interleaved combined/common lines -> alternating winners.
         assert list(res.format_index[:6]) == [0, 1, 0, 1, 0, 1]
         assert res.bad_lines == 0
 
-    @pytest.mark.parametrize("use_pallas", [False, True])
-    def test_matches_oracle(self, use_pallas):
+    def test_matches_oracle(self):
         fmt = "combined\n" + COMMON
         lines = self._mixed() + [
             "garbage neither format accepts",
             '8.8.8.8 - - [01/Jan/2020:00:00:00 +0000] "GET / HTTP/1.1" 200 -',
         ]
-        parser = TpuBatchParser(fmt, self.FIELDS, use_pallas=use_pallas)
+        parser = TpuBatchParser(fmt, self.FIELDS)
         res = parser.parse_batch(lines)
 
         p = HttpdLoglineParser(_Rec, fmt)
@@ -309,8 +285,7 @@ class TestMultiFormat:
             '1.1.1.1 - - [01/Jan/2020:00:00:00 +0000] "GET / HTTP/1.1" 200 - "-" "-"',
             '2.2.2.2 - - [01/Jan/2020:00:00:00 +0000] "GET / HTTP/1.1" 200 -',
         ]
-        parser = TpuBatchParser(fmt, ["BYTES:response.body.bytes"],
-                                use_pallas=False)
+        parser = TpuBatchParser(fmt, ["BYTES:response.body.bytes"])
         res = parser.parse_batch(lines)
         assert res.to_pylist("BYTES:response.body.bytes") == [0, 0]
 
@@ -323,7 +298,7 @@ class TestMultiFormat:
         fmt1 = '"%{A}i" %{C}i %h'
         line = '"x" y" 1.2.3.4'
         fields = ["HTTP.HEADER:request.header.a", "IP:connection.client.host"]
-        parser = TpuBatchParser(fmt0 + "\n" + fmt1, fields, use_pallas=False)
+        parser = TpuBatchParser(fmt0 + "\n" + fmt1, fields)
         assert len(parser.units) == 2
         res = parser.parse_batch([line])
 
@@ -352,15 +327,9 @@ class TestTimestampGarbageParity:
             '"GET /x HTTP/1.1" 200 5 "-" "ua"'
         )
         fields = ["TIME.EPOCH:request.receive.time.epoch"]
-        results = []
-        for use_pallas in (False, True):
-            parser = TpuBatchParser("combined", fields, use_pallas=use_pallas)
-            res = parser.parse_batch([line, good])
-            results.append(
-                (list(res.valid), res.to_pylist(fields[0]))
-            )
-        assert results[0] == results[1]
-        valid, epochs = results[0]
+        parser = TpuBatchParser("combined", fields)
+        res = parser.parse_batch([line, good])
+        valid, epochs = list(res.valid), res.to_pylist(fields[0])
         assert not valid[0]            # garbage tz -> invalid line
         assert valid[1]
         assert epochs[1] == 1704067200000
