@@ -321,6 +321,20 @@ class Parser:
                 phases.append(_DissectorPhase(input_type, out_type, name, dissector))
         return phases
 
+    def set_locale(self, locale) -> "Parser":
+        """Timestamp locale for every locale-aware dissector (the rebuild's
+        parser-level surface over TimeStampDissector.setLocale,
+        TimeStampDissector.java:73-78).  Applies to dissectors already
+        registered AND to ones added later during assembly (format tokens
+        create their own strftime dissectors), so it may be called any
+        time before parsing."""
+        self._locale = locale
+        for d in self.all_dissectors:
+            if hasattr(d, "set_locale"):
+                d.set_locale(locale)
+        self._assembled = False  # re-prepare compiled instances
+        return self
+
     def assemble_dissectors(self) -> None:
         if self._assembled:
             return
@@ -331,12 +345,15 @@ class Parser:
 
         # Fixpoint: dissectors may register additional dissectors recursively.
         done: Set[int] = set()
+        locale = getattr(self, "_locale", None)
         while True:
             pending = [d for d in self.all_dissectors if id(d) not in done]
             if not pending:
                 break
             for d in pending:
                 done.add(id(d))
+                if locale is not None and hasattr(d, "set_locale"):
+                    d.set_locale(locale)
                 d.create_additional_dissectors(self)
 
         available = self._assemble_dissector_phases()

@@ -178,6 +178,11 @@ class StrfTimeStampDissector(Dissector):
         self.timestamp_dissector = TimeStampDissector()
         self.strf_pattern: Optional[str] = None
         self._input_type = "TIME.?????"
+        # One LocalizedTimeDissector per instance: create_additional runs
+        # again on every re-assembly (e.g. after set_locale), and
+        # add_dissector dedups by identity — a fresh instance per call
+        # would accumulate duplicates.
+        self._localized: Optional["LocalizedTimeDissector"] = None
 
     def set_date_time_pattern(self, pattern: Optional[str]) -> None:
         if pattern is None:
@@ -194,6 +199,13 @@ class StrfTimeStampDissector(Dissector):
     def initialize_from_settings_parameter(self, settings: str) -> bool:
         self.set_date_time_pattern(settings)
         return True
+
+    def set_locale(self, locale) -> "StrfTimeStampDissector":
+        """Delegates to the embedded TimeStampDissector (the reference's
+        wrapped-dissector shape keeps one locale, TimeStampDissector.java
+        :73-78)."""
+        self.timestamp_dissector.set_locale(locale)
+        return self
 
     def dissect(self, parsable, input_name: str) -> None:
         field: ParsedField = parsable.get_parsable_field(self._input_type, input_name)
@@ -221,11 +233,15 @@ class StrfTimeStampDissector(Dissector):
 
     def initialize_new_instance(self, new_instance: "Dissector") -> None:
         new_instance.set_input_type(self._input_type)
+        new_instance.set_locale(self.timestamp_dissector.locale)
         if self.strf_pattern is not None:
             new_instance.set_date_time_pattern(self.strf_pattern)
 
     def create_additional_dissectors(self, parser) -> None:
-        parser.add_dissector(LocalizedTimeDissector(self._input_type))
+        if self._localized is None:
+            self._localized = LocalizedTimeDissector(self._input_type)
+        self._localized.set_input_type(self._input_type)
+        parser.add_dissector(self._localized)
 
 
 class LocalizedTimeDissector(Dissector):

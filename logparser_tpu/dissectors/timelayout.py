@@ -27,6 +27,155 @@ DAYS_SHORT = ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
 DAYS_FULL = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
              "Saturday", "Sunday"]
 
+
+class LocaleData:
+    """Month/weekday name tables + week rule for one locale.
+
+    The reference's ``TimeStampDissector.setLocale`` threads a
+    ``java.util.Locale`` into its DateTimeFormatter
+    (TimeStampDissector.java:73-78, :106) and into
+    ``WeekFields.of(locale)`` for the LOCAL week outputs (:455-459; the
+    ``_utc`` twins stay WeekFields.ISO, :519-523).  These tables mirror
+    the CLDR data Java's formatter resolves (JDK 9+ default): note the
+    trailing periods in e.g. French/Dutch abbreviated month names.
+    ``week_first_day`` is ISO numbering (1=Monday .. 7=Sunday)."""
+
+    __slots__ = ("tag", "months_short", "months_full", "days_short",
+                 "days_full", "ampm", "week_first_day", "week_min_days")
+
+    def __init__(self, tag, months_short, months_full, days_short, days_full,
+                 ampm=("AM", "PM"), week_first_day=1, week_min_days=4):
+        self.tag = tag
+        self.months_short = months_short
+        self.months_full = months_full
+        self.days_short = days_short
+        self.days_full = days_full
+        self.ampm = ampm
+        self.week_first_day = week_first_day
+        self.week_min_days = week_min_days
+
+
+_EN = LocaleData("en", MONTHS_SHORT, MONTHS_FULL, DAYS_SHORT, DAYS_FULL)
+
+LOCALES = {
+    # Locale.UK: English names, ISO week fields — the reference's default.
+    "en": _EN,
+    "en_gb": _EN,
+    "en_uk": _EN,
+    # Locale.US: same names, Sunday-first weeks with min 1 day.
+    "en_us": LocaleData("en_US", MONTHS_SHORT, MONTHS_FULL, DAYS_SHORT,
+                        DAYS_FULL, week_first_day=7, week_min_days=1),
+    "fr": LocaleData(
+        "fr",
+        ["janv.", "févr.", "mars", "avr.", "mai", "juin",
+         "juil.", "août", "sept.", "oct.", "nov.", "déc."],
+        ["janvier", "février", "mars", "avril", "mai", "juin", "juillet",
+         "août", "septembre", "octobre", "novembre", "décembre"],
+        ["lun.", "mar.", "mer.", "jeu.", "ven.", "sam.", "dim."],
+        ["lundi", "mardi", "mercredi", "jeudi", "vendredi", "samedi",
+         "dimanche"],
+    ),
+    "de": LocaleData(
+        "de",
+        ["Jan.", "Feb.", "März", "Apr.", "Mai", "Juni",
+         "Juli", "Aug.", "Sept.", "Okt.", "Nov.", "Dez."],
+        ["Januar", "Februar", "März", "April", "Mai", "Juni", "Juli",
+         "August", "September", "Oktober", "November", "Dezember"],
+        ["Mo.", "Di.", "Mi.", "Do.", "Fr.", "Sa.", "So."],
+        ["Montag", "Dienstag", "Mittwoch", "Donnerstag", "Freitag",
+         "Samstag", "Sonntag"],
+    ),
+    "es": LocaleData(
+        "es",
+        ["ene.", "feb.", "mar.", "abr.", "may.", "jun.",
+         "jul.", "ago.", "sept.", "oct.", "nov.", "dic."],
+        ["enero", "febrero", "marzo", "abril", "mayo", "junio", "julio",
+         "agosto", "septiembre", "octubre", "noviembre", "diciembre"],
+        ["lun.", "mar.", "mié.", "jue.", "vie.", "sáb.", "dom."],
+        ["lunes", "martes", "miércoles", "jueves", "viernes", "sábado",
+         "domingo"],
+        ampm=("a. m.", "p. m."),
+    ),
+    "it": LocaleData(
+        "it",
+        ["gen", "feb", "mar", "apr", "mag", "giu",
+         "lug", "ago", "set", "ott", "nov", "dic"],
+        ["gennaio", "febbraio", "marzo", "aprile", "maggio", "giugno",
+         "luglio", "agosto", "settembre", "ottobre", "novembre",
+         "dicembre"],
+        ["lun", "mar", "mer", "gio", "ven", "sab", "dom"],
+        ["lunedì", "martedì", "mercoledì", "giovedì", "venerdì", "sabato",
+         "domenica"],
+    ),
+    "nl": LocaleData(
+        "nl",
+        ["jan.", "feb.", "mrt.", "apr.", "mei", "jun.",
+         "jul.", "aug.", "sep.", "okt.", "nov.", "dec."],
+        ["januari", "februari", "maart", "april", "mei", "juni", "juli",
+         "augustus", "september", "oktober", "november", "december"],
+        ["ma", "di", "wo", "do", "vr", "za", "zo"],
+        ["maandag", "dinsdag", "woensdag", "donderdag", "vrijdag",
+         "zaterdag", "zondag"],
+    ),
+    "pt": LocaleData(
+        "pt",
+        ["jan.", "fev.", "mar.", "abr.", "mai.", "jun.",
+         "jul.", "ago.", "set.", "out.", "nov.", "dez."],
+        ["janeiro", "fevereiro", "março", "abril", "maio", "junho",
+         "julho", "agosto", "setembro", "outubro", "novembro", "dezembro"],
+        ["seg.", "ter.", "qua.", "qui.", "sex.", "sáb.", "dom."],
+        ["segunda-feira", "terça-feira", "quarta-feira", "quinta-feira",
+         "sexta-feira", "sábado", "domingo"],
+        week_first_day=7, week_min_days=1,
+    ),
+}
+
+
+def week_based_fields(
+    year: int, month: int, day: int, first_day: int = 1, min_days: int = 4
+) -> Tuple[int, int]:
+    """(week_based_year, week_of_week_based_year) per java.time
+    ``WeekFields.of(locale)`` (ComputedDayOfField.localizedWeekOfWeekBasedYear
+    semantics).  ``first_day``/``min_days`` default to ISO (Monday, 4) —
+    then this agrees with ``datetime.date.isocalendar`` exactly."""
+    date = _dt.date(year, month, day)
+    dow = (date.isoweekday() - first_day) % 7 + 1
+    doy = date.timetuple().tm_yday
+
+    def sow_offset(d, w):
+        week_start = (d - w) % 7
+        return 7 - week_start if week_start + 1 > min_days else -week_start
+
+    offset = sow_offset(doy, dow)
+    week = (7 + offset + doy - 1) // 7
+    if week == 0:
+        # End-of-week of the previous week-based year.
+        prev_len = (_dt.date(year, 1, 1) - _dt.date(year - 1, 1, 1)).days
+        doy2 = doy + prev_len
+        week = (7 + sow_offset(doy2, dow) + doy2 - 1) // 7
+        return year - 1, week
+    if week > 50:
+        year_len = (_dt.date(year + 1, 1, 1) - _dt.date(year, 1, 1)).days
+        new_year_week = (7 + offset + year_len + min_days - 1) // 7
+        if week >= new_year_week:
+            return year + 1, week - new_year_week + 1
+    return year, week
+
+
+def get_locale(tag: Optional[str]) -> LocaleData:
+    """Resolve a locale tag ("fr", "fr_FR", "en-US") to its table.
+
+    Unknown locales fall back to the English root tables with ISO weeks —
+    the same graceful degradation as Java resolving missing CLDR data
+    through the root locale."""
+    if not tag:
+        return _EN
+    norm = tag.strip().lower().replace("-", "_")
+    got = LOCALES.get(norm)
+    if got is None:
+        got = LOCALES.get(norm.split("_")[0], _EN)
+    return got
+
 # Curated zone-abbreviation table for %Z-style zone text (Java resolves these
 # through its locale zone-name tables; we map to tzdata zones/fixed offsets).
 _ZONE_ABBREVIATIONS = {
@@ -142,13 +291,20 @@ class ParsedTimestamp:
 class TimeLayout:
     """A compiled, serializable timestamp layout."""
 
-    def __init__(self, items: List[Item], default_zone: Optional[str] = None):
+    def __init__(self, items: List[Item], default_zone: Optional[str] = None,
+                 locale: Optional[LocaleData] = None):
         self.items = items
         # tzdata id applied when the layout itself carries no zone
         # (StrfTimeToDateTimeFormatter.java:97-105 defaults likewise).
         self.default_zone = default_zone
+        # Month/day name tables (TimeStampDissector.setLocale semantics).
+        self.locale = locale or _EN
         self._fast = None          # lazily compiled regex fast path
         self._fast_tried = False
+
+    def with_locale(self, locale: LocaleData) -> "TimeLayout":
+        """The same layout re-bound to another locale's name tables."""
+        return TimeLayout(self.items, self.default_zone, locale)
 
     def has_zone(self) -> bool:
         return any(it[0] in ("offset", "offset_colon", "zonetext") for it in self.items)
@@ -176,13 +332,15 @@ class TimeLayout:
             elif kind == "text":
                 _, field, style = it
                 if field == "monthname":
-                    table = MONTHS_FULL if style == "full" else MONTHS_SHORT
+                    table = (self.locale.months_full if style == "full"
+                             else self.locale.months_short)
                     key = "month"
                 elif field == "dayname":
-                    table = DAYS_FULL if style == "full" else DAYS_SHORT
+                    table = (self.locale.days_full if style == "full"
+                             else self.locale.days_short)
                     key = "dayofweek"
                 else:
-                    table = ["AM", "PM"]
+                    table = list(self.locale.ampm)
                     key = "ampm"
                 alts = sorted(table, key=len, reverse=True)
                 parts.append("(" + "|".join(re.escape(a) for a in alts) + ")")
@@ -276,13 +434,16 @@ class TimeLayout:
 
     def _parse_text(self, s, pos, field, style, fields) -> int:
         if field == "monthname":
-            table = MONTHS_FULL if style == "full" else MONTHS_SHORT
+            table = (self.locale.months_full if style == "full"
+                     else self.locale.months_short)
             key = "month"
         elif field == "dayname":
-            table = DAYS_FULL if style == "full" else DAYS_SHORT
+            table = (self.locale.days_full if style == "full"
+                     else self.locale.days_short)
             key = "dayofweek"
         else:  # ampm
-            table = ["AM", "PM"] if style == "upper" else ["am", "pm"]
+            table = (list(self.locale.ampm) if style == "upper"
+                     else [a.lower() for a in self.locale.ampm])
             key = "ampm"
         low = s[pos:].lower()
         for idx, name in enumerate(table):
@@ -412,7 +573,11 @@ class TimeLayout:
 # java.time pattern front-end (the subset the reference uses)
 # ---------------------------------------------------------------------------
 
-def compile_java_pattern(pattern: str, default_zone: Optional[str] = None) -> TimeLayout:
+def compile_java_pattern(
+    pattern: str,
+    default_zone: Optional[str] = None,
+    locale: Optional[LocaleData] = None,
+) -> TimeLayout:
     """Compile the java.time pattern subset used by the reference:
     d/dd, M/MM/MMM/MMMM, y/yy/yyyy, H/HH, m/mm, s/ss, S/SSS, E/EEE/EEEE,
     Z/ZZ/ZZZ (+HHMM), X/XX/XXX (+HH:MM, Z), z (zone text), quoted literals.
@@ -492,4 +657,4 @@ def compile_java_pattern(pattern: str, default_zone: Optional[str] = None) -> Ti
         else:
             merged.append(list(it) if it[0] == "lit" else it)
     merged = [tuple(it) if isinstance(it, list) else it for it in merged]
-    return TimeLayout(merged, default_zone)
+    return TimeLayout(merged, default_zone, locale)

@@ -19,7 +19,14 @@ from ..core.casts import Cast, NO_CASTS, STRING_ONLY, STRING_OR_LONG
 from ..core.dissector import Dissector, extract_field_name
 from ..core.exceptions import DissectionFailure
 from ..core.fields import ParsedField
-from .timelayout import TimeLayout, TimestampParseError, compile_java_pattern
+from .timelayout import (
+    LocaleData,
+    TimeLayout,
+    TimestampParseError,
+    compile_java_pattern,
+    get_locale,
+    week_based_fields,
+)
 
 DEFAULT_APACHE_DATE_TIME_PATTERN = "dd/MMM/yyyy:HH:mm:ss ZZ"
 
@@ -46,11 +53,15 @@ class TimeStampDissector(Dissector):
         self,
         date_time_pattern: str = DEFAULT_APACHE_DATE_TIME_PATTERN,
         input_type: str = "TIME.STAMP",
+        locale: Optional[str] = None,
     ):
         self._input_type = input_type
         if not date_time_pattern or not date_time_pattern.strip():
             date_time_pattern = DEFAULT_APACHE_DATE_TIME_PATTERN
         self.date_time_pattern = date_time_pattern
+        # Reference default is Locale.UK — English names, ISO week fields
+        # (TimeStampDissector.java:52).
+        self.locale = get_locale(locale)
         self._layout: Optional[TimeLayout] = None
         self.wanted: set = set()
 
@@ -64,13 +75,27 @@ class TimeStampDissector(Dissector):
         self.date_time_pattern = pattern
         self._layout = None
 
+    def set_locale(self, locale) -> "TimeStampDissector":
+        """Month/weekday name tables + week rule for parsing and the
+        monthname/week outputs (TimeStampDissector.java:73-78 setLocale).
+        Accepts a tag ("fr", "en_US") or a LocaleData; returns self like
+        the reference's builder-style setter."""
+        self.locale = (
+            locale if isinstance(locale, LocaleData) else get_locale(locale)
+        )
+        if self._layout is not None:
+            self._layout = self._layout.with_locale(self.locale)
+        return self
+
     def set_layout(self, layout: TimeLayout) -> None:
         """Install a pre-compiled layout (used by the strftime front-end)."""
-        self._layout = layout
+        self._layout = layout.with_locale(self.locale)
 
     def get_layout(self) -> TimeLayout:
         if self._layout is None:
-            self._layout = compile_java_pattern(self.date_time_pattern)
+            self._layout = compile_java_pattern(
+                self.date_time_pattern, locale=self.locale
+            )
         return self._layout
 
     def get_new_instance(self) -> "Dissector":
@@ -81,6 +106,7 @@ class TimeStampDissector(Dissector):
     def initialize_new_instance(self, new_instance: "Dissector") -> None:
         new_instance._input_type = self._input_type
         new_instance.date_time_pattern = self.date_time_pattern
+        new_instance.locale = self.locale
         if self._layout is not None:
             new_instance._layout = self._layout
 
@@ -153,13 +179,33 @@ class TimeStampDissector(Dissector):
         if "day" + suffix in w:
             add(input_name, "TIME.DAY", "day" + suffix, ts.day)
         if "monthname" + suffix in w:
-            add(input_name, "TIME.MONTHNAME", "monthname" + suffix, ts.monthname())
+            # getDisplayName(TextStyle.FULL, locale): the locale's full
+            # month name for BOTH local and _utc (TimeStampDissector.java
+            # :446-447, :510-511).
+            add(input_name, "TIME.MONTHNAME", "monthname" + suffix,
+                self.locale.months_full[ts.month - 1])
         if "month" + suffix in w:
             add(input_name, "TIME.MONTH", "month" + suffix, ts.month)
         if "weekofweekyear" + suffix in w:
-            add(input_name, "TIME.WEEK", "weekofweekyear" + suffix, ts.iso_week())
+            # Local weeks follow WeekFields.of(locale) (:455-459); the
+            # _utc twins stay WeekFields.ISO (:519-523).
+            wk = (
+                ts.iso_week() if suffix
+                else week_based_fields(
+                    ts.year, ts.month, ts.day,
+                    self.locale.week_first_day, self.locale.week_min_days,
+                )[1]
+            )
+            add(input_name, "TIME.WEEK", "weekofweekyear" + suffix, wk)
         if "weekyear" + suffix in w:
-            add(input_name, "TIME.YEAR", "weekyear" + suffix, ts.iso_weekyear())
+            wy = (
+                ts.iso_weekyear() if suffix
+                else week_based_fields(
+                    ts.year, ts.month, ts.day,
+                    self.locale.week_first_day, self.locale.week_min_days,
+                )[0]
+            )
+            add(input_name, "TIME.YEAR", "weekyear" + suffix, wy)
         if "year" + suffix in w:
             add(input_name, "TIME.YEAR", "year" + suffix, ts.year)
         if "hour" + suffix in w:

@@ -35,12 +35,19 @@ class HttpdLoglineParser(Parser):
         record_class: Optional[type],
         log_format: str,
         timestamp_format: Optional[str] = None,
+        locale: Optional[str] = None,
     ):
         from ..observability import log_version_banner_once
 
         super().__init__(record_class)
         log_version_banner_once()  # startup banner, HttpdLoglineParser.java:54-94
         self._setup_dissectors(log_format, timestamp_format)
+        if locale is not None:
+            # Parser-level surface over TimeStampDissector.setLocale
+            # (TimeStampDissector.java:73-78): month/day name tables +
+            # WeekFields rule for every timestamp dissector, including
+            # the per-token strftime instances created during assembly.
+            self.set_locale(locale)
 
     def _setup_dissectors(
         self, log_format: str, timestamp_format: Optional[str]

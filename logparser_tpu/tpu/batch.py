@@ -572,6 +572,7 @@ class TpuBatchParser:
         timestamp_format: Optional[str] = None,
         type_remappings: Optional[Dict[str, Any]] = None,
         extra_dissectors: Optional[Sequence[Any]] = None,
+        locale: Optional[str] = None,
     ):
         self.log_format = log_format
         self.requested = [cleanup_field_value(f) for f in fields]
@@ -581,7 +582,9 @@ class TpuBatchParser:
         # priority across formats, so its fallback oracle must not carry
         # the reference's active-format state between lines (see
         # HttpdLogFormatDissector.stateless).
-        self.oracle = HttpdLoglineParser(_CollectingRecord, log_format, timestamp_format)
+        self.oracle = HttpdLoglineParser(
+            _CollectingRecord, log_format, timestamp_format, locale=locale
+        )
         self.oracle.all_dissectors[0].stateless = True
         self.oracle.apply_config(type_remappings, extra_dissectors)
         self.oracle.add_parse_target("set_value", list(self.requested))
@@ -1415,7 +1418,10 @@ class TpuBatchParser:
                     )
                 elif plan.kind == "ts":
                     comp, ok, memo = unit_ts(u, ui, plan)
-                    values = timefields.derive(comp, plan.comp, memo)
+                    values = timefields.derive(
+                        comp, plan.comp, memo,
+                        locale=getattr(plan.meta, "locale", None),
+                    )
                     col["values"] = np.where(sel, values, col["values"])
                     col["ok"] = np.where(sel, ok, col["ok"])
                 elif plan.kind == "geo":

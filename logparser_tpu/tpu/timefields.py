@@ -83,18 +83,58 @@ def iso_week_fields(c: Components):
     return ty, week
 
 
+def locale_week_fields(c: Components, first_day: int, min_days: int):
+    """(weekyear, weekofweekyear) per java.time ``WeekFields.of(locale)``
+    (the vectorized twin of ``timelayout.week_based_fields``); the LOCAL
+    week outputs follow the dissector's locale
+    (TimeStampDissector.java:455-459) while the ``_utc`` twins stay ISO."""
+    y = c["year"].astype(np.int64)
+    days = days_from_civil(y, c["month"], c["day"])
+    isodow = np.mod(days + 3, 7) + 1
+    dow = np.mod(isodow - first_day, 7) + 1
+    ones = np.ones_like(y)
+    jan1 = days_from_civil(y, ones, ones)
+    doy = days - jan1 + 1
+
+    def sow_offset(d):
+        week_start = np.mod(d - dow, 7)
+        return np.where(week_start + 1 > min_days, 7 - week_start, -week_start)
+
+    offset = sow_offset(doy)
+    week = np.floor_divide(7 + offset + doy - 1, 7)
+    # week == 0: end-of-week of the previous week-based year.
+    prev_len = jan1 - days_from_civil(y - 1, ones, ones)
+    doy2 = doy + prev_len
+    week_prev = np.floor_divide(7 + sow_offset(doy2) + doy2 - 1, 7)
+    # week > 50: possibly the partial week belonging to the next year.
+    year_len = days_from_civil(y + 1, ones, ones) - jan1
+    new_year_week = np.floor_divide(7 + offset + year_len + min_days - 1, 7)
+    spill = (week > 50) & (week >= new_year_week)
+    wy = np.where(week == 0, y - 1, np.where(spill, y + 1, y))
+    wk = np.where(
+        week == 0, week_prev, np.where(spill, week - new_year_week + 1, week)
+    )
+    return wy, wk
+
+
 def _zfill(a: np.ndarray, width: int) -> np.ndarray:
     return np.char.zfill(a.astype(np.int64).astype(f"U{width}"), width)
 
 
-def derive(comp: Components, name: str, memo: dict = None) -> np.ndarray:
+def derive(
+    comp: Components, name: str, memo: dict = None, locale=None
+) -> np.ndarray:
     """One TimeStampDissector output column from the component bundle.
 
     ``name`` is the dissector-relative output name (``epoch``, ``year``,
     ``monthname_utc``, ``date``, ...).  Numeric outputs come back int64;
     string outputs come back as numpy unicode arrays.  Pass one ``memo``
     dict per bundle to share the O(B) intermediates (epoch, UTC bundle,
-    ISO week pair) across the outputs of the same timestamp.
+    week pair) across the outputs of the same timestamp.  ``locale``
+    (a ``timelayout.LocaleData``) localizes monthname and the LOCAL week
+    fields; ``_utc`` week twins stay ISO like the reference
+    (TimeStampDissector.java:519-523) while monthname_utc follows the
+    locale (:510-511).
     """
     if memo is None:
         memo = {}
@@ -108,7 +148,10 @@ def derive(comp: Components, name: str, memo: dict = None) -> np.ndarray:
         return shared("epoch", epoch_millis)
     if name.endswith("_utc"):
         utc = shared("utc", utc_components)
-        return derive(utc, name[: -len("_utc")], memo.setdefault("utc_memo", {}))
+        base = name[: -len("_utc")]
+        if base in ("weekyear", "weekofweekyear"):
+            locale = None  # UTC week twins are always WeekFields.ISO
+        return derive(utc, base, memo.setdefault("utc_memo", {}), locale)
     if name in ("year", "month", "day", "hour", "minute", "second"):
         return comp[name]
     if name == "millisecond":
@@ -117,12 +160,23 @@ def derive(comp: Components, name: str, memo: dict = None) -> np.ndarray:
         return comp["milli"] * 1000
     if name == "nanosecond":
         return comp["milli"] * 1000000
-    if name == "weekyear":
-        return shared("isoweek", iso_week_fields)[0]
-    if name == "weekofweekyear":
-        return shared("isoweek", iso_week_fields)[1]
+    if name in ("weekyear", "weekofweekyear"):
+        if locale is not None and (
+            locale.week_first_day != 1 or locale.week_min_days != 4
+        ):
+            pair = shared(
+                f"week:{locale.week_first_day}:{locale.week_min_days}",
+                lambda c: locale_week_fields(
+                    c, locale.week_first_day, locale.week_min_days
+                ),
+            )
+        else:
+            pair = shared("isoweek", iso_week_fields)
+        return pair[0] if name == "weekyear" else pair[1]
     if name == "monthname":
-        table = np.array(MONTHS_FULL)
+        table = np.array(
+            MONTHS_FULL if locale is None else list(locale.months_full)
+        )
         return table[np.clip(comp["month"], 1, 12) - 1]
     if name == "date":
         return np.char.add(

@@ -8,23 +8,26 @@ sits at a static byte offset, and executes it over ``[B]`` spans of a
 ``[B, L]`` byte batch as pure vector arithmetic (the TPU replacement for
 TimeStampDissector.java:404-424's per-line ``DateTimeFormatter.parse``).
 
-Device-compilable layouts: every item fixed-width (numeric fields with
-min==max width, 3-letter month/day names, am/pm, literals), with at most one
-variable-width item — the UTC-offset — in tail position (``ZZ`` accepts
+Device-compilable layouts: numeric fields with min==max width, literals,
+month/day NAME tables (short or full, any locale — entries are matched
+byte-wise against the layout's locale tables, so variable-width localized
+names like French ``janv.``/``août`` segment the layout at a per-row
+cursor instead of forcing the oracle), am/pm, and at most one
+variable-width UTC-offset in tail position (``ZZ`` accepts
 ``+HHMM``/``+HH:MM`` and ``XXX`` accepts ``Z``/``+HH:MM``; both are
-distinguishable by total span width, so a trailing zone stays vectorizable).
-This covers the Apache default ``dd/MMM/yyyy:HH:mm:ss ZZ``, nginx
-``$time_iso8601`` (``yyyy-MM-dd'T'HH:mm:ssXXX``), and the fixed-width
-strftime family (``%d/%b/%Y:%H:%M:%S %z``, ``%Y-%m-%d %H:%M:%S``, ...).
-Everything else (variable month names, zone *names* needing tzdata/DST,
-week-based dates) stays on the host oracle.
+distinguishable by remaining span width).  This covers the Apache default
+``dd/MMM/yyyy:HH:mm:ss ZZ``, nginx ``$time_iso8601``
+(``yyyy-MM-dd'T'HH:mm:ssXXX``), the fixed-width strftime family, and the
+localized variants of all of these.  Zone *names* needing tzdata/DST and
+week-based dates stay on the host oracle.
 
 Validation discipline: the device must never accept a span the host layout
 rejects (a false-accept would bypass the oracle with a wrong value).  Every
-digit is range-checked, literals compare case-insensitively exactly like
-``TimeLayout.parse``, month/day names must be table members, and
-day-in-month honors leap years.  Device-stricter is fine — a rejected line
-falls back to the oracle, which re-applies the exact host semantics.
+digit is range-checked, literals and ASCII name letters compare
+case-insensitively exactly like ``TimeLayout.parse`` (non-ASCII name bytes
+compare exactly — an off-case ``AOÛT`` fails device validation and falls
+back to the oracle, which accepts it; device-stricter is always safe),
+month/day names must be table members, and day-in-month honors leap years.
 """
 from __future__ import annotations
 
@@ -37,11 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from .postproc import pow10_weights
-from ..dissectors.timelayout import (
-    DAYS_SHORT,
-    MONTHS_SHORT,
-    TimeLayout,
-)
+from ..dissectors.timelayout import LocaleData, TimeLayout
 
 # Zones that are a fixed UTC offset year-round (no DST), so a layout whose
 # default_zone is one of these still compiles to constant offset arithmetic.
@@ -50,25 +49,30 @@ _FIXED_OFFSET_ZONES = {"UTC": 0, "GMT": 0, "Z": 0, "UT": 0, "Etc/UTC": 0}
 
 @dataclass(frozen=True)
 class _DevItem:
-    kind: str        # lit | num | monthname | dayname | ampm
-    offset: int      # byte offset from span start
-    width: int
-    field: str = ""  # for num
-    text: bytes = b""  # for lit
+    kind: str        # lit | num | name | ampm
+    offset: int      # byte offset within its SEGMENT
+    width: int       # fixed width (for name/ampm: the max entry width)
+    field: str = ""  # num: layout field; name: "month" | "dayofweek"
+    text: bytes = b""            # lit
+    table: Tuple[bytes, ...] = ()  # name/ampm: per-entry canonical bytes
 
 
 @dataclass
 class DeviceTimeLayout:
-    """A TimeLayout resolved to fixed byte offsets (device-executable)."""
+    """A TimeLayout resolved to per-segment byte offsets.
 
-    items: Tuple[_DevItem, ...]
-    prefix_width: int              # total width of the fixed items
+    Segments are runs of fixed-width items; a NAME item whose locale
+    table has entries of differing byte lengths forms its own segment
+    and advances the per-row cursor by the matched entry's length —
+    that is how localized month names (French ``mars`` vs ``janv.``)
+    stay device-resident."""
+
+    segments: Tuple[Tuple[_DevItem, ...], ...]
+    seg_widths: Tuple[int, ...]    # fixed byte width per segment; -1 = var
     tail: str                      # "" | "offset" | "offset_colon"
     default_offset_seconds: int    # applied when tail == ""
-
-    @property
-    def max_width(self) -> int:
-        return self.prefix_width + (6 if self.tail else 0)
+    locale: Optional[LocaleData] = None
+    min_prefix: int = 0            # lower bound of the pre-tail width
 
 
 # Numeric layout fields the device models, with their post-parse range
@@ -81,42 +85,74 @@ _NUM_FIELDS = {
 
 def compile_layout_for_device(layout: TimeLayout) -> Optional[DeviceTimeLayout]:
     """TimeLayout -> DeviceTimeLayout, or None when any item is outside the
-    fixed-width subset (caller keeps the field on the host oracle)."""
-    items: List[_DevItem] = []
+    device subset (caller keeps the field on the host oracle)."""
+    loc = layout.locale
+    segments: List[Tuple[_DevItem, ...]] = []
+    seg_widths: List[int] = []
+    cur: List[_DevItem] = []
     offset = 0
+    min_prefix = 0
     tail = ""
     n = len(layout.items)
+
+    def close_segment():
+        nonlocal cur, offset
+        if cur:
+            segments.append(tuple(cur))
+            seg_widths.append(offset)
+        cur = []
+        offset = 0
+
+    def name_tables(field: str, style: str):
+        if field == "monthname":
+            names = loc.months_full if style == "full" else loc.months_short
+            return "month", names
+        if field == "dayname":
+            names = loc.days_full if style == "full" else loc.days_short
+            return "dayofweek", names
+        return "ampm", list(loc.ampm)
+
     for idx, it in enumerate(layout.items):
         kind = it[0]
         if kind == "lit":
             text = it[1].encode("utf-8", errors="strict")
-            items.append(_DevItem("lit", offset, len(text), text=text))
+            cur.append(_DevItem("lit", offset, len(text), text=text))
             offset += len(text)
+            min_prefix += len(text)
         elif kind == "num":
             _, field, minw, maxw, space_pad = it
             if space_pad or minw != maxw or field not in _NUM_FIELDS:
                 return None
-            items.append(_DevItem("num", offset, minw, field=field))
+            cur.append(_DevItem("num", offset, minw, field=field))
             offset += minw
+            min_prefix += minw
         elif kind == "text":
             _, field, style = it
-            if field == "monthname" and style == "short":
-                items.append(_DevItem("monthname", offset, 3))
-                offset += 3
-            elif field == "dayname" and style == "short":
-                items.append(_DevItem("dayname", offset, 3))
-                offset += 3
-            elif field == "ampm":
-                items.append(_DevItem("ampm", offset, 2))
-                offset += 2
+            key, names = name_tables(field, style)
+            table = tuple(nm.encode("utf-8") for nm in names)
+            widths = {len(t) for t in table}
+            w = max(widths)
+            dev_kind = "ampm" if key == "ampm" else "name"
+            if len(widths) == 1:
+                cur.append(_DevItem(dev_kind, offset, w, field=key,
+                                    table=table))
+                offset += w
+                min_prefix += w
             else:
-                return None  # full names are variable-width
+                # Variable entry widths: own segment, per-row advance.
+                close_segment()
+                segments.append(
+                    (_DevItem(dev_kind, 0, w, field=key, table=table),)
+                )
+                seg_widths.append(-1)
+                min_prefix += min(widths)
         elif kind in ("offset", "offset_colon"):
             if idx != n - 1:
                 return None  # variable width is only decodable at the tail
             tail = kind
         else:  # zonetext and anything new: host-only
             return None
+    close_segment()
 
     default_offset = 0
     if not tail:
@@ -125,12 +161,18 @@ def compile_layout_for_device(layout: TimeLayout) -> Optional[DeviceTimeLayout]:
             return None  # DST zones need tzdata; host-only
         default_offset = _FIXED_OFFSET_ZONES.get(zone or "UTC", 0)
 
-    fields = {i.field for i in items if i.kind == "num"}
-    has_month = "month" in fields or any(i.kind == "monthname" for i in items)
+    flat = [i for seg in segments for i in seg]
+    fields = {i.field for i in flat if i.kind == "num"}
+    has_month = "month" in fields or any(
+        i.kind == "name" and i.field == "month" for i in flat
+    )
     if not ((("year" in fields) or ("year2" in fields)) and has_month
             and "day" in fields):
         return None  # incomplete date resolves through host paths
-    return DeviceTimeLayout(tuple(items), offset, tail, default_offset)
+    return DeviceTimeLayout(
+        tuple(segments), tuple(seg_widths), tail, default_offset,
+        locale=loc, min_prefix=min_prefix,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -138,9 +180,12 @@ def compile_layout_for_device(layout: TimeLayout) -> Optional[DeviceTimeLayout]:
 # ---------------------------------------------------------------------------
 
 
-def _name_hash(name: str) -> int:
-    a, b, c = (ord(ch) - 97 for ch in name.lower()[:3])
-    return (a * 26 + b) * 26 + c
+def _fold_byte(byte: int) -> Optional[int]:
+    """ASCII-lowercased byte value, or None for non-letters (compared
+    exactly)."""
+    if ord("a") <= (byte | 0x20) <= ord("z"):
+        return byte | 0x20
+    return None
 
 
 def parse_device_timestamp(
@@ -154,110 +199,119 @@ def parse_device_timestamp(
 
     Returns (components, ok): components has int32 arrays
     ``year month day hour minute second milli offset_seconds`` (local wall
-    clock + UTC offset; epoch math happens host-side in int64).
+    clock + UTC offset; epoch math happens host-side in int64).  Segments
+    run at a per-row cursor, so variable-width localized name tables keep
+    their rows on device.
     """
     B = buf.shape[0]
-    b = extract(buf, start, dl.max_width)
     width = end - start
-    ok = width >= dl.prefix_width
+    ok = width >= dl.min_prefix
+    cursor = start
 
     zeros = jnp.zeros(B, dtype=jnp.int32)
     comp: Dict[str, jnp.ndarray] = {}
 
-    def digits(off: int, w: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        # One [B, w] vector op chain instead of w scalar-column rounds.
-        d = (b[:, off : off + w] - np.uint8(ord("0"))).astype(jnp.int32)
-        good = jnp.all((d >= 0) & (d <= 9), axis=1)
-        val = jnp.sum(d * pow10_weights(w), axis=1).astype(jnp.int32)
-        return val, good
+    def match_entry(b, lower, off: int, entry: bytes):
+        m = None
+        for i, byte in enumerate(entry):
+            folded = _fold_byte(byte)
+            if folded is not None:
+                part = lower[:, off + i] == np.uint8(folded)
+            else:
+                part = b[:, off + i] == np.uint8(byte)
+            m = part if m is None else (m & part)
+        return m if m is not None else jnp.ones(B, dtype=bool)
 
-    lower = b | np.uint8(0x20)
     month_from_name = None
-    for it in dl.items:
-        if it.kind == "lit":
-            for i, byte in enumerate(it.text):
-                col = it.offset + i
-                if ord("a") <= (byte | 0x20) <= ord("z"):
-                    ok = ok & (lower[:, col] == np.uint8(byte | 0x20))
-                else:
-                    ok = ok & (b[:, col] == np.uint8(byte))
-        elif it.kind == "num":
-            val, good = digits(it.offset, it.width)
-            ok = ok & good
-            comp[it.field] = val
-        elif it.kind == "monthname":
-            l0 = (lower[:, it.offset] - np.uint8(ord("a"))).astype(jnp.int32)
-            l1 = (lower[:, it.offset + 1] - np.uint8(ord("a"))).astype(jnp.int32)
-            l2 = (lower[:, it.offset + 2] - np.uint8(ord("a"))).astype(jnp.int32)
-            letters = (
-                (l0 >= 0) & (l0 < 26) & (l1 >= 0) & (l1 < 26)
-                & (l2 >= 0) & (l2 < 26)
-            )
-            h = (l0 * 26 + l1) * 26 + l2
-            month = zeros
-            for m, name in enumerate(MONTHS_SHORT, start=1):
-                month = jnp.where(h == _name_hash(name), m, month)
-            ok = ok & letters & (month >= 1)
-            month_from_name = month
-        elif it.kind == "dayname":
-            l0 = (lower[:, it.offset] - np.uint8(ord("a"))).astype(jnp.int32)
-            l1 = (lower[:, it.offset + 1] - np.uint8(ord("a"))).astype(jnp.int32)
-            l2 = (lower[:, it.offset + 2] - np.uint8(ord("a"))).astype(jnp.int32)
-            letters = (
-                (l0 >= 0) & (l0 < 26) & (l1 >= 0) & (l1 < 26)
-                & (l2 >= 0) & (l2 < 26)
-            )
-            h = (l0 * 26 + l1) * 26 + l2
-            known = jnp.zeros(B, dtype=bool)
-            for name in DAYS_SHORT:
-                known = known | (h == _name_hash(name))
-            # The parsed value is validated but unused (the host resolver
-            # ignores dayofweek too).
-            ok = ok & letters & known
-        elif it.kind == "ampm":
-            c0 = lower[:, it.offset]
-            c1 = lower[:, it.offset + 1]
-            is_am = c0 == np.uint8(ord("a"))
-            is_pm = c0 == np.uint8(ord("p"))
-            ok = ok & (is_am | is_pm) & (c1 == np.uint8(ord("m")))
-            comp["ampm"] = jnp.where(is_pm, 1, 0)
-        else:  # pragma: no cover
-            raise AssertionError(it.kind)
+    for seg, seg_w in zip(dl.segments, dl.seg_widths):
+        win_w = seg_w if seg_w >= 0 else max(i.width for i in seg)
+        b = extract(buf, cursor, win_w)
+        lower = b | np.uint8(0x20)
 
-    # ---- tail zone ----------------------------------------------------
-    p = dl.prefix_width
-    if dl.tail == "offset":
-        # ZZ: [+-]HHMM (w==5) or [+-]HH:MM (w==6).
-        tail_w = width - p
-        colon = tail_w == 6
-        sign_b = b[:, p]
+        def digits(off: int, w: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            # One [B, w] vector op chain instead of w scalar rounds.
+            d = (b[:, off : off + w] - np.uint8(ord("0"))).astype(jnp.int32)
+            good = jnp.all((d >= 0) & (d <= 9), axis=1)
+            val = jnp.sum(d * pow10_weights(w), axis=1).astype(jnp.int32)
+            return val, good
+
+        for it in seg:
+            if it.kind == "lit":
+                ok = ok & match_entry(b, lower, it.offset, it.text)
+            elif it.kind == "num":
+                val, good = digits(it.offset, it.width)
+                ok = ok & good
+                comp[it.field] = val
+            elif it.kind in ("name", "ampm"):
+                # Table match in host-table ORDER (first match wins, like
+                # TimeLayout._parse_text): iterate reversed so earlier
+                # entries overwrite later ones.
+                value = zeros
+                wsel = zeros
+                matched = jnp.zeros(B, dtype=bool)
+                for idx in reversed(range(len(it.table))):
+                    entry = it.table[idx]
+                    m = match_entry(b, lower, it.offset, entry) & (
+                        cursor + len(entry) <= end
+                    )
+                    value = jnp.where(m, idx, value)
+                    wsel = jnp.where(m, len(entry), wsel)
+                    matched = matched | m
+                ok = ok & matched
+                if it.kind == "ampm":
+                    comp["ampm"] = value
+                elif it.field == "month":
+                    month_from_name = value + 1
+                # dayofweek is validated but unused (the host resolver
+                # ignores it too).
+                if seg_w < 0:
+                    cursor = cursor + wsel
+            else:  # pragma: no cover
+                raise AssertionError(it.kind)
+        if seg_w >= 0:
+            cursor = cursor + seg_w
+
+    # ---- tail zone (parsed at the final cursor) -----------------------
+    tail_w = end - cursor
+    if dl.tail:
+        b = extract(buf, cursor, 6)
+        lower = b | np.uint8(0x20)
+
+        def tdigits(off: int, w: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            d = (b[:, off : off + w] - np.uint8(ord("0"))).astype(jnp.int32)
+            good = jnp.all((d >= 0) & (d <= 9), axis=1)
+            val = jnp.sum(d * pow10_weights(w), axis=1).astype(jnp.int32)
+            return val, good
+
+        sign_b = b[:, 0]
         sign = jnp.where(sign_b == np.uint8(ord("-")), -1, 1).astype(jnp.int32)
         sign_ok = (sign_b == np.uint8(ord("+"))) | (sign_b == np.uint8(ord("-")))
-        oh, oh_ok = digits(p + 1, 2)
-        m_nc, m_nc_ok = digits(p + 3, 2)
-        m_c, m_c_ok = digits(p + 4, 2)
-        om = jnp.where(colon, m_c, m_nc)
-        om_ok = jnp.where(colon, m_c_ok & (b[:, p + 3] == np.uint8(ord(":"))),
-                          m_nc_ok)
-        ok = ok & ((tail_w == 5) | colon) & sign_ok & oh_ok & om_ok
-        comp["offset_seconds"] = sign * (oh * 3600 + om * 60)
-    elif dl.tail == "offset_colon":
-        # XXX: 'Z' (w==1) or [+-]HH:MM (w==6).
-        tail_w = width - p
-        is_z = (tail_w == 1) & (lower[:, p] == np.uint8(ord("z")))
-        sign_b = b[:, p]
-        sign = jnp.where(sign_b == np.uint8(ord("-")), -1, 1).astype(jnp.int32)
-        sign_ok = (sign_b == np.uint8(ord("+"))) | (sign_b == np.uint8(ord("-")))
-        oh, oh_ok = digits(p + 1, 2)
-        om, om_ok = digits(p + 4, 2)
-        full_ok = (
-            (tail_w == 6) & sign_ok & oh_ok & om_ok
-            & (b[:, p + 3] == np.uint8(ord(":")))
-        )
-        ok = ok & (is_z | full_ok)
-        comp["offset_seconds"] = jnp.where(is_z, 0, sign * (oh * 3600 + om * 60))
+        oh, oh_ok = tdigits(1, 2)
+        if dl.tail == "offset":
+            # ZZ: [+-]HHMM (w==5) or [+-]HH:MM (w==6).
+            colon = tail_w == 6
+            m_nc, m_nc_ok = tdigits(3, 2)
+            m_c, m_c_ok = tdigits(4, 2)
+            om = jnp.where(colon, m_c, m_nc)
+            om_ok = jnp.where(
+                colon, m_c_ok & (b[:, 3] == np.uint8(ord(":"))), m_nc_ok
+            )
+            ok = ok & ((tail_w == 5) | colon) & sign_ok & oh_ok & om_ok
+            comp["offset_seconds"] = sign * (oh * 3600 + om * 60)
+        else:
+            # XXX: 'Z' (w==1) or [+-]HH:MM (w==6).
+            is_z = (tail_w == 1) & (lower[:, 0] == np.uint8(ord("z")))
+            om, om_ok = tdigits(4, 2)
+            full_ok = (
+                (tail_w == 6) & sign_ok & oh_ok & om_ok
+                & (b[:, 3] == np.uint8(ord(":")))
+            )
+            ok = ok & (is_z | full_ok)
+            comp["offset_seconds"] = jnp.where(
+                is_z, 0, sign * (oh * 3600 + om * 60)
+            )
     else:
-        ok = ok & (width == p)
+        ok = ok & (tail_w == 0)
         comp["offset_seconds"] = jnp.full(B, dl.default_offset_seconds,
                                           dtype=jnp.int32)
 

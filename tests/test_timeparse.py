@@ -32,7 +32,8 @@ DEVICE_LAYOUTS = [
 ]
 
 HOST_ONLY_LAYOUTS = [
-    ("java", "dd/MMMM/yyyy HH:mm"),       # full month name: variable width
+    # Full month names (dd/MMMM/yyyy) are DEVICE layouts since round 3:
+    # variable-width name tables segment at a per-row cursor.
     ("strf", "%e/%b/%Y"),                 # space-padded day
     ("strf", "%G-W%V-%u"),                # ISO week date
     ("strf", "%d/%b/%Y %H:%M:%S %Z"),     # zone text needs tzdata
@@ -196,3 +197,167 @@ def test_derived_outputs_match_host_engine():
                 assert int(value) == expected, (name, s)
             else:
                 assert str(value) == expected, (name, s)
+
+
+# -- locales (round 3: TimeStampDissector.setLocale) --------------------------
+
+
+class TestLocaleLayouts:
+    """Localized name tables: parse + device residency + week rules
+    (reference: TimeStampDissector.java:73-78 setLocale, :455-459 local
+    WeekFields.of(locale), :519-523 UTC weeks stay ISO)."""
+
+    def test_french_layout_parses(self):
+        from logparser_tpu.dissectors.timelayout import get_locale
+
+        layout = compile_java_pattern(
+            "dd/MMM/yyyy:HH:mm:ss ZZ", locale=get_locale("fr")
+        )
+        ts = layout.parse("07/févr./2026:10:30:00 +0100")
+        assert (ts.year, ts.month, ts.day) == (2026, 2, 7)
+        ts2 = layout.parse("01/août/2026:00:00:00 +0200")
+        assert ts2.month == 8
+
+    def test_french_layout_device_resident(self):
+        from logparser_tpu.dissectors.timelayout import get_locale
+
+        layout = compile_java_pattern(
+            "dd/MMM/yyyy:HH:mm:ss ZZ", locale=get_locale("fr")
+        )
+        dl = compile_layout_for_device(layout)
+        assert dl is not None
+        months = ["janv.", "févr.", "mars", "avr.", "mai", "juin",
+                  "juil.", "août", "sept.", "oct.", "nov.", "déc."]
+        samples = [
+            f"0{(i % 9) + 1}/{months[i % 12]}/2026:10:0{i % 10}:00 +0100"
+            for i in range(12)
+        ]
+        comp, ok = run_device(dl, samples)
+        assert np.asarray(ok).all()
+        for i in range(12):
+            assert int(np.asarray(comp["month"])[i]) == (i % 12) + 1
+
+    def test_full_month_names_device_resident(self):
+        layout = compile_java_pattern("dd/MMMM/yyyy HH:mm")
+        dl = compile_layout_for_device(layout)
+        assert dl is not None
+        samples = ["07/March/2026 10:30", "01/May/2026 00:00",
+                   "30/September/1999 23:59"]
+        comp, ok = run_device(dl, samples)
+        assert np.asarray(ok).all()
+        assert np.asarray(comp["month"]).tolist() == [3, 5, 9]
+        # ... and host parse agrees item for item.
+        for s in samples:
+            ts = layout.parse(s)
+            assert ts.month in (3, 5, 9)
+
+    def test_week_based_fields_iso_matches_isocalendar(self):
+        import datetime
+        import random
+
+        from logparser_tpu.dissectors.timelayout import week_based_fields
+
+        rng = random.Random(5)
+        for _ in range(500):
+            d = datetime.date(rng.randint(1970, 2100), rng.randint(1, 12),
+                              rng.randint(1, 28))
+            wy, wk = week_based_fields(d.year, d.month, d.day)
+            iso = d.isocalendar()
+            assert (wy, wk) == (iso[0], iso[1]), d
+
+    def test_locale_week_fields_vectorized_matches_scalar(self):
+        import datetime
+        import random
+
+        from logparser_tpu.dissectors.timelayout import week_based_fields
+        from logparser_tpu.tpu import timefields
+
+        rng = random.Random(9)
+        dates = [
+            datetime.date(rng.randint(1971, 2099), rng.randint(1, 12),
+                          rng.randint(1, 28))
+            for _ in range(400)
+        ] + [
+            # Year-boundary adversarial dates for both rules.
+            datetime.date(y, m, d)
+            for y in (2020, 2021, 2024, 2025, 2026, 2027)
+            for m, d in ((1, 1), (1, 2), (12, 29), (12, 30), (12, 31))
+        ]
+        comp = {
+            "year": np.array([d.year for d in dates], dtype=np.int64),
+            "month": np.array([d.month for d in dates], dtype=np.int64),
+            "day": np.array([d.day for d in dates], dtype=np.int64),
+        }
+        for first, mind in ((1, 4), (7, 1), (7, 4), (6, 1)):
+            wy, wk = timefields.locale_week_fields(comp, first, mind)
+            for i, d in enumerate(dates):
+                sy, sk = week_based_fields(d.year, d.month, d.day, first, mind)
+                assert (wy[i], wk[i]) == (sy, sk), (d, first, mind)
+
+    def test_dissector_set_locale_and_outputs(self):
+        from logparser_tpu.dissectors.timestamp import TimeStampDissector
+        from logparser_tpu.testing import DissectorTester
+
+        d = TimeStampDissector("dd/MMM/yyyy:HH:mm:ss ZZ").set_locale("fr")
+        (
+            DissectorTester.create()
+            .with_dissector(d)
+            .with_input("31/déc./2012:23:00:44 -0700")
+            .expect("TIME.EPOCH:epoch", 1357020044000)
+            .expect("TIME.MONTH:month", 12)
+            .expect("TIME.MONTHNAME:monthname", "décembre")
+            .check_expectations()
+        )
+
+    def test_us_week_rule(self):
+        from logparser_tpu.dissectors.timestamp import TimeStampDissector
+        from logparser_tpu.testing import DissectorTester
+
+        # 2027-01-01 (Friday): ISO week 53 of 2026; US week 1 of 2027.
+        d_uk = TimeStampDissector("dd/MMM/yyyy:HH:mm:ss ZZ")
+        (
+            DissectorTester.create()
+            .with_dissector(d_uk)
+            .with_input("01/Jan/2027:10:00:00 +0000")
+            .expect("TIME.WEEK:weekofweekyear", 53)
+            .expect("TIME.YEAR:weekyear", 2026)
+            .check_expectations()
+        )
+        d_us = TimeStampDissector("dd/MMM/yyyy:HH:mm:ss ZZ").set_locale("en_US")
+        (
+            DissectorTester.create()
+            .with_dissector(d_us)
+            .with_input("01/Jan/2027:10:00:00 +0000")
+            .expect("TIME.WEEK:weekofweekyear", 1)
+            .expect("TIME.YEAR:weekyear", 2027)
+            .check_expectations()
+        )
+
+    def test_batch_parser_locale_end_to_end(self):
+        from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+        fmt = '%h %l %u [%{%d/%b/%Y:%H:%M:%S %z}t] "%r" %>s %b'
+        fields = ["TIME.EPOCH:request.receive.time.epoch",
+                  "TIME.MONTHNAME:request.receive.time.monthname",
+                  "TIME.WEEK:request.receive.time.weekofweekyear"]
+        p = TpuBatchParser(fmt, fields, locale="fr")
+        lines = [
+            '1.2.3.4 - - [07/févr./2026:10:00:00 +0100] "GET /x HTTP/1.1" 200 5',
+            '1.2.3.4 - - [01/août/2026:01:02:03 +0200] "GET /y HTTP/1.1" 200 6',
+            '1.2.3.4 - - [03/mars/2026:04:05:06 -0500] "GET /z HTTP/1.1" 200 7',
+            '1.2.3.4 - - [03/Mar/2026:04:05:06 -0500] "GET /z HTTP/1.1" 200 7',
+        ]
+        res = p.parse_batch(lines)
+        # English months under a French locale fail BOTH engines (the
+        # plausible reject pays one confirming oracle visit).
+        assert [bool(v) for v in res.valid] == [True, True, True, False]
+        assert res.oracle_rows <= 1
+        # A pure French corpus is fully device-resident.
+        assert p.parse_batch(lines[:3] * 8).oracle_rows == 0
+        for i, line in enumerate(lines[:3]):
+            want = p.oracle.parse(line, _CollectingRecord()).values
+            for f in fields:
+                got = res.to_pylist(f)[i]
+                w = want.get(f)
+                assert got == w or str(got) == str(w), (i, f, got, w)
+        assert res.to_pylist(fields[1])[0] == "février"
