@@ -6,8 +6,14 @@ PY ?= python
 test:
 	$(PY) -m pytest tests/ -q
 
+# Fast tier: every subsystem's functional tests, minus the heavy
+# differential/fuzz/adapter suites (marked @pytest.mark.slow).
 test-fast:
-	$(PY) -m pytest tests/ -q -x
+	$(PY) -m pytest tests/ -q -x -m "not slow"
+
+lint:
+	$(PY) -m ruff check logparser_tpu tests
+	$(PY) -m mypy logparser_tpu --no-error-summary
 
 bench:
 	$(PY) bench.py

@@ -211,6 +211,16 @@ def parse_device_timestamp(
     zeros = jnp.zeros(B, dtype=jnp.int32)
     comp: Dict[str, jnp.ndarray] = {}
 
+    def make_digits(win):
+        # One [B, w] vector op chain instead of w scalar rounds.
+        def digits(off: int, w: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            d = (win[:, off : off + w] - np.uint8(ord("0"))).astype(jnp.int32)
+            good = jnp.all((d >= 0) & (d <= 9), axis=1)
+            val = jnp.sum(d * pow10_weights(w), axis=1).astype(jnp.int32)
+            return val, good
+
+        return digits
+
     def match_entry(b, lower, off: int, entry: bytes):
         m = None
         for i, byte in enumerate(entry):
@@ -227,13 +237,7 @@ def parse_device_timestamp(
         win_w = seg_w if seg_w >= 0 else max(i.width for i in seg)
         b = extract(buf, cursor, win_w)
         lower = b | np.uint8(0x20)
-
-        def digits(off: int, w: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-            # One [B, w] vector op chain instead of w scalar rounds.
-            d = (b[:, off : off + w] - np.uint8(ord("0"))).astype(jnp.int32)
-            good = jnp.all((d >= 0) & (d <= 9), axis=1)
-            val = jnp.sum(d * pow10_weights(w), axis=1).astype(jnp.int32)
-            return val, good
+        digits = make_digits(b)
 
         for it in seg:
             if it.kind == "lit":
@@ -276,12 +280,7 @@ def parse_device_timestamp(
     if dl.tail:
         b = extract(buf, cursor, 6)
         lower = b | np.uint8(0x20)
-
-        def tdigits(off: int, w: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-            d = (b[:, off : off + w] - np.uint8(ord("0"))).astype(jnp.int32)
-            good = jnp.all((d >= 0) & (d <= 9), axis=1)
-            val = jnp.sum(d * pow10_weights(w), axis=1).astype(jnp.int32)
-            return val, good
+        tdigits = make_digits(b)
 
         sign_b = b[:, 0]
         sign = jnp.where(sign_b == np.uint8(ord("-")), -1, 1).astype(jnp.int32)

@@ -22,6 +22,8 @@ from logparser_tpu.adapters import (
 )
 from logparser_tpu.tools.demolog import generate_combined_lines
 
+pytestmark = pytest.mark.slow
+
 FIELDS = [
     "IP:connection.client.host",
     "TIME.EPOCH:request.receive.time.epoch",

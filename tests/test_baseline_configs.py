@@ -18,6 +18,8 @@ from logparser_tpu.httpd import HttpdLoglineParser
 from logparser_tpu.tools.demolog import generate_combined_lines
 from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
 
+pytestmark = pytest.mark.slow
+
 TEST_DATA = "/root/reference/GeoIP2-TestData/test-data"
 CITY_MMDB = os.path.join(TEST_DATA, "GeoIP2-City-Test.mmdb")
 ASN_MMDB = os.path.join(TEST_DATA, "GeoLite2-ASN-Test.mmdb")

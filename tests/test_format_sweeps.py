@@ -13,6 +13,8 @@ import pytest
 
 from logparser_tpu.httpd import HttpdLoglineParser
 
+pytestmark = pytest.mark.slow
+
 
 class MapRecord:
     def __init__(self):

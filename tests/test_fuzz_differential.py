@@ -13,6 +13,8 @@ import pytest
 
 from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
 
+pytestmark = pytest.mark.slow
+
 # (format token, field ids to request, value generator)
 TOKEN_POOL = [
     ("%h", ["IP:connection.client.host"],

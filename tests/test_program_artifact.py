@@ -13,6 +13,8 @@ import pytest
 from logparser_tpu.tools.demolog import generate_combined_lines
 from logparser_tpu.tpu.batch import TpuBatchParser
 
+pytestmark = pytest.mark.slow
+
 FIELDS = [
     "IP:connection.client.host",
     "TIME.EPOCH:request.receive.time.epoch",

@@ -5,9 +5,12 @@ SURVEY §7.4: wildcard extraction as CSR (offsets+values) device output —
 splitting/locating on device, resilientUrlDecode host-side on exactly the
 flagged values (QueryStringFieldDissector.java:76-108 semantics).
 """
+import pytest
 import random
 
 from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+pytestmark = pytest.mark.slow
 
 WILD = "STRING:request.firstline.uri.query.*"
 SPEC = "STRING:request.firstline.uri.query.img"

@@ -9,6 +9,8 @@ from logparser_tpu.service import (
 )
 from logparser_tpu.tools.demolog import generate_combined_lines
 
+pytestmark = pytest.mark.slow
+
 FIELDS = [
     "IP:connection.client.host",
     "TIME.EPOCH:request.receive.time.epoch",

@@ -13,6 +13,8 @@ import pytest
 
 from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
 
+pytestmark = pytest.mark.slow
+
 FIELDS = [
     "HTTP.PATH:request.firstline.uri.path",
     "HTTP.QUERYSTRING:request.firstline.uri.query",
