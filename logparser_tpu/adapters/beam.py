@@ -1,0 +1,104 @@
+"""Apache Beam binding: a real ``beam.DoFn`` over the micro-batch operator.
+
+The reference ships engine-native classes users drop into their pipelines
+(examples/apache-beam/.../TestParserDoFnInline.java builds the parser in
+``DoFn.setup`` and parses per element).  This module is the drop-in
+equivalent for Beam's Python SDK — a thin shell over
+:class:`~logparser_tpu.adapters.streaming.ParserMapOperator`.
+
+Batching discipline: the DoFn does NOT buffer across ``process`` calls —
+holding elements and re-emitting them later would detach them from their
+window/timestamp (a windowed pipeline would then aggregate records into
+the wrong window).  Instead it accepts BATCH elements: put Beam's own
+``BatchElements`` transform in front, which batches within windows
+correctly, and every output record inherits its input batch's window.
+Single-line elements also work (a batch of one — correct, just slower).
+
+``apache_beam`` is an OPTIONAL dependency: importing this module without it
+works (so the package surface is always present); constructing the DoFn
+raises with install guidance.  Nothing else in logparser_tpu depends on it.
+
+Usage::
+
+    import apache_beam as beam
+    from logparser_tpu.adapters import ParserConfig
+    from logparser_tpu.adapters.beam import ParseLogLinesDoFn
+
+    with beam.Pipeline() as p:
+        (p | beam.io.ReadFromText("access.log")
+           | beam.BatchElements(min_batch_size=256, max_batch_size=4096)
+           | beam.ParDo(ParseLogLinesDoFn(ParserConfig("combined", FIELDS)))
+           | ...)
+
+Each output element is a ``ParsedRecord`` (bad lines are skipped and
+counted, the engines' skip-and-count policy).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .record import ParsedRecord
+from .streaming import ParserConfig, ParserMapOperator
+
+try:  # pragma: no cover - exercised via the fake-module tests
+    import apache_beam as _beam
+    _DoFnBase = _beam.DoFn
+    _HAVE_BEAM = True
+except ImportError:  # pragma: no cover
+    _beam = None
+    _DoFnBase = object
+    _HAVE_BEAM = False
+
+
+def beam_available() -> bool:
+    return _HAVE_BEAM
+
+
+class ParseLogLinesDoFn(_DoFnBase):
+    """``beam.DoFn``: batches of log lines in, ParsedRecords out.
+
+    The parser is built once per worker in ``setup`` (the config object
+    is what Beam serializes to workers).  Each ``process`` element may be
+    a list/tuple of lines (the ``BatchElements`` shape — preferred) or a
+    single line; outputs are emitted inside the same ``process`` call, so
+    they keep the element's window and timestamp.
+    """
+
+    def __init__(self, config: ParserConfig):
+        if not _HAVE_BEAM:
+            raise ImportError(
+                "apache_beam is not installed; "
+                "`pip install apache-beam` to use ParseLogLinesDoFn "
+                "(the engine-agnostic equivalent is "
+                "logparser_tpu.adapters.streaming.ParserMapOperator)"
+            )
+        super().__init__()
+        self.config = config
+        self._operator: Optional[ParserMapOperator] = None
+
+    # -- beam lifecycle --------------------------------------------------
+
+    def setup(self):
+        self._operator = ParserMapOperator(self.config)
+        self._operator.open()
+
+    def process(self, element):
+        batch = (
+            element if isinstance(element, (list, tuple)) else [element]
+        )
+        for record in self._operator.map_batch(list(batch)):
+            if record is not None:  # skip-and-count: bad lines drop
+                yield record
+
+    def teardown(self):
+        if self._operator is not None:
+            self._operator.close()
+            self._operator = None
+
+    @property
+    def counters(self):
+        """The operator's line counters (lines_read/good/bad)."""
+        return self._operator.counters if self._operator else None
+
+
+__all__ = ["ParseLogLinesDoFn", "ParsedRecord", "beam_available"]

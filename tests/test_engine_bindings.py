@@ -1,0 +1,180 @@
+"""Optional real-engine bindings (Beam DoFn / PyFlink MapFunction).
+
+apache_beam and pyflink are not installed in this image, so the wrapper
+LIFECYCLE is exercised against minimal fake modules injected into
+sys.modules (the wrappers only touch DoFn/MapFunction base classes and
+Beam's WindowedValue), and the not-installed path is asserted to raise
+with install guidance.
+"""
+import importlib
+import sys
+import types
+
+import pytest
+
+from logparser_tpu.adapters import ParserConfig
+from logparser_tpu.tools.demolog import generate_combined_lines
+
+FIELDS = ["IP:connection.client.host", "STRING:request.status.last"]
+BAD_LINE = "not a log line"
+
+
+def _fake_beam():
+    beam = types.ModuleType("apache_beam")
+
+    class DoFn:
+        pass
+
+    class WindowedValue:
+        def __init__(self, value, timestamp, windows):
+            self.value = value
+            self.timestamp = timestamp
+            self.windows = windows
+
+    class GlobalWindow:
+        pass
+
+    beam.DoFn = DoFn
+    beam.utils = types.SimpleNamespace(
+        windowed_value=types.SimpleNamespace(WindowedValue=WindowedValue)
+    )
+    beam.transforms = types.SimpleNamespace(
+        window=types.SimpleNamespace(GlobalWindow=GlobalWindow)
+    )
+    return beam
+
+
+def _fake_pyflink():
+    pyflink = types.ModuleType("pyflink")
+    datastream = types.ModuleType("pyflink.datastream")
+    functions = types.ModuleType("pyflink.datastream.functions")
+
+    class MapFunction:
+        pass
+
+    class FlatMapFunction:
+        pass
+
+    functions.MapFunction = MapFunction
+    functions.FlatMapFunction = FlatMapFunction
+    datastream.functions = functions
+    pyflink.datastream = datastream
+    return {
+        "pyflink": pyflink,
+        "pyflink.datastream": datastream,
+        "pyflink.datastream.functions": functions,
+    }
+
+
+@pytest.fixture
+def beam_binding(monkeypatch):
+    monkeypatch.setitem(sys.modules, "apache_beam", _fake_beam())
+    import logparser_tpu.adapters.beam as mod
+
+    return importlib.reload(mod)
+
+
+@pytest.fixture
+def flink_binding(monkeypatch):
+    for name, m in _fake_pyflink().items():
+        monkeypatch.setitem(sys.modules, name, m)
+    import logparser_tpu.adapters.flink as mod
+
+    return importlib.reload(mod)
+
+
+@pytest.fixture(autouse=True)
+def _restore_modules():
+    # Reload the binding modules WITHOUT the fakes afterwards so other
+    # tests see the real (not-installed) state.
+    yield
+    for name in ("logparser_tpu.adapters.beam", "logparser_tpu.adapters.flink"):
+        mod = sys.modules.get(name)
+        if mod is not None:
+            importlib.reload(mod)
+
+
+def test_missing_engines_raise_with_guidance():
+    import logparser_tpu.adapters.beam as beam_mod
+    import logparser_tpu.adapters.flink as flink_mod
+
+    if not beam_mod.beam_available():
+        with pytest.raises(ImportError, match="apache-beam"):
+            beam_mod.ParseLogLinesDoFn(ParserConfig("combined", FIELDS))
+    if not flink_mod.flink_available():
+        with pytest.raises(ImportError, match="apache-flink"):
+            flink_mod.ParseLogLineMap(ParserConfig("combined", FIELDS))
+        with pytest.raises(ImportError, match="apache-flink"):
+            flink_mod.ParseLogLinesFlatMap(ParserConfig("combined", FIELDS))
+
+
+def test_beam_dofn_batch_elements(beam_binding):
+    """The BatchElements shape: one list element in, records out WITHIN
+    the same process call (window/timestamp-preserving by construction —
+    nothing buffers across elements)."""
+    lines = generate_combined_lines(70, seed=3)
+    lines.insert(10, BAD_LINE)
+    fn = beam_binding.ParseLogLinesDoFn(ParserConfig("combined", FIELDS))
+    assert isinstance(fn, sys.modules["apache_beam"].DoFn)
+    fn.setup()
+    batches = [lines[i : i + 32] for i in range(0, len(lines), 32)]
+    records = []
+    for batch in batches:
+        out = list(fn.process(batch))
+        records.extend(out)
+    assert len(records) == 70  # bad line skipped
+    assert records[0].get_string("connection.client.host")
+    assert fn.counters.lines_read == 71
+    assert fn.counters.bad_lines == 1
+    # Single-line elements work too (batch of one).
+    assert len(list(fn.process(lines[0]))) == 1
+    fn.teardown()
+
+
+def test_flink_map_per_line(flink_binding):
+    lines = generate_combined_lines(5, seed=4)
+    m = flink_binding.ParseLogLineMap(ParserConfig("combined", FIELDS))
+    m.open()
+    rec = m.map(lines[0])
+    assert rec.get_string("connection.client.host")
+    assert m.map(BAD_LINE) is None
+    m.close()
+
+
+def test_flink_flatmap_micro_batches(flink_binding):
+    lines = generate_combined_lines(50, seed=5)
+    lines.insert(7, BAD_LINE)
+    f = flink_binding.ParseLogLinesFlatMap(
+        ParserConfig("combined", FIELDS, micro_batch_size=16)
+    )
+    f.open()
+    out = []
+    for line in lines:
+        out.extend(f.flat_map(line))
+    out.extend(f.flush_remaining())
+    assert len(out) == 50
+    assert f.counters.lines_read == 51
+    assert f.counters.bad_lines == 1
+    f.close()
+    assert f.tail_records == []  # flush drained everything
+
+
+def test_flink_flatmap_close_keeps_tail_and_counters(flink_binding):
+    """The Flink lifecycle path: close() (no collector) parses the
+    buffered tail — counters exact, records recoverable via
+    tail_records / flush_remaining, nothing parsed twice."""
+    lines = generate_combined_lines(20, seed=6)
+    f = flink_binding.ParseLogLinesFlatMap(
+        ParserConfig("combined", FIELDS, micro_batch_size=16)
+    )
+    f.open()
+    emitted = []
+    for line in lines:
+        emitted.extend(f.flat_map(line))
+    assert len(emitted) == 16          # one full batch flushed
+    f.close()                          # Flink calls this at end-of-input
+    assert f.counters.lines_read == 20  # tail parsed for counters
+    assert len(f.tail_records) == 4
+    tail = list(f.flush_remaining())   # manual drain after close
+    assert len(tail) == 4
+    assert len(list(f.flush_remaining())) == 0  # idempotent
