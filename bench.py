@@ -48,6 +48,12 @@ ITERS = 8
 ORACLE_SAMPLE = 2000
 
 GEO_TEST_DATA = "/root/reference/GeoIP2-TestData/test-data"
+if not os.path.isdir(GEO_TEST_DATA):
+    # Self-contained fixtures (tools/geoip_testdata.py): the geoip_chain
+    # config no longer needs the reference checkout.
+    from logparser_tpu.tools.geoip_testdata import ensure_test_databases
+
+    GEO_TEST_DATA = ensure_test_databases()
 
 HEADLINE_FIELDS = [
     "IP:connection.client.host",

@@ -83,9 +83,11 @@ class ParseLogLinesDoFn(_DoFnBase):
         self._operator.open()
 
     def process(self, element):
-        batch = (
-            element if isinstance(element, (list, tuple)) else [element]
-        )
+        # Only LISTS are batches (the BatchElements shape).  Tuples are
+        # deliberately NOT treated as batches: a KV element like
+        # ("key", "line") would otherwise silently parse its key as a
+        # log line.
+        batch = element if isinstance(element, list) else [element]
         for record in self._operator.map_batch(list(batch)):
             if record is not None:  # skip-and-count: bad lines drop
                 yield record

@@ -2,9 +2,8 @@
 
 apache_beam and pyflink are not installed in this image, so the wrapper
 LIFECYCLE is exercised against minimal fake modules injected into
-sys.modules (the wrappers only touch DoFn/MapFunction base classes and
-Beam's WindowedValue), and the not-installed path is asserted to raise
-with install guidance.
+sys.modules (the wrappers only touch the DoFn/MapFunction base classes),
+and the not-installed path is asserted to raise with install guidance.
 """
 import importlib
 import sys
@@ -25,22 +24,7 @@ def _fake_beam():
     class DoFn:
         pass
 
-    class WindowedValue:
-        def __init__(self, value, timestamp, windows):
-            self.value = value
-            self.timestamp = timestamp
-            self.windows = windows
-
-    class GlobalWindow:
-        pass
-
     beam.DoFn = DoFn
-    beam.utils = types.SimpleNamespace(
-        windowed_value=types.SimpleNamespace(WindowedValue=WindowedValue)
-    )
-    beam.transforms = types.SimpleNamespace(
-        window=types.SimpleNamespace(GlobalWindow=GlobalWindow)
-    )
     return beam
 
 
