@@ -168,14 +168,15 @@ def sync(x):
     return np.asarray(x.ravel()[0])
 
 
-def marginal_device_rate(parser, buf, lengths, batch, n_lo=16, n_hi=144):
+def marginal_device_rate(parser, buf, lengths, batch, n_lo=16, n_hi=144,
+                         units=None):
     """Marginal in-jit rate: loglines/sec with input already in HBM."""
     import jax
     import jax.numpy as jnp
 
     from logparser_tpu.tpu import pipeline
 
-    units = parser.units
+    units = parser.units if units is None else units
 
     def inner(b, lens):
         return jnp.stack(pipeline.compute_units_rows(units, b, lens))
@@ -216,6 +217,49 @@ def marginal_device_rate(parser, buf, lengths, batch, n_lo=16, n_hi=144):
         positive = [s for s in slopes if s > 0]
         marginal_s = positive[0] if positive else time_loop(n_hi) / n_hi
     return batch / marginal_s
+
+
+def device_stage_profile(parser, buf, lengths, batch):
+    """Cumulative per-stage marginal rates for the headline parser: where
+    the device milliseconds go as pipeline stages are added (split
+    automaton -> +token spans -> +firstline/URI chains -> +timestamps ->
+    full).  Each entry is loglines/sec with that cumulative subset of the
+    per-field plans compiled in."""
+    from logparser_tpu.tpu.pipeline import (
+        FormatUnit,
+        PackedLayout,
+        assign_row_offsets,
+    )
+
+    def units_for(pred):
+        units = []
+        for u in parser.units:
+            plans = [p for p in u.plans if pred(p)]
+            units.append(FormatUnit(
+                u.program, plans,
+                PackedLayout.for_plans(plans, parser.csr_slots),
+                plausibility_only=u.plausibility_only,
+            ))
+        assign_row_offsets(units)
+        return units
+
+    stages = [
+        ("split_automaton", lambda p: False),
+        ("plus_token_spans", lambda p: p.kind == "span" and not p.steps),
+        ("plus_firstline_uri",
+         lambda p: p.kind == "span"),
+        ("plus_timestamps",
+         lambda p: p.kind in ("span", "ts", "secmillis")),
+        ("full", lambda p: p.kind != "host"),
+    ]
+    out = {}
+    for name, pred in stages:
+        rate = marginal_device_rate(
+            parser, buf, lengths, batch, n_lo=8, n_hi=40,
+            units=units_for(pred),
+        )
+        out[name] = round(rate, 1)
+    return out
 
 
 def oracle_rate(parser, lines, sample=ORACLE_SAMPLE):
@@ -366,8 +410,10 @@ def main():
         pass
     stream_lps = CONFIG_BATCH * ITERS / (time.perf_counter() - t0)
 
-    # 3) Device-resident marginal rate (the headline).
+    # 3) Device-resident marginal rate (the headline) + the per-stage
+    # profile showing where the device time goes.
     device_resident = marginal_device_rate(parser, buf, lengths, BATCH)
+    stage_profile = device_stage_profile(parser, buf, lengths, BATCH)
 
     oracle_lps = oracle_rate(parser, lines)
 
@@ -402,6 +448,19 @@ def main():
         "fields": len(HEADLINE_FIELDS),
         "device": str(device),
         "host_oracle_lines_per_sec": round(oracle_lps, 1),
+        "device_stage_profile_lines_per_sec": stage_profile,
+        # Regression guard: the worst per-config oracle share.  Device
+        # coverage work keeps this at 0.0 — any rise means lines fell off
+        # the device path (a ~1000x per-line cliff) and should fail
+        # review.  A config that ERRORED counts as 1.0 (the worst
+        # regression must not read as a clean 0.0).
+        "oracle_fraction_max": max(
+            (
+                c.get("oracle_fraction", 1.0) if isinstance(c, dict) else 1.0
+                for c in configs.values()
+            ),
+            default=1.0,
+        ),
         "configs": configs,
     }))
 

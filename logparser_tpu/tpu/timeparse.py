@@ -232,10 +232,30 @@ def parse_device_timestamp(
             m = part if m is None else (m & part)
         return m if m is not None else jnp.ones(B, dtype=bool)
 
+    # Single-segment fixed layouts (the common English shapes) extract
+    # ONE window covering prefix + tail; segmented layouts pay one
+    # extract per segment.  The merged window must FIT the buffer:
+    # gather_span_bytes clamps its width to L, which would leave the
+    # tail slice narrower than the 6 columns the tail parser indexes.
+    one_shot = (
+        len(dl.segments) == 1
+        and dl.seg_widths
+        and dl.seg_widths[0] >= 0
+        and dl.seg_widths[0] + (6 if dl.tail else 0) <= buf.shape[1]
+    )
+    shared_win = None
+    if one_shot:
+        shared_win = extract(
+            buf, cursor, dl.seg_widths[0] + (6 if dl.tail else 0)
+        )
+
     month_from_name = None
     for seg, seg_w in zip(dl.segments, dl.seg_widths):
-        win_w = seg_w if seg_w >= 0 else max(i.width for i in seg)
-        b = extract(buf, cursor, win_w)
+        if one_shot:
+            b = shared_win
+        else:
+            win_w = seg_w if seg_w >= 0 else max(i.width for i in seg)
+            b = extract(buf, cursor, win_w)
         lower = b | np.uint8(0x20)
         digits = make_digits(b)
 
@@ -278,7 +298,10 @@ def parse_device_timestamp(
     # ---- tail zone (parsed at the final cursor) -----------------------
     tail_w = end - cursor
     if dl.tail:
-        b = extract(buf, cursor, 6)
+        if one_shot:
+            b = shared_win[:, dl.seg_widths[0] :]
+        else:
+            b = extract(buf, cursor, 6)
         lower = b | np.uint8(0x20)
         tdigits = make_digits(b)
 

@@ -361,3 +361,35 @@ class TestLocaleLayouts:
                 w = want.get(f)
                 assert got == w or str(got) == str(w), (i, f, got, w)
         assert res.to_pylist(fields[1])[0] == "février"
+
+
+def test_one_shot_window_clamped_to_narrow_buffer():
+    """A prefix-heavy fixed layout whose merged prefix+tail window exceeds
+    the buffer width must still trace (gather_span_bytes clamps to L; the
+    one-shot merge must bail rather than leave the tail slice short)."""
+    import jax.numpy as jnp
+
+    pat = ("'the quick brown fox jumped over the lazy '"
+           "dd/MM/yyyy HH:mm:ss ZZ")
+    layout = compile_java_pattern(pat)
+    dl = compile_layout_for_device(layout)
+    assert dl is not None
+    B, L = 4, 64  # merged window would be seg_width + 6 > L
+    buf = np.zeros((B, L), dtype=np.uint8)
+    comp, ok = parse_device_timestamp(
+        jnp.asarray(buf), jnp.zeros(B, dtype=jnp.int32),
+        jnp.full(B, L, dtype=jnp.int32), dl, gather_span_bytes,
+    )
+    assert not np.asarray(ok).any()  # nothing valid, but no shape error
+
+    s = "the quick brown fox jumped over the lazy 07/03/2026 10:00:00 +0100"
+    raw = s.encode()
+    buf2 = np.zeros((B, 128), dtype=np.uint8)
+    buf2[0, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    comp2, ok2 = parse_device_timestamp(
+        jnp.asarray(buf2), jnp.zeros(B, dtype=jnp.int32),
+        jnp.asarray([len(raw), 0, 0, 0], dtype=jnp.int32),
+        dl, gather_span_bytes,
+    )
+    assert bool(np.asarray(ok2)[0])
+    assert int(np.asarray(comp2["year"])[0]) == 2026
