@@ -82,6 +82,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int32,
         ]
         lib.lp_gather_spans_multi.restype = None
+        lib.lp_copy_spans.argtypes = [u8p, i64p, u8p, i64p,
+                                      ctypes.c_int64, ctypes.c_int32]
+        lib.lp_copy_spans.restype = None
         _lib = lib
         return _lib
 
@@ -235,6 +238,39 @@ def gather_spans_multi(
         total, dtype=np.int64
     )
     return buf_c.reshape(-1)[idx], offsets
+
+
+def copy_spans(
+    src: np.ndarray,
+    src_off: np.ndarray,
+    dst_off: np.ndarray,
+    threads: int = 0,
+) -> np.ndarray:
+    """Per-row flat re-layout: returns ``out`` with
+    ``out[dst_off[r]:dst_off[r+1]] == src[src_off[r]:src_off[r]+len_r]``
+    (lengths from the dst offsets).  C++ threaded memcpy fan-out; numpy
+    repeat-gather fallback."""
+    n = len(dst_off) - 1
+    total = int(dst_off[-1])
+    src_off64 = np.ascontiguousarray(src_off, dtype=np.int64)
+    dst_off64 = np.ascontiguousarray(dst_off, dtype=np.int64)
+    src_c = np.ascontiguousarray(src)
+    lib = get_lib()
+    if lib is not None:
+        out = np.empty(total, dtype=np.uint8)
+        lib.lp_copy_spans(
+            _u8(src_c),
+            src_off64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _u8(out),
+            dst_off64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, threads or _default_threads(),
+        )
+        return out
+    lens = np.diff(dst_off64)
+    idx = np.repeat(src_off64 - dst_off64[:-1], lens) + np.arange(
+        total, dtype=np.int64
+    )
+    return src_c[idx]
 
 
 def _encode_blob_numpy(

@@ -160,6 +160,35 @@ void lp_gather_spans_multi(const uint8_t* buf, int64_t B, int64_t L,
   for (auto& th : pool) th.join();
 }
 
+// Flat re-layout: per-row copy from arbitrary source offsets in one flat
+// byte buffer to contiguous destination offsets.  The Arrow bridge's
+// URI-repair splice uses it to rebuild a column after patching rows
+// (numpy's fancy-index gather is per-element; this is memcpy-speed).
+void lp_copy_spans(const uint8_t* src, const int64_t* src_off,
+                   uint8_t* dst, const int64_t* dst_off,
+                   int64_t n, int32_t threads) {
+  if (threads < 1) threads = 1;
+  int64_t chunk = (n + threads - 1) / threads;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      int64_t len = dst_off[r + 1] - dst_off[r];
+      if (len <= 0) continue;
+      std::memcpy(dst + dst_off[r], src + src_off[r], len);
+    }
+  };
+  if (threads == 1 || n < 4096) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  for (int32_t t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back(work, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+}
+
 // One-shot convenience: frame + pack a whole blob.  Returns line count.
 int64_t lp_frame_pack(const uint8_t* data, int64_t size,
                       uint8_t* out, int32_t* lengths,

@@ -248,14 +248,14 @@ def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid)
             off += len(v)
         pieces.append(py_buf)
 
+    from ..native import copy_spans
+
     combined = np.concatenate(pieces) if len(pieces) > 1 else data
     new_off = np.zeros_like(offsets)
     np.cumsum(new_lens, out=new_off[1:])
-    new_total = int(new_off[-1])
-    out_idx = np.repeat(src_base - new_off[:-1], new_lens) + np.arange(
-        new_total, dtype=np.int64
-    )
-    return combined[out_idx], new_off
+    # Rebuild via the native threaded memcpy fan-out (numpy's per-element
+    # fancy-index gather was the splice's hot spot).
+    return copy_spans(combined, src_base, new_off), new_off
 
 
 def _column_to_arrow(
