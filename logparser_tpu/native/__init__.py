@@ -250,6 +250,8 @@ def copy_spans(
     ``out[dst_off[r]:dst_off[r+1]] == src[src_off[r]:src_off[r]+len_r]``
     (lengths from the dst offsets).  C++ threaded memcpy fan-out; numpy
     repeat-gather fallback."""
+    if src.dtype != np.uint8:
+        raise TypeError(f"copy_spans needs uint8 src, got {src.dtype}")
     n = len(dst_off) - 1
     total = int(dst_off[-1])
     src_off64 = np.ascontiguousarray(src_off, dtype=np.int64)

@@ -149,19 +149,21 @@ class TestGatherSpans:
                 assert bytes(data[offsets[r]:offsets[r + 1]]).decode() == want
 
 
-def test_copy_spans_matches_numpy():
+@pytest.mark.parametrize("n", [301, 5000])  # above/below the thread cutoff
+def test_copy_spans_matches_numpy(n):
     from logparser_tpu import native
 
     rng = np.random.default_rng(21)
-    n = 301
     lens = rng.integers(0, 40, size=n).astype(np.int64)
     lens[::9] = 0
     dst_off = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(lens, out=dst_off[1:])
     src = rng.integers(0, 255, size=int(dst_off[-1]) + 500, dtype=np.uint8)
     src_off = rng.integers(0, 500, size=n).astype(np.int64)
-    out = native.copy_spans(src, src_off, dst_off)
+    out = native.copy_spans(src, src_off, dst_off, threads=4)
     for r in range(n):
         got = bytes(out[dst_off[r] : dst_off[r + 1]])
         want = bytes(src[src_off[r] : src_off[r] + lens[r]])
         assert got == want, r
+    with pytest.raises(TypeError):
+        native.copy_spans(src.astype(np.int32), src_off, dst_off)
