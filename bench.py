@@ -207,12 +207,17 @@ def marginal_device_rate(parser, buf, lengths, batch, n_lo=16, n_hi=144,
     # The tunneled chip attachment jitters ~20% run-to-run.  The slope is
     # a DIFFERENCE of two timings, so noise can push individual samples
     # either way (an inflated n_lo makes the rate look too high) — take
-    # the median of three slopes rather than the extreme.
-    slopes = sorted(
-        (time_loop(n_hi) - time_loop(n_lo)) / (n_hi - n_lo)
-        for _attempt in range(3)
-    )
-    marginal_s = slopes[1]
+    # the median of three slopes, and when the spread is still large
+    # (>30% of the median), add two more samples and take the median of
+    # five before giving up on stability.
+    def sample():
+        return (time_loop(n_hi) - time_loop(n_lo)) / (n_hi - n_lo)
+
+    slopes = sorted(sample() for _ in range(3))
+    med = slopes[1]
+    if med > 0 and (slopes[-1] - slopes[0]) > 0.3 * med:
+        slopes = sorted(slopes + [sample(), sample()])
+    marginal_s = slopes[len(slopes) // 2]
     if marginal_s <= 0:
         positive = [s for s in slopes if s > 0]
         marginal_s = positive[0] if positive else time_loop(n_hi) / n_hi
