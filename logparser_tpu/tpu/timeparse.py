@@ -18,8 +18,10 @@ variable-width UTC-offset in tail position (``ZZ`` accepts
 distinguishable by remaining span width).  This covers the Apache default
 ``dd/MMM/yyyy:HH:mm:ss ZZ``, nginx ``$time_iso8601``
 (``yyyy-MM-dd'T'HH:mm:ssXXX``), the fixed-width strftime family, and the
-localized variants of all of these.  Zone *names* needing tzdata/DST and
-week-based dates stay on the host oracle.
+localized variants of all of these, plus %Z zone TEXT for the
+fixed-offset abbreviation family (UTC/GMT/UT/Z).  DST zone names /
+region ids (they need tzdata) and week-based dates stay on the host
+oracle.
 
 Validation discipline: the device must never accept a span the host layout
 rejects (a false-accept would bypass the oracle with a wrong value).  Every
@@ -49,12 +51,14 @@ _FIXED_OFFSET_ZONES = {"UTC": 0, "GMT": 0, "Z": 0, "UT": 0, "Etc/UTC": 0}
 
 @dataclass(frozen=True)
 class _DevItem:
-    kind: str        # lit | num | name | ampm
+    kind: str        # lit | num | name | ampm | zone
     offset: int      # byte offset within its SEGMENT
-    width: int       # fixed width (for name/ampm: the max entry width)
+    width: int       # fixed width (for name/ampm/zone: max entry width)
     field: str = ""  # num: layout field; name: "month" | "dayofweek"
     text: bytes = b""            # lit
-    table: Tuple[bytes, ...] = ()  # name/ampm: per-entry canonical bytes
+    table: Tuple[bytes, ...] = ()  # name/ampm/zone: per-entry bytes
+    # zone only: per-entry UTC offset seconds (parallel to `table`).
+    offsets_s: Tuple[int, ...] = ()
 
 
 @dataclass
@@ -150,12 +154,47 @@ def compile_layout_for_device(layout: TimeLayout) -> Optional[DeviceTimeLayout]:
             if idx != n - 1:
                 return None  # variable width is only decodable at the tail
             tail = kind
-        else:  # zonetext and anything new: host-only
+        elif kind == "zonetext":
+            # %Z zone TEXT: the device models the fixed-offset
+            # ABBREVIATIONS (UTC/GMT/UT/Z today), derived from the host's
+            # own tables so they cannot drift; rows stamped with DST
+            # zones (CET, EST, region ids — incl. case-sensitive ids
+            # like Etc/UTC, since abbreviation matching is
+            # case-INsensitive on the host but region ids are not) fail
+            # device validation and take the oracle, which resolves them
+            # through tzdata.  The host consumes the zone token GREEDILY
+            # over [A-Za-z0-9_/+-], so the match also checks the byte
+            # AFTER the entry is outside that class ("UTCX" must not
+            # device-accept as UTC) — the +1 width gives the peek byte.
+            from ..dissectors.timelayout import _ZONE_ABBREVIATIONS
+
+            abbrevs = sorted(
+                (k for k, v in _ZONE_ABBREVIATIONS.items()
+                 if v in _FIXED_OFFSET_ZONES),
+                key=len, reverse=True,
+            )
+            table = tuple(a.encode() for a in abbrevs)
+            offsets_s = tuple(
+                _FIXED_OFFSET_ZONES[_ZONE_ABBREVIATIONS[a]] for a in abbrevs
+            )
+            close_segment()
+            segments.append((
+                _DevItem("zone", 0, max(len(t) for t in table) + 1,
+                         field="zone", table=table, offsets_s=offsets_s),
+            ))
+            seg_widths.append(-1)
+            min_prefix += min(len(t) for t in table)
+        else:  # anything new: host-only
             return None
     close_segment()
 
+    has_zone_item = any(
+        i.kind == "zone" for seg in segments for i in seg
+    )
     default_offset = 0
-    if not tail:
+    if not tail and not has_zone_item:
+        # (A zonetext item always supplies the zone, so default_zone is
+        # dead for those layouts — no reason to reject a DST default.)
         zone = layout.default_zone
         if zone is not None and zone not in _FIXED_OFFSET_ZONES:
             return None  # DST zones need tzdata; host-only
@@ -266,7 +305,7 @@ def parse_device_timestamp(
                 val, good = digits(it.offset, it.width)
                 ok = ok & good
                 comp[it.field] = val
-            elif it.kind in ("name", "ampm"):
+            elif it.kind in ("name", "ampm", "zone"):
                 # Table match in host-table ORDER (first match wins, like
                 # TimeLayout._parse_text): iterate reversed so earlier
                 # entries overwrite later ones.
@@ -278,11 +317,37 @@ def parse_device_timestamp(
                     m = match_entry(b, lower, it.offset, entry) & (
                         cursor + len(entry) <= end
                     )
+                    if it.kind == "zone":
+                        # Greedy host tokenization: the byte after the
+                        # entry must end the zone token (zero-fill past
+                        # the line end qualifies).
+                        nxt = b[:, it.offset + len(entry)]
+                        lo = nxt | np.uint8(0x20)
+                        zone_char = (
+                            ((lo >= np.uint8(ord("a")))
+                             & (lo <= np.uint8(ord("z"))))
+                            | ((nxt >= np.uint8(ord("0")))
+                               & (nxt <= np.uint8(ord("9"))))
+                            | (nxt == np.uint8(ord("_")))
+                            | (nxt == np.uint8(ord("/")))
+                            | (nxt == np.uint8(ord("+")))
+                            | (nxt == np.uint8(ord("-")))
+                        )
+                        m = m & ~zone_char
                     value = jnp.where(m, idx, value)
                     wsel = jnp.where(m, len(entry), wsel)
                     matched = matched | m
                 ok = ok & matched
-                if it.kind == "ampm":
+                if it.kind == "zone":
+                    # The matched entry supplies the offset (all fixed
+                    # zones; per-entry so the table can never silently
+                    # disagree with a default).
+                    zoff = zeros
+                    for idx, secs in enumerate(it.offsets_s):
+                        if secs:
+                            zoff = jnp.where(value == idx, secs, zoff)
+                    comp["offset_seconds"] = zoff
+                elif it.kind == "ampm":
                     comp["ampm"] = value
                 elif it.field == "month":
                     month_from_name = value + 1
@@ -334,8 +399,10 @@ def parse_device_timestamp(
             )
     else:
         ok = ok & (tail_w == 0)
-        comp["offset_seconds"] = jnp.full(B, dl.default_offset_seconds,
-                                          dtype=jnp.int32)
+        if "offset_seconds" not in comp:  # a zone item may have set it
+            comp["offset_seconds"] = jnp.full(
+                B, dl.default_offset_seconds, dtype=jnp.int32
+            )
 
     # ---- resolve components (mirrors TimeLayout._resolve) -------------
     year = comp.get("year")

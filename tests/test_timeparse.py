@@ -32,11 +32,10 @@ DEVICE_LAYOUTS = [
 ]
 
 HOST_ONLY_LAYOUTS = [
-    # Full month names (dd/MMMM/yyyy) are DEVICE layouts since round 3:
-    # variable-width name tables segment at a per-row cursor.
+    # Full month names (dd/MMMM/yyyy) and %Z zone text are DEVICE layouts
+    # since round 3 (segmented name tables; UTC-family zones).
     ("strf", "%e/%b/%Y"),                 # space-padded day
     ("strf", "%G-W%V-%u"),                # ISO week date
-    ("strf", "%d/%b/%Y %H:%M:%S %Z"),     # zone text needs tzdata
 ]
 
 
@@ -395,3 +394,33 @@ def test_one_shot_window_clamped_to_narrow_buffer():
     )
     assert bool(np.asarray(ok2)[0])
     assert int(np.asarray(comp2["year"])[0]) == 2026
+
+
+def test_zonetext_utc_family_device_resident():
+    """%Z zone TEXT: the UTC-family abbreviations parse on device with a
+    0 offset; DST zones / region ids / greedy-longer tokens fail device
+    validation (the oracle resolves them through tzdata)."""
+    layout = compile_strftime("%d/%b/%Y %H:%M:%S %Z")
+    dl = compile_layout_for_device(layout)
+    assert dl is not None
+    samples = [
+        "07/Mar/2026 10:00:00 UTC",
+        "07/Mar/2026 10:00:00 GMT",
+        "07/Mar/2026 10:00:00 utc",      # host is case-insensitive here
+        "07/Mar/2026 10:00:00 Z",
+        "07/Mar/2026 10:00:00 UT",
+        "07/Mar/2026 10:00:00 CET",      # DST zone: host-only
+        "07/Mar/2026 10:00:00 Europe/Amsterdam",
+        "07/Mar/2026 10:00:00 UTCX",     # greedy token: unknown zone
+        "07/Mar/2026 10:00:00 UTC2",     # greedy token: unknown zone
+    ]
+    comp, ok = run_device(dl, samples)
+    assert ok.tolist() == [True] * 5 + [False] * 4
+    epochs = timefields.derive(comp, "epoch")
+    for i in range(5):
+        want = layout.parse(samples[i])
+        assert epochs[i] == want.epoch_millis, samples[i]
+        assert comp["offset_seconds"][i] == 0
+    # The host also accepts the rejected rows (oracle fallback is sound).
+    for s in samples[5:7]:
+        layout.parse(s)
