@@ -55,17 +55,7 @@ if not os.path.isdir(GEO_TEST_DATA):
 
     GEO_TEST_DATA = ensure_test_databases()
 
-HEADLINE_FIELDS = [
-    "IP:connection.client.host",
-    "STRING:connection.client.user",
-    "TIME.EPOCH:request.receive.time.epoch",
-    "HTTP.METHOD:request.firstline.method",
-    "HTTP.URI:request.firstline.uri",
-    "STRING:request.status.last",
-    "BYTES:response.body.bytes",
-    "HTTP.URI:request.referer",
-    "HTTP.USERAGENT:request.user-agent",
-]
+from logparser_tpu.tools.demolog import HEADLINE_FIELDS  # noqa: E402
 
 
 def build_configs():

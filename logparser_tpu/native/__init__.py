@@ -59,7 +59,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if so_path is None:
             _lib_failed = True
             return None
-        lib = ctypes.CDLL(so_path)
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError:
+            # A prebuilt .so for another platform (e.g. a linux library
+            # inside a wheel installed on macOS): numpy fallback, never
+            # a crash.
+            _lib_failed = True
+            return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)

@@ -87,3 +87,18 @@ def write_demolog(
         for line in lines:
             f.write(line + "\n")
     return len(lines)
+
+
+# The benchmark-of-record field set (bench.py and the device profiler
+# both import it, so they can never measure different parsers).
+HEADLINE_FIELDS = [
+    "IP:connection.client.host",
+    "STRING:connection.client.user",
+    "TIME.EPOCH:request.receive.time.epoch",
+    "HTTP.METHOD:request.firstline.method",
+    "HTTP.URI:request.firstline.uri",
+    "STRING:request.status.last",
+    "BYTES:response.body.bytes",
+    "HTTP.URI:request.referer",
+    "HTTP.USERAGENT:request.user-agent",
+]
