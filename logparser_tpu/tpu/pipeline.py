@@ -108,7 +108,7 @@ def _charset_mask(b32: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
     return ok
 
 
-def compute_split(
+def compute_split_dense(
     program: DeviceProgram,
     b32: jnp.ndarray,
     lengths: jnp.ndarray,
@@ -249,6 +249,246 @@ def compute_split(
 
     # The whole line must be consumed (the regex is end-anchored).
     valid = valid & (cursor == lengths)
+    return starts, ends, valid, plausible
+
+
+# ---------------------------------------------------------------------------
+# Bitplane split executor.  The dense splitter above costs one full [B, L]
+# reduction pass PER op (each until_lit first-occurrence search and each
+# charset span check reads the whole buffer again); the sequential cursor
+# dependency keeps XLA from fusing the passes, so ~14 passes dominated the
+# round-3 kernel profile (ROADMAP item 1).  The bitplane form packs the
+# buffer ONCE into per-byte-class position bitplanes — [B, C] uint32 words,
+# C = ceil(L/32), bit j of word c = "class matches at position c*32+j" —
+# and then every search, literal probe, charset span check and plausibility
+# anchoring runs on the planes with word arithmetic (shift/AND/popcount +
+# tiny reductions over C).  One O(B*L) pass total instead of ~14.
+#
+# Exactness: multi-byte literal occurrence masks are derived from the
+# single-byte planes with cross-word shifts, and every resolution below
+# reproduces compute_split_dense bit-for-bit (locked by
+# tests/test_bitplane_split.py differential sweeps).
+# ---------------------------------------------------------------------------
+
+_PLANE_W = 32
+_PLANE_FULL = np.uint32(0xFFFFFFFF)
+
+
+def _plane_pack(pred: jnp.ndarray, C: int) -> jnp.ndarray:
+    """[B, C*32] bool -> [B, C] uint32 position bitplane."""
+    B = pred.shape[0]
+    w = pred.reshape(B, C, _PLANE_W)
+    weights = jnp.uint32(1) << jnp.arange(_PLANE_W, dtype=jnp.uint32)
+    return jnp.sum(
+        jnp.where(w, weights, jnp.uint32(0)), axis=2, dtype=jnp.uint32
+    )
+
+
+def _plane_shr(plane: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Bit p of the result = bit p+k of the input (cross-word carry).
+
+    Arbitrary k: whole words shift as column moves, the remainder as a
+    bit shift (k is the literal byte offset, so separators longer than
+    one 32-bit word still derive correctly)."""
+    wshift, bshift = divmod(k, _PLANE_W)
+    if wshift:
+        plane = jnp.pad(plane[:, wshift:], ((0, 0), (0, wshift)))
+    if bshift:
+        nxt = jnp.pad(plane[:, 1:], ((0, 0), (0, 1)))
+        plane = (plane >> jnp.uint32(bshift)) | (
+            nxt << jnp.uint32(_PLANE_W - bshift)
+        )
+    return plane
+
+
+def _plane_cutoff(thresh: jnp.ndarray, C: int) -> jnp.ndarray:
+    """[B] threshold -> [B, C] plane with bits set at positions < thresh."""
+    word_idx = jnp.arange(C, dtype=jnp.int32)[None, :]
+    rel = jnp.clip(thresh[:, None] - word_idx * _PLANE_W, 0, _PLANE_W)
+    partial = (jnp.uint32(1) << rel.astype(jnp.uint32)) - jnp.uint32(1)
+    return jnp.where(rel >= _PLANE_W, _PLANE_FULL, partial)
+
+
+def _plane_word_at(plane: jnp.ndarray, wi: jnp.ndarray, C: int) -> jnp.ndarray:
+    """Select word wi per row (one-hot sum; out-of-range -> 0)."""
+    idx = jnp.arange(C, dtype=jnp.int32)[None, :]
+    return jnp.sum(
+        jnp.where(idx == wi[:, None], plane, jnp.uint32(0)),
+        axis=1, dtype=jnp.uint32,
+    )
+
+
+def _plane_first_ge(
+    plane: jnp.ndarray, cursor: jnp.ndarray, C: int, L: int
+) -> jnp.ndarray:
+    """First set-bit position >= cursor per row; L when none."""
+    cw = cursor // _PLANE_W
+    cb = (cursor % _PLANE_W).astype(jnp.uint32)
+    idx = jnp.arange(C, dtype=jnp.int32)[None, :]
+    tail = _PLANE_FULL << cb[:, None]
+    keep = jnp.where(
+        idx == cw[:, None], plane & tail,
+        jnp.where(idx > cw[:, None], plane, jnp.uint32(0)),
+    )
+    nz = keep != 0
+    first_w = jnp.min(jnp.where(nz, idx, C), axis=1)
+    word = _plane_word_at(keep, first_w, C)
+    low = word & (jnp.uint32(0) - word)
+    bit = jax.lax.population_count(low - jnp.uint32(1))
+    found = first_w * _PLANE_W + bit.astype(jnp.int32)
+    return jnp.where(word != 0, found, L)
+
+
+def _plane_test_bit(plane: jnp.ndarray, p: jnp.ndarray, C: int) -> jnp.ndarray:
+    """Bit test at position p per row (out-of-range -> False)."""
+    word = _plane_word_at(plane, p // _PLANE_W, C)
+    bit = (word >> (p % _PLANE_W).astype(jnp.uint32)) & jnp.uint32(1)
+    return (bit != 0) & (p >= 0) & (p < C * _PLANE_W)
+
+
+def _plane_any_in_range(
+    plane: jnp.ndarray, start: jnp.ndarray, end: jnp.ndarray, C: int
+) -> jnp.ndarray:
+    """Any set bit at a position in [start, end) per row."""
+    rng = _plane_cutoff(end, C) & ~_plane_cutoff(start, C)
+    return jnp.any((plane & rng) != 0, axis=1)
+
+
+def _plane_last_set(plane: jnp.ndarray, C: int) -> jnp.ndarray:
+    """Highest set-bit position per row; -1 when the plane is empty."""
+    idx = jnp.arange(C, dtype=jnp.int32)[None, :]
+    nz = plane != 0
+    last_w = jnp.max(jnp.where(nz, idx, -1), axis=1)
+    word = _plane_word_at(plane, last_w, C)
+    w = word
+    for s in (1, 2, 4, 8, 16):
+        w = w | (w >> jnp.uint32(s))
+    high = jax.lax.population_count(w).astype(jnp.int32) - 1
+    return jnp.where(last_w >= 0, last_w * _PLANE_W + high, -1)
+
+
+def compute_split(
+    program: DeviceProgram,
+    b32: jnp.ndarray,
+    lengths: jnp.ndarray,
+    need_plausible: bool = False,
+) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], jnp.ndarray, Optional[jnp.ndarray]]:
+    """Bitplane execution of the split program — semantically identical to
+    :func:`compute_split_dense` (same return contract; see its docstring for
+    the plausibility soundness argument), one O(B*L) packing pass total."""
+    if any(0 in op.lit for op in program.ops if op.lit):
+        # A NUL byte inside a separator literal would collide with the
+        # zero padding the plane derivation relies on.
+        return compute_split_dense(program, b32, lengths, need_plausible)
+    B, L = b32.shape
+    C = -(-L // _PLANE_W)
+    Lp = C * _PLANE_W
+    bp = jnp.pad(b32, ((0, 0), (0, Lp - L))) if Lp != L else b32
+
+    lit_bytes = sorted({bt for op in program.ops if op.lit for bt in op.lit})
+    charsets = sorted({
+        op.charset for op in program.ops
+        if op.kind != "lit" and op.charset != CS_ANY
+    })
+    byte_planes = {bt: _plane_pack(bp == bt, C) for bt in lit_bytes}
+    viol_planes = {
+        cs: _plane_pack(
+            ~_charset_mask(bp, program.charset_table[program.charset_ids[cs]]),
+            C,
+        )
+        for cs in charsets
+    }
+    lit_planes: Dict[bytes, jnp.ndarray] = {}
+    for lit in sorted({op.lit for op in program.ops if op.lit}):
+        m = byte_planes[lit[0]]
+        for k, bt in enumerate(lit[1:], 1):
+            m = m & _plane_shr(byte_planes[bt], k)
+        # Same guard as the dense lit_masks: the occurrence must fit
+        # inside the line (pos + len(lit) <= lengths).
+        lit_planes[lit] = m & _plane_cutoff(lengths - (len(lit) - 1), C)
+
+    zeros = jnp.zeros(B, dtype=jnp.int32)
+    cursor = zeros
+    valid = jnp.ones(B, dtype=bool)
+    n_tok = len(program.tokens)
+    starts: List[jnp.ndarray] = [zeros] * n_tok
+    ends: List[jnp.ndarray] = [zeros] * n_tok
+
+    def check_charset(start, end, op, valid):
+        if op.charset != CS_ANY:
+            bad = _plane_any_in_range(viol_planes[op.charset], start, end, C)
+            valid = valid & ~bad
+        width = end - start
+        ok = valid & (width >= op.min_len)
+        if op.max_len:
+            ok = ok & (width <= op.max_len)
+        return ok
+
+    for op in program.ops:
+        if op.kind == "lit":
+            ok = _plane_test_bit(lit_planes[op.lit], cursor, C)
+            valid = valid & ok
+            cursor = cursor + len(op.lit)
+        elif op.kind == "until_lit":
+            found = _plane_first_ge(lit_planes[op.lit], cursor, C, L)
+            token_valid = found < L
+            start = cursor
+            end = jnp.where(token_valid, found, cursor)
+            valid = check_charset(start, end, op, valid & token_valid)
+            starts[op.token_index] = start
+            ends[op.token_index] = end
+            cursor = end + len(op.lit)
+        elif op.kind == "to_end":
+            start = cursor
+            end = lengths
+            valid = check_charset(start, end, op, valid)
+            starts[op.token_index] = start
+            ends[op.token_index] = end
+            cursor = end
+        else:  # pragma: no cover
+            raise AssertionError(op.kind)
+    valid = valid & (cursor == lengths)
+
+    plausible = None
+    if need_plausible:
+        # Same chase as compute_split_dense (see its inline comments for
+        # the soundness of each anchoring), resolved on the planes.
+        ops_list = list(program.ops)
+        plausible = jnp.ones(B, dtype=bool)
+        p_cursor = zeros
+        for idx, op in enumerate(ops_list):
+            if not op.lit:
+                continue
+            k = len(op.lit)
+            is_first = idx == 0 and op.kind == "lit"
+            remaining = ops_list[idx + 1:]
+            is_final_sep = not any(o.lit for o in remaining)
+            plane = lit_planes[op.lit]
+            lower = p_cursor
+            exact: Optional[jnp.ndarray] = None
+            if is_first:
+                exact = zeros
+            if is_final_sep and not remaining:
+                e2 = lengths - k
+                exact = e2 if exact is None else jnp.where(
+                    exact == e2, exact, jnp.full(B, -1, jnp.int32)
+                )
+            elif is_final_sep and remaining[0].kind == "to_end":
+                tail = remaining[0]
+                if tail.charset != CS_ANY and not tail.narrow:
+                    masked = (
+                        viol_planes[tail.charset]
+                        & _plane_cutoff(lengths, C)
+                    )
+                    last_bad = _plane_last_set(masked, C)
+                    lower = jnp.maximum(lower, last_bad - k + 1)
+            if exact is not None:
+                hit = _plane_test_bit(plane, exact, C) & (exact >= lower)
+                found = jnp.where(hit, exact, L)
+            else:
+                found = _plane_first_ge(plane, lower, C, L)
+            plausible = plausible & (found < L)
+            p_cursor = found + k
     return starts, ends, valid, plausible
 
 
