@@ -257,6 +257,51 @@ def device_stage_profile(parser, buf, lengths, batch):
     return out
 
 
+def kernel_rate(parser, lines, iters=5):
+    """Ground-truth kernel time via the xplane profiler (the ROADMAP's
+    profile_device tool): (kernel_ms_per_batch, lines_per_sec) or None when
+    the xplane proto module is unavailable.  This is the number of record —
+    the slope estimator below is cross-checked against it and the bench
+    FAILS when they diverge (round-3 verdict: the slope estimator read
+    23M-106M on the same kernel depending on tunnel jitter)."""
+    from logparser_tpu.tools.profile_device import profile_parser
+
+    prof = profile_parser(parser, lines, iters=iters)
+    if not prof:
+        return None
+    ms = prof[0][1] / iters
+    return ms, len(lines) / ms * 1000.0
+
+
+def previous_round_configs():
+    """Latest committed BENCH_r*.json's per-config dict (same host as the
+    driver's bench runs) — the baseline for the oracle-regression gate."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            # The driver's record wraps (and may front-truncate) the bench
+            # stdout under "tail" — decode the first complete object after
+            # the last '"configs":' key inside it.
+            text = doc.get("tail", "") if isinstance(doc, dict) else ""
+            if "configs" in doc and isinstance(doc["configs"], dict):
+                return doc["configs"], os.path.basename(path)
+            key = '"configs":'
+            idx = text.rindex(key)
+            configs, _ = json.JSONDecoder().raw_decode(
+                text[idx + len(key):].lstrip()
+            )
+            if isinstance(configs, dict) and configs:
+                return configs, os.path.basename(path)
+        except Exception:  # noqa: BLE001 — a malformed record is no baseline
+            continue
+    return {}, None
+
+
 def oracle_rate(parser, lines, sample=ORACLE_SAMPLE):
     from logparser_tpu.tpu.batch import _CollectingRecord
 
@@ -328,14 +373,27 @@ def bench_config(name, log_format, fields, lines_fn, extra):
     if pad > 0:
         buf = np.pad(buf, ((0, pad), (0, 0)))
         lengths = np.pad(lengths, (0, pad))
-    device = marginal_device_rate(parser, buf, lengths, CONFIG_BATCH,
-                                  n_lo=8, n_hi=40)
+    kern = kernel_rate(parser, lines)
+    if kern is not None:
+        # Number of record: xplane-profiled device time of the full fused
+        # executor.  The marginal-slope estimator is NOT used per config —
+        # at per-config iteration counts its timing deltas sit below the
+        # tunnel jitter (round-3 verdict: it read 23M-106M on the same
+        # kernel); it survives only for the 64k headline, where the
+        # deltas are large enough, as the cross-check the gate enforces.
+        device = kern[1]
+    else:
+        device = marginal_device_rate(parser, buf, lengths, CONFIG_BATCH,
+                                      n_lo=8, n_hi=40)
     oracle_lps = oracle_rate(parser, lines, sample=min(1000, len(lines)))
     effective = 1.0 / (1.0 / device + frac / oracle_lps)
     arrow_lps = arrow_rate(result)
     span_lps = span_column_rate(result)
     return {
         "device_lines_per_sec": round(device, 1),
+        **({"device_kernel_ms_per_batch": round(kern[0], 4),
+            "device_kernel_lines_per_sec": round(kern[1], 1)}
+           if kern else {}),
         "oracle_fraction": round(frac, 5),
         "host_oracle_lines_per_sec": round(oracle_lps, 1),
         # Delivery rate: rows/sec through a full pyarrow Table on this
@@ -405,8 +463,11 @@ def main():
         pass
     stream_lps = CONFIG_BATCH * ITERS / (time.perf_counter() - t0)
 
-    # 3) Device-resident marginal rate (the headline) + the per-stage
+    # 3) Device-resident rates: the xplane-profiled kernel time is the
+    # HEADLINE (ground truth; round-3 verdict item 1), the marginal-slope
+    # estimate stays as a cross-checked secondary, plus the per-stage
     # profile showing where the device time goes.
+    headline_kern = kernel_rate(parser, lines)
     device_resident = marginal_device_rate(parser, buf, lengths, BATCH)
     stage_profile = device_stage_profile(parser, buf, lengths, BATCH)
 
@@ -424,12 +485,50 @@ def main():
         except Exception as e:  # noqa: BLE001 — a config must not kill the run
             configs[cfg[0]] = {"error": f"{type(e).__name__}: {e}"}
 
+    # ---- credibility gates (round-3 verdict item 1) ---------------------
+    # (a) The independent slope estimator must agree with the profiler-
+    #     derived kernel rate within 1.5x on the 64k headline (the one
+    #     scale where its timing deltas clear the tunnel jitter) —
+    #     divergence means the published number is jitter, not measurement.
+    # (b) The host oracle rate must not regress >10% vs the latest
+    #     committed round (it is the fallback floor under every
+    #     oracle-routed input class).
+    gate_failures = []
+    for cname, c in configs.items():
+        if not isinstance(c, dict) or "error" in c:
+            gate_failures.append(f"{cname}: config errored")
+    if headline_kern:
+        ratio = max(device_resident / headline_kern[1],
+                    headline_kern[1] / device_resident)
+        if ratio > 1.5:
+            gate_failures.append(
+                f"headline: slope {device_resident:.3g} vs kernel "
+                f"{headline_kern[1]:.3g} lines/s diverge {ratio:.2f}x (>1.5x)"
+            )
+    prev_configs, prev_name = previous_round_configs()
+    for cname, prev in prev_configs.items():
+        cur = configs.get(cname)
+        if not (isinstance(prev, dict) and isinstance(cur, dict)):
+            continue
+        p_or = prev.get("host_oracle_lines_per_sec")
+        c_or = cur.get("host_oracle_lines_per_sec")
+        if p_or and c_or and c_or < 0.9 * p_or:
+            gate_failures.append(
+                f"{cname}: host oracle regressed {p_or:.0f} -> {c_or:.0f} "
+                f"lines/s (>10% vs {prev_name})"
+            )
+
+    headline = round(headline_kern[1], 1) if headline_kern else round(
+        device_resident, 1)
     print(json.dumps({
-        "metric": "device loglines/sec/chip (Apache combined)",
-        "value": round(device_resident, 1),
+        "metric": "device kernel loglines/sec/chip (Apache combined)",
+        "value": headline,
         "unit": "lines/sec",
-        "vs_baseline": round(device_resident / oracle_lps, 2),
+        "vs_baseline": round(headline / oracle_lps, 2),
         "p99_batch_latency_ms": round(p99_ms, 2),
+        **({"device_kernel_ms_per_batch": round(headline_kern[0], 4),
+            "device_kernel_lines_per_sec": round(headline_kern[1], 1)}
+           if headline_kern else {}),
         "device_resident_lines_per_sec": round(device_resident, 1),
         "arrow_lines_per_sec": round(arrow_lps, 1),
         "pipelined_end_to_end_lines_per_sec": round(pipelined, 1),
@@ -456,8 +555,15 @@ def main():
             ),
             default=1.0,
         ),
+        # Credibility gates: empty means no config errored, the headline
+        # slope cross-check agrees with the profiler ground truth
+        # (<=1.5x), and no host-oracle regression >10% vs the previous
+        # committed round.  A non-empty list also fails the process
+        # (exit 1) so CI/driver records it.
+        "gate_failures": gate_failures,
         "configs": configs,
     }))
+    return 1 if gate_failures else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,616 @@
+"""Compiled per-format store programs for the per-line host engine.
+
+The generic engine (Parser._run) routes every dissector output through
+``Parsable.add_dissection`` — a memoized but still per-output dict-probe +
+object-construction path — and re-discovers the same line-invariant routing
+decisions on every line (reference hot loop: Parser.java:726-756 +
+Parsable.java:142-193).  This module compiles that routing ONCE per
+assembled parser into flat per-format programs:
+
+- the LogFormat regex match feeds token captures straight into precompiled
+  *routes* (direct setter dispatch with resolved store plans — the
+  Parser.store inner loop of Parser.java:760-876 with every line-invariant
+  decision hoisted),
+- the hot sub-dissectors (timestamp, first line, protocol split, the
+  translate converters) compile to *value-level emitters* whose outputs
+  feed further precompiled routes,
+- anything else (URI repair, wildcards, GeoIP, ...) falls back to the
+  UNMODIFIED generic dissector running against a real Parsable, so the
+  messy byte-level semantics stay single-sourced.
+
+Semantics contract: identical delivered records and identical
+DissectionFailure behavior vs the generic engine — locked by
+tests/test_fastline.py differential sweeps.  compile_fastline returns None
+whenever a construct it cannot faithfully replay is present (stateful
+multi-format switching, a non-HttpdLogFormat root, ...); the caller then
+keeps the generic path.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .exceptions import DissectionFailure, FatalErrorDuringCallOfSetterMethod
+from .fields import ParsedField, make_field_id
+from .value import Value, _java_double_to_string, _parse_java_double, _parse_java_long
+
+_IN_PROGRESS = object()
+
+Route = Callable[["_Ctx", Any], None]
+
+
+class _Ctx:
+    """Per-line mutable state for the compiled path."""
+
+    __slots__ = ("record", "parsable", "delivered", "queue")
+
+    def __init__(self, record, parsable):
+        self.record = record
+        self.parsable = parsable          # real Parsable or None (lazy-less)
+        self.delivered = parsable.delivered if parsable is not None else set()
+        self.queue: List[Tuple[Callable, Any]] = []
+
+
+def _to_string(v) -> Optional[str]:
+    if v is None or isinstance(v, str):
+        return v
+    if isinstance(v, float):
+        return _java_double_to_string(v)
+    return str(v)
+
+
+def _to_long(v) -> Optional[int]:
+    if v is None or isinstance(v, int):
+        return v
+    if isinstance(v, str):
+        return _parse_java_long(v)
+    return Value(v).get_long()
+
+
+def _to_double(v) -> Optional[float]:
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return _parse_java_double(v)
+    return float(v)
+
+
+_CONVERT = {"STRING": _to_string, "LONG": _to_long, "DOUBLE": _to_double}
+
+
+def _compile_store(parser, key: str, name: str) -> Optional[Route]:
+    """Bind one store target's resolved plan into a closure replicating
+    Parser.store's inner loop on raw python values."""
+    plan = parser._store_plans.get(key)
+    if plan is None:
+        cache_key: Any = key
+        if key not in parser.casts_of_targets:
+            cache_key = (key, name)
+            plan = parser._store_plans.get(cache_key)
+        if plan is None:
+            plan = parser._build_store_plan(key, name)
+            if plan is None:
+                return None
+            parser._store_plans[cache_key] = plan
+    resolved, casts_to = plan
+    bound = tuple(
+        (m, a, vtype, _CONVERT[vtype], skip, ne)
+        for m, a, vtype, skip, ne in resolved
+    )
+
+    def store(ctx: _Ctx, v) -> None:
+        record = ctx.record
+        called = False
+        for method_name, arg_count, vtype, conv, skip_null, not_empty in bound:
+            out = conv(v)
+            if out is None and skip_null:
+                called = True
+                continue
+            if not_empty and vtype == "STRING" and out == "":
+                called = True
+                continue
+            method = getattr(record, method_name, None)
+            if method is None:
+                raise FatalErrorDuringCallOfSetterMethod(
+                    f"Record {type(record).__name__} has no method {method_name!r}"
+                )
+            try:
+                if arg_count == 2:
+                    method(name, out)
+                else:
+                    method(out)
+            except Exception as e:  # noqa: BLE001 — mirror the generic wrap
+                raise FatalErrorDuringCallOfSetterMethod(
+                    f'{e} when calling "{method_name}" for key="{key}" '
+                    f'name="{name}" value="{v}" casts_to="{casts_to}"'
+                ) from e
+            called = True
+        if not called:
+            raise FatalErrorDuringCallOfSetterMethod(
+                f'No setter called for key="{key}" name="{name}" value="{v}"'
+            )
+
+    return store
+
+
+class _Compiler:
+    def __init__(self, parser):
+        self.parser = parser
+        self.route_cache: Dict[Tuple[str, str, str], Route] = {}
+        # ids the last-chance converter pass may probe from the cache: those
+        # fields must be cached even when no generic phase consumes them.
+        self.probe_ids = {
+            make_field_id(input_type, nid.partition(":")[2])
+            for nid, options in parser._last_chance.items()
+            for input_type, _ in options
+        }
+        # True when any route needs a real Parsable (generic phase,
+        # last-chance probe target, or a routing cycle).
+        self.any_generic = bool(parser._last_chance)
+
+    # -- routing (the static image of Parsable.add_dissection) -----------
+
+    def route(self, base: str, ftype: str, name: str) -> Route:
+        key = (base, ftype, name)
+        got = self.route_cache.get(key)
+        if got is _IN_PROGRESS:
+            # Routing cycle (a dissector chain feeding itself): the generic
+            # engine terminates through the Parsable cache — route the
+            # cyclic edge generically so it does too.
+            self.any_generic = True
+            generic = self._generic_route(base, ftype, name)
+            self.route_cache[key] = generic
+            return generic
+        if got is None:
+            self.route_cache[key] = _IN_PROGRESS
+            compiled = self._compile_route(base, ftype, name)
+            if self.route_cache[key] is _IN_PROGRESS:
+                self.route_cache[key] = compiled
+            got = self.route_cache[key]
+        return got
+
+    def _generic_route(self, base: str, ftype: str, name: str) -> Route:
+        def generic(ctx: _Ctx, v) -> None:
+            ctx.parsable.add_dissection(base, ftype, name, v)
+        return generic
+
+    def _compile_route(self, base: str, ftype: str, name: str) -> Route:
+        parser = self.parser
+        complete_name = (
+            name if base == ""
+            else (base if name == "" else base + "." + name)
+        )
+
+        remap_routes: List[Route] = []
+        for new_type in parser.type_remappings.get(complete_name, ()):
+            if new_type == ftype:
+                def bad(ctx, v, _b=base, _t=ftype, _n=name):
+                    raise DissectionFailure(
+                        "[Type Remapping] Trying to map to the same type "
+                        f"(mapping definition bug!): base={_b} type={_t} name={_n}"
+                    )
+                remap_routes.append(bad)
+                continue
+            # Remapped delivery never re-applies remappings (the generic
+            # path passes _recursion=True) — compile the non-remap tail.
+            remap_routes.append(
+                self._compile_tail(base, new_type, name, complete_name)
+            )
+        tail = self._compile_tail(base, ftype, name, complete_name)
+
+        if not remap_routes:
+            return tail
+
+        def route(ctx: _Ctx, v) -> None:
+            for r in remap_routes:
+                r(ctx, v)
+            tail(ctx, v)
+        return route
+
+    def _compile_tail(
+        self, base: str, ftype: str, name: str, complete_name: str
+    ) -> Route:
+        """The non-remapping part of add_dissection for one static triple."""
+        parser = self.parser
+        if base == "":
+            needed_wildcard = ftype + ":*"
+        else:
+            needed_wildcard = ftype + ":" + base + ".*"
+        needed_name = ftype + ":" + complete_name
+        needed = parser.get_needed()
+
+        sinks: List[Route] = []
+        fid = make_field_id(ftype, complete_name)
+        is_intermediate = complete_name in parser.get_useful_intermediate_fields()
+        if is_intermediate:
+            phase_runs: List[Route] = []
+            for phase in parser._compiled.get(fid, ()):
+                phase_runs.append(self._compile_phase(phase, complete_name))
+            generic_phases = [
+                p for p, r in zip(parser._compiled.get(fid, ()), phase_runs)
+                if r is None
+            ]
+            fast_phases = [r for r in phase_runs if r is not None]
+            # Only fields a generic phase consumes or the last-chance pass
+            # can probe need the Parsable cache entry (every other cache
+            # reader fetches its own input, which the fast phases bypass).
+            must_cache = fid in self.probe_ids or bool(generic_phases)
+            if must_cache:
+                self.any_generic = True
+
+            generic_runs = [
+                (lambda ctx2, _v, _p=p: _p.instance.dissect(
+                    ctx2.parsable, complete_name))
+                for p in generic_phases
+            ]
+
+            def intermediate(ctx: _Ctx, v) -> None:
+                if must_cache:
+                    # The generic consumers (and the last-chance pass) read
+                    # the field from the Parsable cache, exactly like the
+                    # generic engine caches useful intermediates.
+                    val = v if isinstance(v, Value) else Value(v)
+                    pf = ParsedField(ftype, complete_name, val)
+                    ctx.parsable._cache[pf.id] = pf
+                for r in fast_phases:
+                    ctx.queue.append((r, v))
+                for g in generic_runs:
+                    ctx.queue.append((g, v))
+            sinks.append(intermediate)
+
+        if needed_name in needed:
+            store = _compile_store(parser, needed_name, needed_name)
+            if store is not None:
+                def needed_sink(ctx: _Ctx, v, _s=store) -> None:
+                    ctx.delivered.add(needed_name)
+                    _s(ctx, v)
+                sinks.append(needed_sink)
+        if needed_wildcard in needed:
+            store = _compile_store(parser, needed_wildcard, needed_name)
+            if store is not None:
+                sinks.append(store)
+
+        if not sinks:
+            def noop(ctx: _Ctx, v) -> None:
+                return
+            return noop
+        if len(sinks) == 1:
+            return sinks[0]
+
+        def multi(ctx: _Ctx, v) -> None:
+            for s in sinks:
+                s(ctx, v)
+        return multi
+
+    # -- value-level emitters for the hot sub-dissectors -----------------
+
+    def _compile_phase(self, phase, input_name: str) -> Optional[Route]:
+        """A value-level replay of one compiled phase, or None when the
+        dissector must run generically (against a real Parsable)."""
+        from ..dissectors.firstline import (
+            HttpFirstLineDissector,
+            HttpFirstLineProtocolDissector,
+        )
+        from ..dissectors.timestamp import TimeStampDissector
+        from ..dissectors.translate import (
+            ConvertCLFIntoNumber,
+            ConvertMillisecondsIntoMicroseconds,
+            ConvertNumberIntoCLF,
+            ConvertSecondsWithMillisStringDissector,
+        )
+
+        inst = phase.instance
+        if isinstance(inst, TimeStampDissector):
+            return self._compile_timestamp(inst, input_name)
+        if isinstance(inst, HttpFirstLineDissector):
+            return self._compile_firstline(inst, input_name)
+        if isinstance(inst, HttpFirstLineProtocolDissector):
+            return self._compile_protocol(inst, input_name)
+        if isinstance(inst, ConvertCLFIntoNumber):
+            out = self.route(input_name, inst.output_type, "")
+
+            def clf_num(ctx: _Ctx, v) -> None:
+                s = _to_string(v)
+                out(ctx, 0 if (s is None or s == "-") else v)
+            return clf_num
+        if isinstance(inst, ConvertNumberIntoCLF):
+            out = self.route(input_name, inst.output_type, "")
+
+            def num_clf(ctx: _Ctx, v) -> None:
+                out(ctx, None if _to_string(v) == "0" else v)
+            return num_clf
+        if isinstance(inst, ConvertMillisecondsIntoMicroseconds):
+            out = self.route(input_name, inst.output_type, "")
+
+            def ms_us(ctx: _Ctx, v) -> None:
+                out(ctx, _to_long(v) * 1000)
+            return ms_us
+        if isinstance(inst, ConvertSecondsWithMillisStringDissector):
+            out = self.route(input_name, inst.output_type, "")
+
+            def secms(ctx: _Ctx, v) -> None:
+                seconds_str, _, millis_str = _to_string(v).partition(".")
+                out(ctx, int(seconds_str) * 1000 + int(millis_str))
+            return secms
+        return None
+
+    def _compile_timestamp(self, inst, input_name: str) -> Route:
+        from .exceptions import DissectionFailure as DF
+        from ..dissectors.timelayout import TimestampParseError, week_based_fields
+        from ..dissectors.timestamp import _LOCAL_FIELDS
+
+        layout = inst.get_layout()
+        locale = inst.locale
+        w = inst.wanted
+
+        emits: List[Tuple[bool, Callable, Route]] = []  # (is_utc, compute, route)
+        if "timezone" in w:
+            emits.append((False, lambda ts: ts.zone_display_name(),
+                          self.route(input_name, "TIME.TIMEZONE", "timezone")))
+        if "epoch" in w:
+            emits.append((False, lambda ts: ts.epoch_millis,
+                          self.route(input_name, "TIME.EPOCH", "epoch")))
+        computes = {
+            "day": lambda ts: ts.day,
+            "monthname": lambda ts: locale.months_full[ts.month - 1],
+            "month": lambda ts: ts.month,
+            "year": lambda ts: ts.year,
+            "hour": lambda ts: ts.hour,
+            "minute": lambda ts: ts.minute,
+            "second": lambda ts: ts.second,
+            "millisecond": lambda ts: ts.nano // 1_000_000,
+            "microsecond": lambda ts: ts.nano // 1_000,
+            "nanosecond": lambda ts: ts.nano,
+            "date": lambda ts: ts.date_str(),
+            "time": lambda ts: ts.time_str(),
+        }
+        for suffix, is_utc in (("", False), ("_utc", True)):
+            for fname, ftype, _ in _LOCAL_FIELDS:
+                if fname + suffix not in w:
+                    continue
+                r = self.route(input_name, ftype, fname + suffix)
+                if fname == "weekofweekyear":
+                    if is_utc:
+                        compute = lambda ts: ts.iso_week()  # noqa: E731
+                    else:
+                        compute = lambda ts: week_based_fields(  # noqa: E731
+                            ts.year, ts.month, ts.day,
+                            locale.week_first_day, locale.week_min_days)[1]
+                elif fname == "weekyear":
+                    if is_utc:
+                        compute = lambda ts: ts.iso_weekyear()  # noqa: E731
+                    else:
+                        compute = lambda ts: week_based_fields(  # noqa: E731
+                            ts.year, ts.month, ts.day,
+                            locale.week_first_day, locale.week_min_days)[0]
+                else:
+                    compute = computes[fname]
+                emits.append((is_utc, compute, r))
+        any_utc = any(is_utc for is_utc, _, _ in emits)
+
+        def ts_emit(ctx: _Ctx, v) -> None:
+            value = _to_string(v)
+            if value is None or value == "":
+                return
+            try:
+                ts = layout.parse(value)
+            except TimestampParseError as e:
+                raise DF(str(e)) from e
+            except (ValueError, IndexError) as e:
+                raise DF(f"Unable to parse timestamp {value!r}: {e}") from e
+            utc = ts.utc_fields() if any_utc else None
+            for is_utc, compute, r in emits:
+                r(ctx, compute(utc if is_utc else ts))
+        return ts_emit
+
+    def _compile_firstline(self, inst, input_name: str) -> Route:
+        req = inst.requested
+        routes = {
+            "method": self.route(input_name, "HTTP.METHOD", "method"),
+            "uri": self.route(input_name, "HTTP.URI", "uri"),
+            "protocol": self.route(input_name, "HTTP.PROTOCOL_VERSION",
+                                   "protocol"),
+        }
+        splitter = inst._SPLITTER
+        too_long = inst._TOO_LONG_SPLITTER
+
+        def fl_emit(ctx: _Ctx, v) -> None:
+            value = _to_string(v)
+            if value is None or value == "" or value == "-":
+                return
+            m = splitter.search(value)
+            if m is not None:
+                if "method" in req:
+                    routes["method"](ctx, m.group(1))
+                if "uri" in req:
+                    routes["uri"](ctx, m.group(2))
+                if "protocol" in req:
+                    routes["protocol"](ctx, m.group(3))
+                return
+            m = too_long.search(value)
+            if m is not None:
+                if "method" in req:
+                    routes["method"](ctx, m.group(1))
+                if "uri" in req:
+                    routes["uri"](ctx, m.group(2))
+                routes["protocol"](ctx, None)
+        return fl_emit
+
+    def _compile_protocol(self, inst, input_name: str) -> Route:
+        req = inst.requested
+        r_proto = self.route(input_name, "HTTP.PROTOCOL", "")
+        r_ver = self.route(input_name, "HTTP.PROTOCOL.VERSION", "version")
+
+        def proto_emit(ctx: _Ctx, v) -> None:
+            value = _to_string(v)
+            if value is None or value == "" or value == "-":
+                return
+            parts = value.split("/", 1)
+            if len(parts) == 2:
+                if "" in req:
+                    r_proto(ctx, parts[0])
+                if "version" in req:
+                    r_ver(ctx, parts[1])
+                return
+            r_proto(ctx, None)
+            r_ver(ctx, None)
+        return proto_emit
+
+
+class _FormatProgram:
+    """One LogFormat's compiled stage-1: regex match -> token routes."""
+
+    __slots__ = ("tf", "token_routes", "apache_decode")
+
+    def __init__(self, tf, token_routes):
+        self.tf = tf
+        self.token_routes = token_routes
+        # The Apache decode (decode_extracted_apache_value) is value-only
+        # — inline it to skip two function calls per token; other dialects
+        # keep the method call.
+        from ..httpd.apache import ApacheHttpdLogFormatDissector
+
+        self.apache_decode = type(tf) is ApacheHttpdLogFormatDissector
+
+    def run(self, ctx: _Ctx, line: str) -> None:
+        tf = self.tf
+        if not tf._usable:
+            raise DissectionFailure("Dissector in unusable state")
+        m = tf._pattern.search(line) if line is not None else None
+        if m is None:
+            raise DissectionFailure(
+                "The input line does not match the specified log format."
+                f"Line     : {line}\n"
+                f"LogFormat: {tf.log_format}\n"
+                f"RegEx    : {tf._regex}"
+            )
+        groups = m.groups()
+        if self.apache_decode:
+            from ..dissectors.utils import decode_apache_httpd_log_value
+
+            for matched, fields in zip(groups, self.token_routes):
+                if matched == "-":
+                    matched = None
+                elif matched and (
+                    matched == "request.firstline"
+                    or matched.startswith(
+                        ("request.header.", "response.header.")
+                    )
+                ):
+                    # Faithful upstream quirk: the reference compares the
+                    # VALUE against these names (utils_apache.py).
+                    matched = decode_apache_httpd_log_value(matched)
+                for _fname, route in fields:
+                    route(ctx, matched)
+            return
+        decode = tf.decode_extracted_value
+        for i, fields in enumerate(self.token_routes, start=1):
+            matched = groups[i - 1]
+            for fname, route in fields:
+                route(ctx, decode(fname, matched))
+
+
+class FastLineEngine:
+    """Compiled replay of Parser.parse for HttpdLogFormat-rooted parsers."""
+
+    def __init__(self, parser, programs: List[_FormatProgram],
+                 needs_parsable: bool, cache_root: bool = False):
+        self.parser = parser
+        self.programs = programs
+        self.needs_parsable = needs_parsable
+        # Cache the root field only when the last-chance pass could probe
+        # it (nothing else reads it on the compiled path).
+        self.cache_root = cache_root
+
+    def parse(self, line: str, record: Any) -> Any:
+        parser = self.parser
+        parsable = None
+        if self.needs_parsable:
+            parsable = parser.create_parsable(record)
+            if self.cache_root:
+                parsable.set_root_dissection(parser.root_type, line)
+                parsable.to_be_parsed.clear()
+        ctx = _Ctx(record, parsable)
+        programs = self.programs
+        try:
+            programs[0].run(ctx, line)
+        except DissectionFailure:
+            # Multi-format fallback: on failure retry EVERY format in
+            # registration order (HttpdLogFormatDissector.java:174-204;
+            # stateless mode, so priority order every line).  Partial
+            # deliveries before the failure stay, like the generic path.
+            if len(programs) <= 1:
+                raise
+            for prog in programs:
+                try:
+                    prog.run(ctx, line)
+                    break
+                except DissectionFailure:
+                    continue
+            else:
+                raise
+        # Stage 2: sub-dissector waves in FIFO order (the generic worklist
+        # equivalent).  Emitters may enqueue further work (firstline -> URI).
+        queue = ctx.queue
+        i = 0
+        while i < len(queue):
+            fn, v = queue[i]
+            i += 1
+            fn(ctx, v)
+            if parsable is not None and parsable.to_be_parsed:
+                # A generic phase enqueued new intermediates through the
+                # real Parsable — drain them with the generic wave loop
+                # (without _run's trailing last-chance pass; that runs
+                # exactly once below, like the generic engine).
+                to_be = set(parsable.to_be_parsed)
+                while to_be:
+                    for pf in to_be:
+                        parsable.set_as_parsed(pf)
+                        for phase in parser._compiled.get(pf.id, ()):
+                            phase.instance.dissect(parsable, pf.name)
+                    to_be = set(parsable.to_be_parsed)
+        if parsable is not None:
+            parser._last_chance_converters(parsable)
+        return record
+
+
+def compile_fastline(parser) -> Optional[FastLineEngine]:
+    """Compile the assembled parser into a FastLineEngine, or None when a
+    construct the compiled path cannot faithfully replay is present."""
+    from ..httpd.format_dissector import HttpdLogFormatDissector
+
+    if parser.root_type is None:
+        return None
+    root_id = make_field_id(parser.root_type, "")
+    root_phases = parser._compiled.get(root_id, ())
+    if len(root_phases) != 1:
+        return None
+    root = root_phases[0].instance
+    if not isinstance(root, HttpdLogFormatDissector):
+        return None
+    if not root.stateless:
+        # Stateful active-format switching is stream-history-dependent;
+        # the compiled replay only models the deterministic stateless mode.
+        return None
+    if not root.dissectors:
+        return None
+
+    compiler = _Compiler(parser)
+    programs: List[_FormatProgram] = []
+    for tf in root.dissectors:
+        if not getattr(tf, "_usable", False):
+            return None
+        token_routes = []
+        for token in tf._used_tokens:
+            fields = []
+            for f in token.output_fields:
+                fields.append((f.name, compiler.route("", f.type, f.name)))
+            token_routes.append(fields)
+        programs.append(_FormatProgram(tf, token_routes))
+
+    # Generic phases, last-chance probes and routing cycles need a real
+    # Parsable per line; the compiler recorded whether any route does.
+    return FastLineEngine(
+        parser, programs,
+        needs_parsable=compiler.any_generic,
+        cache_root=root_id in compiler.probe_ids,
+    )

@@ -59,6 +59,10 @@ from .value import Value
 
 LOG = logging.getLogger(__name__)
 
+# Sentinel: the compiled line-program has not been built yet for the current
+# assembly (distinct from None = "compiled path unavailable, use generic").
+_FASTLINE_UNSET = object()
+
 
 class _DissectorPhase:
     __slots__ = ("input_type", "output_type", "name", "instance")
@@ -155,6 +159,13 @@ class Parser:
         # (base, type, name); reset whenever the parser (re)assembles.
         self.dissection_memo: Dict[tuple, tuple] = {}
         self._store_plans: Dict[Any, Any] = {}
+        # Compiled per-format store programs (core/fastline.py): the parse
+        # hot path when the parser shape supports it.  _FASTLINE_UNSET ->
+        # compile on first parse; None -> compiled path unavailable, use
+        # the generic engine.  use_fastline=False disables it entirely
+        # (the differential tests compare both paths).
+        self._fastline: Any = _FASTLINE_UNSET
+        self.use_fastline = True
 
         if record_class is not None:
             for name in dir(record_class):
@@ -342,6 +353,7 @@ class Parser:
             raise InvalidDissectorException("No root type was set")
         self.dissection_memo = {}  # targets may have changed since last run
         self._store_plans = {}
+        self._fastline = _FASTLINE_UNSET  # recompiles after reassembly
 
         # Fixpoint: dissectors may register additional dissectors recursively.
         done: Set[int] = set()
@@ -519,6 +531,20 @@ class Parser:
     def parse(self, value: str, record: Optional[Any] = None) -> Any:
         """Parse one line; returns the (new or given) record."""
         self.assemble_dissectors()
+        if self.use_fastline:
+            engine = self._fastline
+            if engine is _FASTLINE_UNSET:
+                from .fastline import compile_fastline
+
+                engine = self._fastline = compile_fastline(self)
+            if engine is not None:
+                if record is None:
+                    if self.record_class is None:
+                        raise InvalidDissectorException(
+                            "No record class and no record instance"
+                        )
+                    record = self.record_class()
+                return engine.parse(value, record)
         parsable = self.create_parsable(record)
         parsable.set_root_dissection(self.root_type, value)
         self._run(parsable)
@@ -747,4 +773,13 @@ class Parser:
         state["_located_targets"] = set()
         state["_needed_frozen"] = None
         state["_last_chance"] = {}
+        # Drop the compiled engine AND the sentinel: the sentinel is
+        # identity-compared, so it must be restored from this module on
+        # load, never round-tripped through the pickle.
+        state.pop("_fastline", None)
         return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self.__dict__["_fastline"] = _FASTLINE_UNSET
+        self.__dict__.setdefault("use_fastline", True)

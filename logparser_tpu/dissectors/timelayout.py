@@ -301,10 +301,26 @@ class TimeLayout:
         self.locale = locale or _EN
         self._fast = None          # lazily compiled regex fast path
         self._fast_tried = False
+        self._fixed = None         # lazily compiled fixed-width direct lane
+        self._fixed_tried = False
 
     def with_locale(self, locale: LocaleData) -> "TimeLayout":
         """The same layout re-bound to another locale's name tables."""
         return TimeLayout(self.items, self.default_zone, locale)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        # Compiled lanes hold closures/patterns; rebuild lazily on load.
+        state["_fast"] = None
+        state["_fast_tried"] = False
+        state["_fixed"] = None
+        state["_fixed_tried"] = False
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.__dict__.setdefault("_fixed", None)
+        self.__dict__.setdefault("_fixed_tried", False)
 
     def has_zone(self) -> bool:
         return any(it[0] in ("offset", "offset_colon", "zonetext") for it in self.items)
@@ -355,7 +371,146 @@ class TimeLayout:
                 return None
         return re.compile("".join(parts) + r"\Z", re.IGNORECASE), extractors
 
+    def _compile_fixed(self):
+        """Direct-slicing lane for fully fixed-width offset-bearing layouts
+        (the Apache ``dd/MMM/yyyy:HH:mm:ss ZZ`` shape): no regex, no field
+        dict, no datetime objects in the epoch math.  Returns a closure
+        ``s -> ParsedTimestamp | None`` (None = fall through to the exact
+        slower lanes, which also own every error message), or None when the
+        layout has any variable-width / zone-text / week / 12h construct.
+
+        Bit-exactness notes: the epoch replicates ``datetime.timestamp()``'s
+        float rounding exactly (``int((total_us / 10**6) * 1000)`` — the
+        same single division + multiply), the leap-second clamp matches
+        _resolve, and any out-of-range component bails to the slow lane so
+        range errors surface with identical messages.
+        """
+        steps = []  # (start, end, kind, payload); fixed offsets into s
+        pos = 0
+        have = set()
+        for it in self.items:
+            kind = it[0]
+            if kind == "lit":
+                steps.append((pos, pos + len(it[1]), "lit", it[1].lower()))
+                pos += len(it[1])
+            elif kind == "num":
+                _, field, minw, maxw, space_pad = it
+                if space_pad or minw != maxw:
+                    return None
+                if field not in ("day", "month", "year", "hour", "minute",
+                                 "second", "milli"):
+                    return None
+                steps.append((pos, pos + minw, "num", field))
+                have.add(field)
+                pos += minw
+            elif kind == "text":
+                _, field, style = it
+                if field != "monthname":
+                    return None
+                table = (self.locale.months_full if style == "full"
+                         else self.locale.months_short)
+                widths = {len(t) for t in table}
+                if len(widths) != 1:
+                    return None
+                w = widths.pop()
+                lookup = {t.lower(): i + 1 for i, t in enumerate(table)}
+                if len(lookup) != len(table):
+                    return None
+                steps.append((pos, pos + w, "month_text", lookup))
+                have.add("month")
+                pos += w
+            elif kind == "offset":
+                steps.append((pos, pos + 5, "offset", None))
+                have.add("offset")
+                pos += 5
+            else:
+                return None
+        if not {"year", "month", "day", "offset"} <= have:
+            return None
+        total = pos
+
+        def run(s: str):
+            if len(s) != total:
+                return None
+            y = mo = d = h = mi = sec = milli = off = 0
+            try:
+                for a, b, kind, payload in steps:
+                    if kind == "lit":
+                        if s[a:b].lower() != payload:
+                            return None
+                    elif kind == "num":
+                        part = s[a:b]
+                        if not part.isdigit():
+                            return None
+                        v = int(part)
+                        if payload == "day":
+                            d = v
+                        elif payload == "month":
+                            mo = v
+                        elif payload == "year":
+                            y = v
+                        elif payload == "hour":
+                            h = v
+                        elif payload == "minute":
+                            mi = v
+                        elif payload == "second":
+                            sec = v
+                        else:
+                            milli = v
+                    elif kind == "month_text":
+                        mo = payload.get(s[a:b].lower(), 0)
+                        if mo == 0:
+                            return None
+                    else:  # offset
+                        sign = s[a]
+                        body = s[a + 1:b]
+                        # Strict ASCII digits: the slower lanes' offset
+                        # regex is [0-9] (unlike the unicode-accepting
+                        # isdigit() the numeric fields share with them).
+                        if (sign not in "+-" or not body.isascii()
+                                or not body.isdigit()):
+                            return None
+                        off = int(body[:2]) * 3600 + int(body[2:]) * 60
+                        if off >= 86400:
+                            # datetime.timezone (the slow lane) rejects
+                            # offsets of 24h or more — bail so it does.
+                            return None
+                        if sign == "-":
+                            off = -off
+                if sec == 60:
+                    sec = 59  # leap second: java.time SMART clamps
+                if not (1 <= mo <= 12 and 1 <= d <= 31 and h <= 23
+                        and mi <= 59 and sec <= 59):
+                    return None
+                # days-from-civil (proleptic Gregorian), then the exact
+                # float rounding datetime.timestamp() applies.
+                yy = y - (mo <= 2)
+                era = (yy if yy >= 0 else yy - 399) // 400
+                yoe = yy - era * 400
+                doy = (153 * (mo + (-3 if mo > 2 else 9)) + 2) // 5 + d - 1
+                doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+                days = era * 146097 + doe - 719468
+                base_s = days * 86400 + h * 3600 + mi * 60 + sec - off
+                micro = milli * 1000
+                total_us = base_s * 10**6 + micro
+                epoch_millis = int((total_us / 10**6) * 1000)
+                return ParsedTimestamp(
+                    y, mo, d, h, mi, sec, milli * 1_000_000, off, None,
+                    epoch_millis,
+                )
+            except (ValueError, IndexError):
+                return None
+
+        return run
+
     def parse(self, s: str) -> ParsedTimestamp:
+        if not self._fixed_tried:
+            self._fixed_tried = True
+            self._fixed = self._compile_fixed()
+        if self._fixed is not None:
+            ts = self._fixed(s)
+            if ts is not None:
+                return ts
         if not self._fast_tried:
             self._fast_tried = True
             self._fast = self._compile_fast()

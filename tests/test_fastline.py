@@ -1,0 +1,186 @@
+"""Differential lock: the compiled line engine == the generic engine.
+
+core/fastline.py compiles per-format store programs; every delivered
+record and every raised failure must match the generic Parsable/worklist
+path exactly.  Sweeps cover token-level delivery, sub-dissector chains
+(timestamp incl. locales, first line, URI, query wildcards, cookies),
+multi-format priority, remapping, and hostile corpora.
+"""
+import pickle
+
+import pytest
+
+from logparser_tpu.httpd import HttpdLoglineParser
+from logparser_tpu.tools.demolog import HEADLINE_FIELDS, generate_combined_lines
+
+
+class Rec:
+    def __init__(self):
+        self.values = {}
+
+    def set_value(self, name, value):
+        self.values[name] = value
+
+
+NGINX = (
+    '$remote_addr - $remote_user [$time_local] "$request" $status '
+    '$body_bytes_sent "$http_referer" "$http_user_agent"'
+)
+
+CASES = [
+    ("combined", HEADLINE_FIELDS),
+    ("combined", [
+        "TIME.EPOCH:request.receive.time.epoch",
+        "TIME.MONTHNAME:request.receive.time.monthname",
+        "TIME.WEEK:request.receive.time.weekofweekyear",
+        "TIME.YEAR:request.receive.time.year_utc",
+        "TIME.DATE:request.receive.time.date_utc",
+        "HTTP.PROTOCOL:request.firstline.protocol",
+        "HTTP.PROTOCOL.VERSION:request.firstline.protocol.version",
+    ]),
+    # URI chain + query wildcard: generic phases driven through the
+    # compiled path's Parsable bridge.
+    ("combined", [
+        "HTTP.PATH:request.firstline.uri.path",
+        "HTTP.QUERYSTRING:request.firstline.uri.query",
+        "STRING:request.firstline.uri.query.*",
+    ]),
+    (NGINX, ["IP:connection.client.host", "TIME.STAMP:request.receive.time",
+             "HTTP.PATH:request.firstline.uri.path",
+             "STRING:request.status.last"]),
+    # Multi-format: registration priority decides per line.
+    ("combined\n%h %l %u %t \"%r\" %>s %b",
+     ["IP:connection.client.host", "STRING:request.status.last",
+      "BYTES:response.body.bytes"]),
+]
+
+
+def _corpus():
+    lines = generate_combined_lines(60, seed=11, garbage_fraction=0.15)
+    lines += [
+        "",
+        "-",
+        '1.2.3.4 - - [31/Dec/2023:23:59:60 +0100] "GET /leap HTTP/1.1" 200 0 "-" "x"',
+        '1.2.3.4 - - [29/Feb/2023:10:00:00 +0000] "GET /bad-date HTTP/1.1" 200 0 "-" "x"',
+        '1.2.3.4 - - [10/Oct/2023:13:55:36 -0700] "BROKEN" 200 - "-" "x"',
+        '1.2.3.4 - - [10/Oct/2023:13:55:36 -0700] "GET /x?a=1&b=%41&c HTTP/1.0" 503 12 "-" "x"',
+        # common-format line (multi-format case exercises the fallback)
+        '5.6.7.8 - frank [10/Oct/2023:13:55:36 +0000] "GET / HTTP/1.0" 200 5',
+    ]
+    return lines
+
+
+def _run(parser_factory, line):
+    parser = parser_factory()
+    rec = Rec()
+    try:
+        parser.parse(line, rec)
+        return ("ok", rec.values)
+    except Exception as e:  # noqa: BLE001 — failure parity is the contract
+        return (type(e).__name__, str(e))
+
+
+@pytest.mark.parametrize("fmt,fields", CASES)
+def test_fastline_matches_generic(fmt, fields):
+    def build(fast):
+        p = HttpdLoglineParser(Rec, fmt)
+        p.all_dissectors[0].stateless = True
+        p.add_parse_target("set_value", fields)
+        p.use_fastline = fast
+        return p
+
+    fast_p = build(True)
+    slow_p = build(False)
+    fast_p.assemble_dissectors()
+    # The compiled engine must actually engage for these shapes.
+    from logparser_tpu.core.fastline import compile_fastline
+
+    assert compile_fastline(fast_p) is not None
+    for line in _corpus():
+        fast = _run(lambda: fast_p, line)
+        slow = _run(lambda: slow_p, line)
+        assert fast == slow, f"divergence on {line!r}:\n {fast}\n {slow}"
+
+
+def test_fastline_locale_timestamps():
+    # The strftime format admits the dotted French short-month tokens.
+    fmt = '%h %l %u [%{%d/%b/%Y:%H:%M:%S %z}t] "%r" %>s %b'
+    fields = [
+        "TIME.EPOCH:request.receive.time.epoch",
+        "TIME.MONTHNAME:request.receive.time.monthname",
+    ]
+
+    def build(fast):
+        p = HttpdLoglineParser(Rec, fmt)
+        p.all_dissectors[0].stateless = True
+        p.add_parse_target("set_value", fields)
+        p.set_locale("fr")
+        p.use_fastline = fast
+        return p
+
+    line = ('1.2.3.4 - - [10/oct./2023:13:55:36 -0700] "GET / HTTP/1.0" '
+            '200 0')
+    a, b = Rec(), Rec()
+    build(True).parse(line, a)
+    build(False).parse(line, b)
+    assert a.values == b.values
+    assert a.values["TIME.MONTHNAME:request.receive.time.monthname"] == "octobre"
+
+
+def test_fastline_survives_pickle():
+    p = HttpdLoglineParser(Rec, "combined")
+    p.all_dissectors[0].stateless = True
+    p.add_parse_target("set_value", HEADLINE_FIELDS)
+    line = generate_combined_lines(1, seed=3)[0]
+    r1 = Rec()
+    p.parse(line, r1)
+    clone = pickle.loads(pickle.dumps(p))
+    r2 = Rec()
+    clone.parse(line, r2)
+    assert r1.values == r2.values
+
+
+def test_fixed_timestamp_lane_matches_slow_lane():
+    """The fixed-width direct lane in TimeLayout must agree with the slow
+    item parser on hostile near-miss inputs (review finding: >=24h offsets
+    were accepted where datetime.timezone rejects them)."""
+    import random
+
+    from logparser_tpu.dissectors.timelayout import (
+        TimestampParseError,
+        compile_java_pattern,
+    )
+
+    layout = compile_java_pattern("dd/MMM/yyyy:HH:mm:ss ZZ")
+    assert layout._compile_fixed() is not None
+    rng = random.Random(5)
+    months = ["Jan", "Feb", "Mar", "Apr", "May", "Jun", "Jul", "Aug",
+              "Sep", "Oct", "Nov", "Dec", "Xxx", "foo"]
+    for _ in range(4000):
+        s = (f"{rng.randrange(0, 40):02d}/{rng.choice(months)}/"
+             f"{rng.randrange(1000, 3000):04d}:{rng.randrange(0, 30):02d}:"
+             f"{rng.randrange(0, 70):02d}:{rng.randrange(0, 70):02d} "
+             f"{rng.choice('+-')}{rng.randrange(0, 100):02d}"
+             f"{rng.randrange(0, 100):02d}")
+        fixed = layout._compile_fixed()(s)
+        try:
+            slow = layout._parse_slow(s)
+        except (TimestampParseError, ValueError, IndexError):
+            slow = None
+        if fixed is None:
+            continue  # fall-through is always allowed
+        assert slow is not None, f"fixed lane accepted what slow rejects: {s}"
+        assert (fixed.epoch_millis, fixed.offset_seconds) == (
+            slow.epoch_millis, slow.offset_seconds), s
+
+
+def test_fastline_stateful_mode_stays_generic():
+    """Stateful multi-format switching is stream-history-dependent; the
+    compiled engine must decline it."""
+    from logparser_tpu.core.fastline import compile_fastline
+
+    p = HttpdLoglineParser(Rec, "combined")
+    assert p.all_dissectors[0].stateless is False
+    p.add_parse_target("set_value", ["IP:connection.client.host"])
+    p.assemble_dissectors()
+    assert compile_fastline(p) is None
