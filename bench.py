@@ -320,17 +320,20 @@ def oracle_rate(parser, lines, sample=ORACLE_SAMPLE):
     return len(sample_lines) / (time.perf_counter() - t0)
 
 
-def arrow_rate(result, iters=5):
+def arrow_rate(result, iters=5, **kwargs):
     """Host-side delivery rate: rows/sec THROUGH a pyarrow Table — the
     rate a consumer of the framework actually observes (the TPU-native
     analogue of the reference's per-record setter delivery,
-    Parser.java:760-876).  Warm (the batch-level ASCII check and lazy
-    wildcard materialization are per-batch, cached), then best-of."""
-    result.to_arrow()
+    Parser.java:760-876).  The default table uses zero-copy string_view
+    span columns (round-4 materializer); kwargs select variants
+    (strings="copy" = contiguous StringArrays).  Warm (the batch-level
+    ASCII check, per-batch decode caches and lazy wildcard
+    materialization are per-batch), then best-of."""
+    result.to_arrow(**kwargs)
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
-        result.to_arrow()
+        result.to_arrow(**kwargs)
         best = min(best, time.perf_counter() - t0)
     return result.lines_read / best
 
@@ -388,6 +391,7 @@ def bench_config(name, log_format, fields, lines_fn, extra):
     oracle_lps = oracle_rate(parser, lines, sample=min(1000, len(lines)))
     effective = 1.0 / (1.0 / device + frac / oracle_lps)
     arrow_lps = arrow_rate(result)
+    arrow_copy_lps = arrow_rate(result, strings="copy")
     span_lps = span_column_rate(result)
     return {
         "device_lines_per_sec": round(device, 1),
@@ -397,8 +401,11 @@ def bench_config(name, log_format, fields, lines_fn, extra):
         "oracle_fraction": round(frac, 5),
         "host_oracle_lines_per_sec": round(oracle_lps, 1),
         # Delivery rate: rows/sec through a full pyarrow Table on this
-        # host (all columns), and the span-columns-only variant.
+        # host (all columns; zero-copy string_view span columns), the
+        # classic contiguous-StringArray variant, and the
+        # span-columns-only variant.
         "arrow_lines_per_sec": round(arrow_lps, 1),
+        "arrow_copy_lines_per_sec": round(arrow_copy_lps, 1),
         **({"arrow_span_columns_lines_per_sec": round(span_lps, 1)}
            if span_lps else {}),
         # Combined-path model: every line pays the device rate, the oracle
@@ -497,6 +504,18 @@ def main():
     for cname, c in configs.items():
         if not isinstance(c, dict) or "error" in c:
             gate_failures.append(f"{cname}: config errored")
+    # (c) Consumer-visible Arrow delivery must stay at/above the north
+    #     star on this host (round-3 verdict item 2): combined >= 10M
+    #     rows/s, nginx_uri >= 5M rows/s through a full pyarrow Table.
+    for cname, floor in (("combined", 10e6), ("nginx_uri", 5e6)):
+        c = configs.get(cname)
+        if isinstance(c, dict) and "arrow_lines_per_sec" in c:
+            got = c["arrow_lines_per_sec"]
+            if got < floor:
+                gate_failures.append(
+                    f"{cname}: arrow delivery {got:.3g} rows/s below "
+                    f"the {floor:.0e} north-star floor"
+                )
     if headline_kern:
         ratio = max(device_resident / headline_kern[1],
                     headline_kern[1] / device_resident)

@@ -92,6 +92,29 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.lp_copy_spans.argtypes = [u8p, i64p, u8p, i64p,
                                       ctypes.c_int64, ctypes.c_int32]
         lib.lp_copy_spans.restype = None
+        if hasattr(lib, "lp_build_views"):
+            # Older cached .so builds predate the view materializer.
+            lib.lp_build_views.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int64, i32p, i32p, u8p,
+                ctypes.c_int64, ctypes.c_int32,
+            ]
+            lib.lp_build_views.restype = None
+        if hasattr(lib, "lp_patch_views"):
+            lib.lp_patch_views.argtypes = [
+                u8p, i64p, i64p, ctypes.c_int64, ctypes.c_int32, u8p,
+            ]
+            lib.lp_patch_views.restype = None
+        if hasattr(lib, "lp_repair_scan"):
+            lib.lp_repair_scan.argtypes = [
+                u8p, i64p, ctypes.c_int64, ctypes.c_int32, u8p, i64p, u8p,
+                ctypes.c_int32,
+            ]
+            lib.lp_repair_scan.restype = None
+            lib.lp_repair_write.argtypes = [
+                u8p, i64p, ctypes.c_int64, ctypes.c_int32, u8p, i64p, u8p,
+                u8p, ctypes.c_int32,
+            ]
+            lib.lp_repair_write.restype = None
         _lib = lib
         return _lib
 
@@ -280,6 +303,133 @@ def copy_spans(
         total, dtype=np.int64
     )
     return src_c[idx]
+
+
+def build_views(
+    buf: np.ndarray,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    threads: int = 0,
+) -> np.ndarray:
+    """Arrow BinaryView structs for K span columns of a [B, L] buffer.
+
+    ``starts``/``lens`` are [K, B] (lens < 0 = null row -> zeroed view).
+    Returns a [K, B, 16] uint8 array of Arrow string_view structs whose
+    long strings reference the FLATTENED buffer at offset ``r*L + start``
+    (buffer index 0) — no byte gather at all; strings of <= 12 bytes are
+    inlined per the Arrow spec.  Caller guarantees B*L < 2^31."""
+    starts2 = np.ascontiguousarray(starts, dtype=np.int32)
+    K, B = starts2.shape
+    L = buf.shape[1]
+    if B * L >= 2**31:
+        raise ValueError("buffer too large for int32 view offsets")
+    lens2 = np.ascontiguousarray(lens, dtype=np.int32)
+    buf_c = np.ascontiguousarray(buf)
+    views = np.empty(K * B * 16, dtype=np.uint8)
+    lib = get_lib()
+    if lib is not None and hasattr(lib, "lp_build_views"):
+        lib.lp_build_views(
+            _u8(buf_c), B, L,
+            starts2.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lens2.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            _u8(views), K, threads or _default_threads(),
+        )
+        return views.reshape(K, B, 16)
+    # numpy fallback: same encoding, vectorized.
+    views = views.reshape(K * B, 16)
+    views[:] = 0
+    flat = buf_c.reshape(-1)
+    sf = starts2.reshape(-1).astype(np.int64)
+    lf = lens2.reshape(-1).astype(np.int64)
+    live = lf >= 0
+    ln = np.where(live, lf, 0)
+    vi32 = views.view(np.int32).reshape(K * B, 4)
+    vi32[live, 0] = ln[live].astype(np.int32)
+    abs_off = np.tile(np.arange(B, dtype=np.int64) * L, K) + sf
+    idx = np.minimum(abs_off[:, None] + np.arange(12), B * L - 1)
+    first12 = flat[idx]
+    mask = np.arange(12)[None, :] < np.minimum(ln, 12)[:, None]
+    views[:, 4:16] = np.where(mask & live[:, None], first12, 0)
+    long_rows = live & (lf > 12)
+    vi32[long_rows, 2] = 0
+    vi32[long_rows, 3] = abs_off[long_rows].astype(np.int32)
+    return views.reshape(K, B, 16)
+
+
+def patch_views(
+    views: np.ndarray,
+    rows: np.ndarray,
+    side: np.ndarray,
+    side_off: np.ndarray,
+    buffer_index: int,
+) -> None:
+    """Re-point selected rows of a [B, 16] view array at a side buffer
+    (repaired/overridden values).  ``side_off`` is [n_rows+1] into
+    ``side``; C++ row loop with a vectorized numpy fallback."""
+    n = rows.size
+    if n == 0:
+        return
+    lib = get_lib()
+    if lib is not None and hasattr(lib, "lp_patch_views"):
+        rows64 = np.ascontiguousarray(rows, dtype=np.int64)
+        side_c = np.ascontiguousarray(side)
+        off64 = np.ascontiguousarray(side_off, dtype=np.int64)
+        lib.lp_patch_views(
+            _u8(side_c if len(side_c) else np.zeros(1, np.uint8)),
+            off64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            rows64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, buffer_index, _u8(views),
+        )
+        return
+    lens = np.diff(side_off).astype(np.int64)
+    sub = np.zeros((n, 16), dtype=np.uint8)
+    v32 = sub.view(np.int32).reshape(n, 4)
+    v32[:, 0] = lens.astype(np.int32)
+    idx = np.minimum(side_off[:-1, None] + np.arange(12),
+                     max(len(side) - 1, 0))
+    first12 = side[idx] if len(side) else np.zeros((n, 12), np.uint8)
+    mask = np.arange(12)[None, :] < np.minimum(lens, 12)[:, None]
+    sub[:, 4:16] = np.where(mask, first12, 0)
+    long_rows = lens > 12
+    v32[long_rows, 2] = buffer_index
+    v32[long_rows, 3] = side_off[:-1][long_rows].astype(np.int32)
+    views[rows] = sub
+
+
+def repair_spans(seg: np.ndarray, seg_off: np.ndarray, escape_mode: bool,
+                 enc_table: np.ndarray, threads: int = 0):
+    """Native URI-repair of per-row segments: returns
+    (out_flat, out_lens int64[n], py_flags bool[n]) where py-flagged rows
+    (non-ASCII / non-ASCII decode) are zero-length in out_flat and must be
+    repaired per-row in Python.  None when the native library (or the
+    repair entry points) is unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lp_repair_scan"):
+        return None
+    n = len(seg_off) - 1
+    seg_c = np.ascontiguousarray(seg)
+    off64 = np.ascontiguousarray(seg_off, dtype=np.int64)
+    enc_c = np.ascontiguousarray(enc_table, dtype=np.uint8)
+    out_lens = np.empty(n, dtype=np.int64)
+    py_flags = np.empty(n, dtype=np.uint8)
+    mode = 1 if escape_mode else 0
+    nthreads = threads or _default_threads()
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.lp_repair_scan(
+        _u8(seg_c if len(seg_c) else np.zeros(1, np.uint8)),
+        off64.ctypes.data_as(i64p), n, mode, _u8(enc_c),
+        out_lens.ctypes.data_as(i64p), _u8(py_flags), nthreads,
+    )
+    out_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(out_lens, out=out_off[1:])
+    out = np.empty(int(out_off[-1]), dtype=np.uint8)
+    lib.lp_repair_write(
+        _u8(seg_c if len(seg_c) else np.zeros(1, np.uint8)),
+        off64.ctypes.data_as(i64p), n, mode, _u8(enc_c),
+        out_off.ctypes.data_as(i64p), _u8(py_flags),
+        _u8(out if len(out) else np.zeros(1, np.uint8)), nthreads,
+    )
+    return out, out_lens, py_flags.astype(bool)
 
 
 def _encode_blob_numpy(

@@ -18,8 +18,104 @@
 #include <cstdint>
 #include <cstring>
 #include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <functional>
+#include <mutex>
 #include <thread>
 #include <vector>
+
+// Persistent worker pool: per-call std::thread spawns (~50us each) used
+// to dominate the small batched calls (view builds, repairs) — the pool
+// is created on first parallel call and reused for every lp_* entry
+// point.  One job at a time (outer job mutex); chunks are handed out via
+// an atomic cursor so uneven rows balance.
+namespace {
+
+class Pool {
+ public:
+  explicit Pool(int n) : nworkers_(n) {
+    for (int i = 0; i < n; ++i) workers_.emplace_back([this] { Loop(); });
+  }
+
+  void Run(int64_t total, int64_t chunk,
+           const std::function<void(int64_t, int64_t)>& body) {
+    std::lock_guard<std::mutex> job(job_m_);
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      body_ = &body;
+      total_ = total;
+      chunk_ = chunk;
+      next_.store(0, std::memory_order_relaxed);
+      active_.store(nworkers_, std::memory_order_relaxed);
+      ++gen_;
+      cv_.notify_all();
+    }
+    std::unique_lock<std::mutex> lk(m_);
+    done_cv_.wait(lk, [&] { return active_.load() == 0; });
+  }
+
+ private:
+  void Loop() {
+    uint64_t seen = 0;
+    for (;;) {
+      const std::function<void(int64_t, int64_t)>* body;
+      int64_t total, chunk;
+      {
+        std::unique_lock<std::mutex> lk(m_);
+        cv_.wait(lk, [&] { return gen_ != seen; });
+        seen = gen_;
+        body = body_;
+        total = total_;
+        chunk = chunk_;
+      }
+      for (;;) {
+        int64_t lo = next_.fetch_add(chunk, std::memory_order_relaxed);
+        if (lo >= total) break;
+        (*body)(lo, std::min(total, lo + chunk));
+      }
+      if (active_.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(m_);
+        done_cv_.notify_all();
+      }
+    }
+  }
+
+  int nworkers_;
+  std::vector<std::thread> workers_;
+  std::mutex job_m_, m_;
+  std::condition_variable cv_, done_cv_;
+  const std::function<void(int64_t, int64_t)>* body_ = nullptr;
+  int64_t total_ = 0, chunk_ = 0;
+  std::atomic<int64_t> next_{0};
+  std::atomic<int> active_{0};
+  uint64_t gen_ = 0;
+};
+
+void lp_run(int64_t n, int32_t threads,
+            const std::function<void(int64_t, int64_t)>& body) {
+  if (threads <= 1 || n < 4096) {
+    body(0, n);
+    return;
+  }
+  static Pool* pool = nullptr;
+  static std::mutex create_m;
+  {
+    std::lock_guard<std::mutex> lk(create_m);
+    if (pool == nullptr) {
+      // Size by the hardware, not the first caller's thread count — the
+      // pool is process-wide and a small first request must not cap
+      // every later call's parallelism.
+      unsigned hw = std::thread::hardware_concurrency();
+      int n = std::max<int>(threads, hw ? static_cast<int>(hw) : threads);
+      pool = new Pool(n);
+    }
+  }
+  int64_t chunk = std::max<int64_t>(512, n / (threads * 4));
+  pool->Run(n, chunk, body);
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -68,7 +164,6 @@ void lp_pack(const uint8_t* data, const int64_t* offsets,
              const int32_t* lens, int64_t n,
              uint8_t* out, int32_t* lengths, int64_t L, int32_t threads) {
   if (threads < 1) threads = 1;
-  int64_t chunk = (n + threads - 1) / threads;
   auto work = [&](int64_t lo, int64_t hi) {
     for (int64_t r = lo; r < hi; ++r) {
       int64_t len = lens[r];
@@ -83,17 +178,7 @@ void lp_pack(const uint8_t* data, const int64_t* offsets,
       }
     }
   };
-  if (threads == 1 || n < 4096) {
-    work(0, n);
-    return;
-  }
-  std::vector<std::thread> pool;
-  for (int32_t t = 0; t < threads; ++t) {
-    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
-    if (lo >= hi) break;
-    pool.emplace_back(work, lo, hi);
-  }
-  for (auto& th : pool) th.join();
+  lp_run(n, threads, work);
 }
 
 // Span gather: per-row (start, end) windows of a padded [B, L] buffer ->
@@ -105,7 +190,6 @@ void lp_gather_spans(const uint8_t* buf, int64_t B, int64_t L,
                      const int32_t* starts, const int64_t* offsets,
                      uint8_t* out, int32_t threads) {
   if (threads < 1) threads = 1;
-  int64_t chunk = (B + threads - 1) / threads;
   auto work = [&](int64_t lo, int64_t hi) {
     for (int64_t r = lo; r < hi; ++r) {
       int64_t len = offsets[r + 1] - offsets[r];
@@ -113,17 +197,7 @@ void lp_gather_spans(const uint8_t* buf, int64_t B, int64_t L,
       std::memcpy(out + offsets[r], buf + r * L + starts[r], len);
     }
   };
-  if (threads == 1 || B < 4096) {
-    work(0, B);
-    return;
-  }
-  std::vector<std::thread> pool;
-  for (int32_t t = 0; t < threads; ++t) {
-    int64_t lo = t * chunk, hi = std::min(B, lo + chunk);
-    if (lo >= hi) break;
-    pool.emplace_back(work, lo, hi);
-  }
-  for (auto& th : pool) th.join();
+  lp_run(B, threads, work);
 }
 
 // Multi-column span gather: K span columns over the SAME [B, L] buffer in
@@ -138,26 +212,19 @@ void lp_gather_spans_multi(const uint8_t* buf, int64_t B, int64_t L,
                            uint8_t* out, int64_t K, int32_t threads) {
   if (threads < 1) threads = 1;
   int64_t n = K * B;
-  int64_t chunk = (n + threads - 1) / threads;
+  if (n == 0) return;  // the row-tracking modulo below needs B > 0
   auto work = [&](int64_t lo, int64_t hi) {
+    int64_t r = lo % B;
+    int64_t row_base = r * L;
     for (int64_t i = lo; i < hi; ++i) {
       int64_t len = offsets[i + 1] - offsets[i];
-      if (len <= 0) continue;
-      int64_t r = i % B;
-      std::memcpy(out + offsets[i], buf + r * L + starts[i], len);
+      if (len > 0) {
+        std::memcpy(out + offsets[i], buf + row_base + starts[i], len);
+      }
+      if (++r == B) { r = 0; row_base = 0; } else row_base += L;
     }
   };
-  if (threads == 1 || n < 4096) {
-    work(0, n);
-    return;
-  }
-  std::vector<std::thread> pool;
-  for (int32_t t = 0; t < threads; ++t) {
-    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
-    if (lo >= hi) break;
-    pool.emplace_back(work, lo, hi);
-  }
-  for (auto& th : pool) th.join();
+  lp_run(n, threads, work);
 }
 
 // Flat re-layout: per-row copy from arbitrary source offsets in one flat
@@ -168,7 +235,6 @@ void lp_copy_spans(const uint8_t* src, const int64_t* src_off,
                    uint8_t* dst, const int64_t* dst_off,
                    int64_t n, int32_t threads) {
   if (threads < 1) threads = 1;
-  int64_t chunk = (n + threads - 1) / threads;
   auto work = [&](int64_t lo, int64_t hi) {
     for (int64_t r = lo; r < hi; ++r) {
       int64_t len = dst_off[r + 1] - dst_off[r];
@@ -176,17 +242,194 @@ void lp_copy_spans(const uint8_t* src, const int64_t* src_off,
       std::memcpy(dst + dst_off[r], src + src_off[r], len);
     }
   };
-  if (threads == 1 || n < 4096) {
-    work(0, n);
-    return;
+  lp_run(n, threads, work);
+}
+
+// Arrow BinaryView (string_view) materializer: K span columns over the
+// same [B, L] buffer -> packed 16-byte Arrow view structs, NO byte
+// gather.  Strings of <= 12 bytes are inlined in the view (the Arrow
+// spec requires it); longer ones store (length, 4-byte prefix,
+// buffer_index=0, offset into the flattened [B*L] buffer), so the Arrow
+// column references the batch buffer zero-copy.  starts/lens are [K*B]
+// column-major; lens[i] < 0 marks a null row (zeroed view; the validity
+// bitmap is the caller's).  Offsets require B*L < 2^31 (caller-guarded).
+void lp_build_views(const uint8_t* buf, int64_t B, int64_t L,
+                    const int32_t* starts, const int32_t* lens,
+                    uint8_t* views, int64_t K, int32_t threads) {
+  if (threads < 1) threads = 1;
+  int64_t n = K * B;
+  if (n == 0) return;  // the row-tracking modulo below needs B > 0
+  int64_t size = B * L;
+  // Inline masks: keep bytes < len of a constant-size 12-byte load
+  // (branch-free tail zeroing; the variable-length memcpy + memset pair
+  // was the single-core hot spot).
+  static uint64_t mask_a[13];
+  static uint32_t mask_b[13];
+  static bool masks_init = [] {
+    for (int l = 0; l <= 12; ++l) {
+      int ka = l < 8 ? l : 8;
+      int kb = l < 8 ? 0 : l - 8;
+      mask_a[l] = ka == 8 ? ~0ULL : ((1ULL << (8 * ka)) - 1);
+      mask_b[l] = kb == 4 ? ~0U : ((1U << (8 * kb)) - 1);
+    }
+    return true;
+  }();
+  (void)masks_init;
+  auto work = [&](int64_t lo, int64_t hi) {
+    int64_t r = lo % B;                 // incremental row tracking: the
+    int64_t row_base = r * L;          // per-element % B div was ~half
+    for (int64_t i = lo; i < hi; ++i) {  // the single-core loop cost
+      uint8_t* v = views + i * 16;
+      int32_t len = lens[i];
+      if (len < 0) {
+        std::memset(v, 0, 16);
+        if (++r == B) { r = 0; row_base = 0; } else row_base += L;
+        continue;
+      }
+      int64_t off = row_base + starts[i];
+      const uint8_t* src = buf + off;
+      std::memcpy(v, &len, 4);
+      if (len <= 12) {
+        uint64_t a = 0;
+        uint32_t b = 0;
+        if (off + 12 <= size) {
+          std::memcpy(&a, src, 8);
+          std::memcpy(&b, src + 8, 4);
+          a &= mask_a[len];
+          b &= mask_b[len];
+        } else {
+          uint8_t tmp[12] = {0};
+          std::memcpy(tmp, src, static_cast<size_t>(len));
+          std::memcpy(&a, tmp, 8);
+          std::memcpy(&b, tmp + 8, 4);
+        }
+        std::memcpy(v + 4, &a, 8);
+        std::memcpy(v + 12, &b, 4);
+      } else {
+        std::memcpy(v + 4, src, 4);
+        int32_t bufi = 0;
+        int32_t off32 = static_cast<int32_t>(off);
+        std::memcpy(v + 8, &bufi, 4);
+        std::memcpy(v + 12, &off32, 4);
+      }
+      if (++r == B) { r = 0; row_base = 0; } else row_base += L;
+    }
+  };
+  lp_run(n, threads, work);
+}
+
+// Re-point selected rows of a [B, 16] Arrow view array at a side buffer
+// (repaired / overridden values).  rows/side_off are per patch entry;
+// the same inline-vs-reference encoding as lp_build_views.
+void lp_patch_views(const uint8_t* side, const int64_t* side_off,
+                    const int64_t* rows, int64_t n_rows,
+                    int32_t buffer_index, uint8_t* views) {
+  for (int64_t j = 0; j < n_rows; ++j) {
+    uint8_t* v = views + rows[j] * 16;
+    int64_t off = side_off[j];
+    int32_t len = static_cast<int32_t>(side_off[j + 1] - off);
+    const uint8_t* src = side + off;
+    std::memcpy(v, &len, 4);
+    if (len <= 12) {
+      std::memset(v + 4, 0, 12);
+      std::memcpy(v + 4, src, len);
+    } else {
+      std::memcpy(v + 4, src, 4);
+      int32_t off32 = static_cast<int32_t>(off);
+      std::memcpy(v + 8, &buffer_index, 4);
+      std::memcpy(v + 12, &off32, 4);
+    }
   }
-  std::vector<std::thread> pool;
-  for (int32_t t = 0; t < threads; ++t) {
-    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
-    if (lo >= hi) break;
-    pool.emplace_back(work, lo, hi);
-  }
-  for (auto& th : pool) th.join();
+}
+
+// URI-repair scan (the hot classification of the Arrow bridge's
+// _repair_fix_segments, ported 1:1 — see that function's docstring for
+// the semantics derivation).  mode 0 = decode (path/userinfo): good %XX
+// escapes substitute their byte, bad escapes stay literal; mode 1 =
+// escape (query): bad '%' expands to "%25", encode-set bytes to their
+// uppercase %XX triple.  Rows with any byte >= 0x80 — or, in decode
+// mode, a good escape decoding to >= 0x80 — set py_flags[r] (exact
+// UTF-8 semantics stay in Python) and get out_lens[r] = 0.
+static inline bool lp_is_hex(uint8_t c) {
+  return (c >= '0' && c <= '9') || (c >= 'a' && c <= 'f') ||
+         (c >= 'A' && c <= 'F');
+}
+static inline int lp_hex_val(uint8_t c) {
+  if (c <= '9') return c - '0';
+  if (c >= 'a') return c - 'a' + 10;
+  return c - 'A' + 10;
+}
+
+void lp_repair_scan(const uint8_t* seg, const int64_t* seg_off, int64_t n,
+                    int32_t mode, const uint8_t* enc_table,
+                    int64_t* out_lens, uint8_t* py_flags, int32_t threads) {
+  if (threads < 1) threads = 1;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      const uint8_t* s = seg + seg_off[r];
+      int64_t len = seg_off[r + 1] - seg_off[r];
+      bool py = false;
+      int64_t out = len;
+      for (int64_t i = 0; i < len; ++i) {
+        uint8_t c = s[i];
+        if (c >= 0x80) { py = true; break; }
+        if (c == '%' && i + 2 < len && lp_is_hex(s[i + 1]) &&
+            lp_is_hex(s[i + 2])) {
+          if (mode == 0) {
+            int dec = (lp_hex_val(s[i + 1]) << 4) | lp_hex_val(s[i + 2]);
+            if (dec >= 0x80) { py = true; break; }
+            out -= 2;
+            i += 2;  // consume the escape
+          }
+          // escape mode: well-formed escapes copy verbatim
+        } else if (mode == 1 && (c == '%' || enc_table[c])) {
+          out += 2;  // %25 insertion / %XX expansion
+        }
+      }
+      py_flags[r] = py ? 1 : 0;
+      out_lens[r] = py ? 0 : out;
+    }
+  };
+  lp_run(n, threads, work);
+}
+
+void lp_repair_write(const uint8_t* seg, const int64_t* seg_off, int64_t n,
+                     int32_t mode, const uint8_t* enc_table,
+                     const int64_t* out_off, const uint8_t* py_flags,
+                     uint8_t* out, int32_t threads) {
+  static const char HEX[] = "0123456789ABCDEF";
+  if (threads < 1) threads = 1;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      if (py_flags[r]) continue;
+      const uint8_t* s = seg + seg_off[r];
+      int64_t len = seg_off[r + 1] - seg_off[r];
+      uint8_t* d = out + out_off[r];
+      for (int64_t i = 0; i < len; ++i) {
+        uint8_t c = s[i];
+        bool good = c == '%' && i + 2 < len && lp_is_hex(s[i + 1]) &&
+                    lp_is_hex(s[i + 2]);
+        if (mode == 0) {
+          if (good) {
+            *d++ = static_cast<uint8_t>(
+                (lp_hex_val(s[i + 1]) << 4) | lp_hex_val(s[i + 2]));
+            i += 2;
+          } else {
+            *d++ = c;
+          }
+        } else {
+          if (c == '%' && !good) {
+            *d++ = '%'; *d++ = '2'; *d++ = '5';
+          } else if (c != '%' && enc_table[c]) {
+            *d++ = '%'; *d++ = HEX[c >> 4]; *d++ = HEX[c & 0x0F];
+          } else {
+            *d++ = c;
+          }
+        }
+      }
+    }
+  };
+  lp_run(n, threads, work);
 }
 
 // One-shot convenience: frame + pack a whole blob.  Returns line count.

@@ -201,7 +201,11 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                         f"{len(lines)}"
                     )
                 result = parser.parse_batch(lines)
-                table = result.to_arrow(include_validity=True)
+                # Copy mode for the wire: IPC does not dedupe shared
+                # buffers, so string_view columns would each ship a full
+                # copy of the batch buffer.
+                table = result.to_arrow(include_validity=True,
+                                        strings="copy")
                 import pyarrow as pa
 
                 sink = pa.BufferOutputStream()

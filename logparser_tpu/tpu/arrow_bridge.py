@@ -96,38 +96,62 @@ for _c in _ENCODE_PRINTABLE:
 _HEX_UPPER = np.frombuffer(b"0123456789ABCDEF", dtype=np.uint8)
 
 
-def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid):
-    """Patch URI-repair (`fix`) rows into gathered flat span bytes.
+def _repair_fix_segments(seg, seg_off, mode):
+    """Vectorized URI repair over concatenated fix-row bytes.
 
-    The flat gather copies repair rows RAW; the repair semantics
-    (%-bad-escape rewrite + path %XX decode, HttpUriDissector.java:166-167
-    / java.net.URI decode) run here VECTORIZED over the concatenated
-    fix-row bytes: rows whose escapes are all well-formed ``%XX`` decode
-    with numpy scatter/gather; only rows with bad escapes, non-ASCII raw
-    bytes, or non-ASCII decode results (UTF-8 replacement semantics) take
-    the per-row ``_fix_uri_part`` path.  Spliced python-row values
-    re-encode through UTF-8, so they are valid by construction."""
+    The repair semantics (%-bad-escape rewrite + path %XX decode,
+    HttpUriDissector.java:166-167 / java.net.URI decode) run VECTORIZED
+    in fix-row space: rows whose escapes are all well-formed ``%XX``
+    decode with numpy scatter/gather; only rows with bad escapes,
+    non-ASCII raw bytes, or non-ASCII decode results (UTF-8 replacement
+    semantics) take the per-row ``_fix_uri_part`` path.  Returns
+    (flat, lens): one repaired value per input row, in order (unchanged
+    rows keep their original bytes).  Per-row python values re-encode
+    through UTF-8, so they are valid by construction."""
     from .batch import _fix_uri_part
 
-    col = result.column(field_id)
-    fix = col.get("fix")
-    B = result.lines_read
-    if fix is None:
-        return data, offsets
-    rows = np.nonzero(np.asarray(fix[:B], dtype=bool) & valid)[0]
-    if rows.size == 0:
-        return data, offsets
-    mode = col["fix_mode"]
-    lens = np.diff(offsets)
-    seg_lens = lens[rows]
-    n_rows = rows.size
-    seg_off = np.zeros(n_rows + 1, dtype=np.int64)
-    np.cumsum(seg_lens, out=seg_off[1:])
+    n_rows = len(seg_off) - 1
+
+    from ..native import copy_spans, repair_spans
+
+    native = repair_spans(seg, seg_off, mode not in ("path", "userinfo"),
+                          _IS_ENC)
+    if native is not None:
+        out_flat, out_lens, py_flags = native
+        if not py_flags.any():
+            if np.array_equal(out_lens, np.diff(seg_off)):
+                # Nothing changed (any real native repair changes a
+                # row's length): return the INPUT so callers' identity
+                # checks skip their column rebuilds.
+                return seg, out_lens
+            return out_flat, out_lens
+        py_idx = np.nonzero(py_flags)[0]
+        py_bytes = [
+            _fix_uri_part(
+                bytes(seg[seg_off[j]: seg_off[j + 1]]).decode(
+                    "utf-8", "replace"), mode,
+            ).encode("utf-8")
+            for j in py_idx.tolist()
+        ]
+        out_off = np.zeros(n_rows + 1, dtype=np.int64)
+        np.cumsum(out_lens, out=out_off[1:])
+        src_base = out_off[:-1].copy()
+        new_lens = out_lens.copy()
+        base = len(out_flat)
+        off = 0
+        for j, v in zip(py_idx.tolist(), py_bytes):
+            src_base[j] = base + off
+            new_lens[j] = len(v)
+            off += len(v)
+        combined = np.concatenate(
+            [out_flat, np.frombuffer(b"".join(py_bytes), dtype=np.uint8)]
+        )
+        final_off = np.zeros(n_rows + 1, dtype=np.int64)
+        np.cumsum(new_lens, out=final_off[1:])
+        return copy_spans(combined, src_base, final_off), new_lens
+
     total = int(seg_off[-1])
-    idx = np.repeat(offsets[rows] - seg_off[:-1], seg_lens) + np.arange(
-        total, dtype=np.int64
-    )
-    seg = data[idx]
+    seg_lens = np.diff(seg_off)
     row_id = np.repeat(np.arange(n_rows, dtype=np.int64), seg_lens)
 
     # Classify every '%' as a well-formed %XX escape or a bad escape
@@ -184,13 +208,9 @@ def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid)
         vec_changed = row_any(bad | enc) & ~py_rows
 
     py_idx = np.nonzero(py_rows)[0]
-    changed_local = np.nonzero(vec_changed | py_rows)[0]
-    if changed_local.size == 0:
-        return data, offsets
-
-    pieces = [data]
-    src_base = offsets[:-1].astype(np.int64, copy=True)
-    new_lens = lens.copy()
+    new_lens = seg_lens.astype(np.int64, copy=True)
+    src_base = seg_off[:-1].astype(np.int64, copy=True)
+    pieces = [seg]
     if vec_changed.any():
         in_vec = vec_changed[row_id]
         if mode in ("path", "userinfo"):
@@ -228,8 +248,8 @@ def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid)
         vloc = np.nonzero(vec_changed)[0]
         voff = np.zeros(vloc.size + 1, dtype=np.int64)
         np.cumsum(row_counts[vloc], out=voff[1:])
-        src_base[rows[vloc]] = len(data) + voff[:-1]
-        new_lens[rows[vloc]] = row_counts[vloc]
+        src_base[vloc] = len(seg) + voff[:-1]
+        new_lens[vloc] = row_counts[vloc]
         pieces.append(new_seg)
     if py_idx.size:
         py_bytes = [
@@ -243,14 +263,57 @@ def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid)
         base = sum(len(p) for p in pieces)
         off = 0
         for j, v in zip(py_idx.tolist(), py_bytes):
-            src_base[rows[j]] = base + off
-            new_lens[rows[j]] = len(v)
+            src_base[j] = base + off
+            new_lens[j] = len(v)
             off += len(v)
         pieces.append(py_buf)
 
     from ..native import copy_spans
 
-    combined = np.concatenate(pieces) if len(pieces) > 1 else data
+    if len(pieces) == 1:
+        return seg, seg_lens.astype(np.int64)
+    combined = np.concatenate(pieces)
+    out_off = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(new_lens, out=out_off[1:])
+    return copy_spans(combined, src_base, out_off), new_lens
+
+
+def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid):
+    """Patch URI-repair (`fix`) rows into gathered flat span bytes: the
+    flat gather copies repair rows RAW; :func:`_repair_fix_segments`
+    produces their repaired values, spliced back with the native threaded
+    memcpy fan-out."""
+    col = result.column(field_id)
+    fix = col.get("fix")
+    B = result.lines_read
+    if fix is None:
+        return data, offsets
+    rows = np.nonzero(np.asarray(fix[:B], dtype=bool) & valid)[0]
+    if rows.size == 0:
+        return data, offsets
+    lens = np.diff(offsets)
+    seg_lens = lens[rows]
+    n_rows = rows.size
+    seg_off = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(seg_lens, out=seg_off[1:])
+    total = int(seg_off[-1])
+    idx = np.repeat(offsets[rows] - seg_off[:-1], seg_lens) + np.arange(
+        total, dtype=np.int64
+    )
+    seg = data[idx]
+    rep_flat, rep_lens = _repair_fix_segments(seg, seg_off, col["fix_mode"])
+    if rep_flat is seg:
+        return data, offsets
+
+    from ..native import copy_spans
+
+    rep_off = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(rep_lens, out=rep_off[1:])
+    src_base = offsets[:-1].astype(np.int64, copy=True)
+    new_lens = lens.astype(np.int64, copy=True)
+    src_base[rows] = len(data) + rep_off[:-1]
+    new_lens[rows] = rep_lens
+    combined = np.concatenate([data, rep_flat])
     new_off = np.zeros_like(offsets)
     np.cumsum(new_lens, out=new_off[1:])
     # Rebuild via the native threaded memcpy fan-out (numpy's per-element
@@ -258,8 +321,263 @@ def _splice_fix_rows(result: "BatchResult", field_id: str, data, offsets, valid)
     return copy_spans(combined, src_base, new_off), new_off
 
 
+def _view_column_inputs(result: "BatchResult", field_id: str, buf,
+                        base: Optional[Any] = None):
+    """Per-column prep for the view materializer: (starts, lens_main,
+    state) where state carries everything the assembly step needs.
+    ``base`` optionally carries the batched (valid, starts, lens) triple
+    computed once for all columns.  Returns None when the column must
+    take the copy path."""
+    col = result.column(field_id)
+    if col["kind"] != "span":
+        return None
+    B = result.lines_read
+    overrides = result._overrides.get(field_id, {})
+    ov_rows: List[int] = []
+    ov_vals: List[bytes] = []
+    for r, v in overrides.items():
+        if v is None:
+            continue
+        if not isinstance(v, str):
+            return None
+        ov_rows.append(r)
+        ov_vals.append(v.encode("utf-8"))
+
+    if base is not None:
+        valid, starts, lens = base
+    else:
+        valid = (
+            np.asarray(result.valid[:B]).astype(bool)
+            & np.asarray(col["ok"][:B]).astype(bool)
+            & ~np.asarray(col["null"][:B]).astype(bool)
+        )
+        starts = np.asarray(col["starts"][:B], dtype=np.int32)
+        lens = np.where(
+            valid, np.asarray(col["ends"][:B]) - starts, -1
+        ).astype(np.int32)
+    arr_valid = valid if not overrides else valid.copy()
+    for r, v in overrides.items():
+        arr_valid[r] = v is not None
+    if ov_rows:
+        lens = lens.copy()
+        lens[np.asarray(ov_rows)] = -1  # patched from the side buffer
+
+    fix = col.get("fix")
+    amp = col.get("amp")
+    fix_m = (
+        np.asarray(fix[:B], dtype=bool) & valid
+        if fix is not None else None
+    )
+    if fix_m is not None and not fix_m.any():
+        fix_m = None
+    amp_m = None
+    if amp is not None:
+        cand = np.asarray(amp[:B], dtype=bool) & valid & (lens > 0)
+        if cand.any():
+            first = buf[np.nonzero(cand)[0], starts[cand]]
+            cand[np.nonzero(cand)[0]] = first == np.uint8(ord("?"))
+            amp_m = cand if cand.any() else None
+    if ov_rows and (fix_m is not None or amp_m is not None):
+        sel = np.zeros(B, dtype=bool)
+        sel[np.asarray(ov_rows)] = True
+        if fix_m is not None:
+            fix_m &= ~sel
+        if amp_m is not None:
+            amp_m &= ~sel
+    if fix_m is not None or amp_m is not None:
+        special = (
+            fix_m if amp_m is None
+            else (amp_m if fix_m is None else fix_m | amp_m)
+        )
+        lens_main = lens.copy()
+        lens_main[special] = -1  # patched from the side buffer
+    else:
+        special = None
+        lens_main = lens
+    state = (col, valid, arr_valid, lens, special, fix_m, amp_m,
+             ov_rows, ov_vals)
+    return starts, lens_main, state
+
+
+def _assemble_view_array(result: "BatchResult", buf, starts, views, state):
+    """Side-buffer handling + pa.Array assembly for one view column."""
+    import pyarrow as pa
+
+    from ..native import copy_spans, patch_views
+
+    (col, valid, arr_valid, lens, special, fix_m, amp_m,
+     ov_rows, ov_vals) = state
+    B = result.lines_read
+    L = buf.shape[1]
+    views = np.ascontiguousarray(views.reshape(B, 16))
+    variadic = [pa.py_buffer(buf.reshape(-1))]
+    if special is not None:
+        rows = np.nonzero(special)[0]
+        sub_lens = lens[rows].astype(np.int64)
+        sub_off = np.zeros(rows.size + 1, dtype=np.int64)
+        np.cumsum(sub_lens, out=sub_off[1:])
+        src_off = rows.astype(np.int64) * L + starts[rows]
+        sub = copy_spans(buf.reshape(-1), src_off, sub_off)
+        if amp_m is not None:
+            amp_sub = amp_m[rows]
+            if amp_sub.any():
+                sub[sub_off[:-1][amp_sub]] = np.uint8(ord("&"))
+        fix_sub = (
+            np.nonzero(fix_m[rows])[0] if fix_m is not None
+            else np.empty(0, dtype=np.int64)
+        )
+        if fix_sub.size:
+            f_lens = sub_lens[fix_sub]
+            f_off = np.zeros(fix_sub.size + 1, dtype=np.int64)
+            np.cumsum(f_lens, out=f_off[1:])
+            f_seg = copy_spans(sub, sub_off[:-1][fix_sub], f_off)
+            rep_flat, rep_lens = _repair_fix_segments(
+                f_seg, f_off, col["fix_mode"]
+            )
+            if rep_flat is not f_seg or np.any(rep_lens != f_lens):
+                # Reassemble the side buffer with the repaired values.
+                new_lens = sub_lens.copy()
+                new_lens[fix_sub] = rep_lens
+                src_base = sub_off[:-1].copy()
+                rep_off = np.zeros(fix_sub.size + 1, dtype=np.int64)
+                np.cumsum(rep_lens, out=rep_off[1:])
+                src_base[fix_sub] = len(sub) + rep_off[:-1]
+                combined = np.concatenate([sub, rep_flat])
+                new_off = np.zeros(rows.size + 1, dtype=np.int64)
+                np.cumsum(new_lens, out=new_off[1:])
+                sub = copy_spans(combined, src_base, new_off)
+                sub_off = new_off
+        patch_views(views, rows, sub, sub_off, len(variadic))
+        variadic.append(pa.py_buffer(sub))
+    if ov_rows:
+        ov_flat = np.frombuffer(b"".join(ov_vals), dtype=np.uint8)
+        ov_off = np.zeros(len(ov_rows) + 1, dtype=np.int64)
+        np.cumsum([len(v) for v in ov_vals], out=ov_off[1:])
+        patch_views(views, np.asarray(ov_rows), ov_flat, ov_off,
+                    len(variadic))
+        variadic.append(pa.py_buffer(ov_flat))
+
+    null_bitmap = (
+        None if arr_valid.all()
+        else pa.py_buffer(np.packbits(arr_valid, bitorder="little"))
+    )
+    arr = pa.Array.from_buffers(
+        pa.string_view(), B,
+        [null_bitmap, pa.py_buffer(views)] + variadic,
+    )
+    if not result.ascii_only:
+        try:
+            arr.validate(full=True)
+        except pa.ArrowInvalid:
+            return None
+    return arr
+
+
+def _spans_to_view_array(result: "BatchResult", field_id: str):
+    """Zero-copy span column -> pa.StringViewArray.
+
+    Arrow's BinaryView layout stores (length, prefix, buffer, offset) per
+    element, so clean rows reference the batch's [B, L] byte buffer
+    IN PLACE — no gather, no value copy; only the 16-byte view structs
+    are built (native lp_build_views).  Rows the buffer bytes cannot
+    represent — URI-repair ``fix`` rows, ``amp`` (?->&) rows,
+    host-override rows — land in a compact side buffer (repaired via
+    _repair_fix_segments) that the views reference as further data
+    buffers.  Returns None when the column needs the copy path (non-str
+    overrides, >2^31 buffer, or non-UTF-8 values)."""
+    import pyarrow as pa
+
+    from ..native import build_views
+
+    B = result.lines_read
+    if B == 0:
+        return pa.array([], type=pa.string_view())
+    buf = np.ascontiguousarray(result.buf[:B])
+    if buf.size >= 2**31:
+        return None
+    pre = _view_column_inputs(result, field_id, buf)
+    if pre is None:
+        return None
+    starts, lens_main, state = pre
+    views = build_views(buf, starts[None, :], lens_main[None, :])[0]
+    return _assemble_view_array(result, buf, starts, views, state)
+
+
+def _span_view_arrays(result: "BatchResult", field_ids) -> Dict[str, Any]:
+    """Batched view materialization: ONE native lp_build_views call
+    covers every eligible span column (the per-call thread-pool spawn
+    dominated per-column builds).  Ineligible columns are absent."""
+    import pyarrow as pa
+
+    from ..native import build_views
+
+    out: Dict[str, Any] = {}
+    if not hasattr(pa, "string_view"):
+        return out
+    B = result.lines_read
+    if B == 0:
+        return out
+    buf = np.ascontiguousarray(result.buf[:B])
+    if buf.size >= 2**31:
+        return out
+    span_fids = [
+        fid for fid in field_ids
+        if result.column(fid)["kind"] == "span"
+    ]
+    if not span_fids:
+        return out
+    # Batched base prep: ONE stacked pass computes valid/starts/lens for
+    # every span column (per-column [B] numpy chains added up).  The
+    # result is line-invariant per batch, so it is memoized on the
+    # BatchResult like the other per-batch decode caches (ascii check,
+    # lazy wildcards) — the delivered views themselves are rebuilt on
+    # every call.
+    pre_cache = result.__dict__.setdefault("_view_pre", {})
+    missing = [fid for fid in span_fids if fid not in pre_cache]
+    if missing:
+        # Batched base prep: ONE stacked pass computes valid/starts/lens
+        # for every span column; the per-column pre (incl. special-row
+        # masks) is line-invariant per batch and memoized on the
+        # BatchResult like the other per-batch decode caches (ascii
+        # check, lazy wildcards) — the delivered views and side buffers
+        # themselves are rebuilt on every call.
+        cols = [result.column(fid) for fid in missing]
+        line_valid = np.asarray(result.valid[:B]).astype(bool)
+        ok_k = np.stack([np.asarray(c["ok"][:B], dtype=bool) for c in cols])
+        null_k = np.stack(
+            [np.asarray(c["null"][:B], dtype=bool) for c in cols]
+        )
+        starts_k = np.stack(
+            [np.asarray(c["starts"][:B], dtype=np.int32) for c in cols]
+        )
+        ends_k = np.stack(
+            [np.asarray(c["ends"][:B], dtype=np.int32) for c in cols]
+        )
+        valid_k = ok_k & ~null_k & line_valid[None, :]
+        lens_k = np.where(valid_k, ends_k - starts_k, -1).astype(np.int32)
+        for k, fid in enumerate(missing):
+            pre_cache[fid] = _view_column_inputs(
+                result, fid, buf, base=(valid_k[k], starts_k[k], lens_k[k])
+            )
+    pres = [
+        (fid, pre_cache[fid]) for fid in span_fids
+        if pre_cache[fid] is not None
+    ]
+    if not pres:
+        return out
+    starts = np.stack([p[1][0] for p in pres])
+    lens = np.stack([p[1][1] for p in pres])
+    views = build_views(buf, starts, lens)
+    for k, (fid, (st, _lm, state)) in enumerate(pres):
+        arr = _assemble_view_array(result, buf, st, views[k], state)
+        if arr is not None:
+            out[fid] = arr
+    return out
+
+
 def _column_to_arrow(
-    result: "BatchResult", field_id: str, flat: Optional[Any] = None
+    result: "BatchResult", field_id: str, flat: Optional[Any] = None,
+    strings: str = "view", prebuilt: Optional[Any] = None,
 ):
     import pyarrow as pa
 
@@ -267,6 +585,24 @@ def _column_to_arrow(
     kind = col["kind"]
     overrides = result._overrides.get(field_id, {})
     B = result.lines_read
+
+    if kind == "span" and not field_id.endswith(".*") and strings == "view":
+        if not hasattr(pa, "string_view"):
+            # Older pyarrow without the BinaryView type (added in 14,
+            # buildable from buffers in 16): classic StringArrays.
+            return _column_to_arrow(result, field_id, flat, strings="copy")
+        arr = prebuilt if prebuilt is not None else _spans_to_view_array(
+            result, field_id
+        )
+        if arr is not None:
+            return arr
+        # Copy-path fallback (non-str overrides / oversized buffer /
+        # non-UTF-8): cast string results to string_view so the column
+        # type stays stable across batches.
+        arr = _column_to_arrow(result, field_id, flat, strings="copy")
+        if pa.types.is_string(arr.type):
+            arr = arr.cast(pa.string_view())
+        return arr
 
     if kind == "numeric" and not any(
         isinstance(v, (str, dict)) for v in overrides.values()
@@ -280,7 +616,11 @@ def _column_to_arrow(
         values[null & null_zero] = 0
         mask = mask | (null & ~null_zero)
         for row, v in overrides.items():
-            if v is None:
+            if v is None or not -2**63 <= v < 2**63:
+                # Beyond-int64 oracle values (>18-digit counters) deliver
+                # NULL in the typed column — exactly the reference's
+                # Long.parseLong null on its Long-typed setters;
+                # to_pylist still carries the full python int.
                 mask[row] = True
             else:
                 values[row] = v
@@ -363,21 +703,36 @@ def _column_to_arrow(
     )
 
 
-def batch_to_arrow(result: "BatchResult", include_validity: bool = True):
-    """BatchResult -> pyarrow.Table (one column per requested field)."""
+def batch_to_arrow(
+    result: "BatchResult", include_validity: bool = True,
+    strings: str = "view",
+):
+    """BatchResult -> pyarrow.Table (one column per requested field).
+
+    ``strings="view"`` (default) delivers span columns as Arrow
+    string_view arrays referencing the batch buffer zero-copy — the table
+    shares the batch's memory (kept alive by the Arrow buffers).
+    ``strings="copy"`` builds classic contiguous StringArrays instead
+    (self-contained value buffers; the pre-round-4 behavior)."""
     import pyarrow as pa
 
-    # One threaded multi-column gather covers every flat-eligible span
-    # column; ineligible columns (overrides/fix/wildcards) fall through
-    # to their per-column paths inside _column_to_arrow.
-    flats = result.span_bytes_many(
-        [f for f in result.field_ids() if not f.endswith(".*")],
-        include_fix=True,
-    )
+    # In copy mode one threaded multi-column gather covers every
+    # flat-eligible span column; in view mode one batched native view
+    # build covers them instead (no byte gather at all).
+    span_fids = [f for f in result.field_ids() if not f.endswith(".*")]
+    if strings == "view":
+        flats: Dict[str, Any] = {}
+        prebuilt = _span_view_arrays(result, span_fids)
+    else:
+        flats = result.span_bytes_many(span_fids, include_fix=True)
+        prebuilt = {}
     arrays = []
     names = []
     for field_id in result.field_ids():
-        arrays.append(_column_to_arrow(result, field_id, flats.get(field_id)))
+        arrays.append(_column_to_arrow(
+            result, field_id, flats.get(field_id), strings=strings,
+            prebuilt=prebuilt.get(field_id),
+        ))
         names.append(field_id)
     if include_validity:
         arrays.append(pa.array(np.asarray(result.valid, dtype=bool)))
@@ -403,5 +758,11 @@ def table_from_ipc_bytes(data: bytes):
 
 
 def parse_to_ipc(parser, lines: Sequence[Any]) -> bytes:
-    """One-call sidecar surface: lines in, Arrow IPC stream bytes out."""
-    return table_to_ipc_bytes(batch_to_arrow(parser.parse_batch(lines)))
+    """One-call sidecar surface: lines in, Arrow IPC stream bytes out.
+
+    Serialization uses the contiguous copy mode: IPC does not dedupe
+    shared buffers, so a string_view table would ship one copy of the
+    whole batch buffer PER span column over the wire."""
+    return table_to_ipc_bytes(
+        batch_to_arrow(parser.parse_batch(lines), strings="copy")
+    )

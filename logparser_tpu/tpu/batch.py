@@ -547,11 +547,18 @@ class BatchResult:
             out[fid] = (col_data, offsets, valid_k)
         return out
 
-    def to_arrow(self, include_validity: bool = True):
-        """Materialize as a pyarrow.Table (see tpu/arrow_bridge.py)."""
+    def to_arrow(self, include_validity: bool = True, strings: str = "view"):
+        """Materialize as a pyarrow.Table (see tpu/arrow_bridge.py).
+
+        ``strings="view"`` (default): span columns are Arrow string_view
+        arrays referencing this batch's byte buffer zero-copy (the table
+        keeps the buffer alive; no value bytes are copied for clean
+        rows).  ``strings="copy"``: classic contiguous StringArrays."""
         from .arrow_bridge import batch_to_arrow
 
-        return batch_to_arrow(self, include_validity=include_validity)
+        return batch_to_arrow(
+            self, include_validity=include_validity, strings=strings
+        )
 
 
 def _bucket_batch(b: int, minimum: int = 64) -> int:

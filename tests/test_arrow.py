@@ -33,7 +33,11 @@ def test_to_arrow_types_and_values(parser):
     assert table.num_rows == 64
     assert table.column("BYTES:response.body.bytes").type == pa.int64()
     assert table.column("TIME.EPOCH:request.receive.time.epoch").type == pa.int64()
-    assert table.column("IP:connection.client.host").type == pa.string()
+    # Round-4 default: zero-copy string_view span columns; strings="copy"
+    # restores contiguous StringArrays.
+    assert table.column("IP:connection.client.host").type == pa.string_view()
+    copy_table = result.to_arrow(strings="copy")
+    assert copy_table.column("IP:connection.client.host").type == pa.string()
 
     valid = table.column("__valid__").to_pylist()
     assert valid[5] is False
@@ -130,10 +134,13 @@ def test_obj_column_typed_int():
 
 
 def test_span_column_does_not_pin_sibling_buffers(parser):
-    """Each StringArray must own only its column's bytes, not a view of
-    the batch-wide multi-column gather buffer."""
+    """COPY mode: each StringArray must own only its column's bytes, not
+    a view of the batch-wide multi-column gather buffer.  (View mode
+    intentionally shares the batch buffer across columns — that IS the
+    zero-copy contract.)"""
     lines = generate_combined_lines(64, seed=3)
-    table = parser.parse_batch(lines).to_arrow()
+    result = parser.parse_batch(lines)
+    table = result.to_arrow(strings="copy")
     col = table.column("IP:connection.client.host").combine_chunks()
     if hasattr(col, "chunks"):
         col = col.chunks[0]
@@ -141,6 +148,12 @@ def test_span_column_does_not_pin_sibling_buffers(parser):
     # The data buffer should be about this column's size (IPs: <16 B/row),
     # nowhere near the whole batch's span bytes.
     assert data_buf.size <= 64 * 16
+    # View mode: the variadic data buffer is exactly the batch buffer.
+    vcol = result.to_arrow().column(
+        "IP:connection.client.host").combine_chunks()
+    if hasattr(vcol, "chunks"):
+        vcol = vcol.chunks[0]
+    assert vcol.buffers()[-1].size == result.buf[:64].size
 
 
 class TestFixRowSplice:

@@ -1,0 +1,145 @@
+"""The zero-copy string_view Arrow materializer == the copy path.
+
+Round-4 delivery work: span columns default to Arrow string_view arrays
+referencing the batch buffer in place (native lp_build_views), with
+repaired/amp/override rows patched through side buffers.  Every column of
+the view table must value-match the contiguous-StringArray copy path, the
+schema must stay string_view even when a column falls back, and IPC must
+round-trip the view tables.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from logparser_tpu.tpu.batch import TpuBatchParser
+from logparser_tpu.tpu.arrow_bridge import (
+    table_from_ipc_bytes,
+    table_to_ipc_bytes,
+)
+from logparser_tpu.tools.demolog import HEADLINE_FIELDS, generate_combined_lines
+
+NGINX = (
+    '$remote_addr - $remote_user [$time_local] "$request" $status '
+    '$body_bytes_sent "$http_referer" "$http_user_agent"'
+)
+URI_FIELDS = [
+    "IP:connection.client.host",
+    "HTTP.PATH:request.firstline.uri.path",
+    "HTTP.QUERYSTRING:request.firstline.uri.query",
+    "STRING:request.status.last",
+]
+
+
+def _assert_tables_match(res):
+    tv = res.to_arrow()
+    tc = res.to_arrow(strings="copy")
+    for name in tc.column_names:
+        a = tv.column(name).to_pylist()
+        b = tc.column(name).to_pylist()
+        assert a == b, (name, [(x, y) for x, y in zip(a, b) if x != y][:3])
+    return tv
+
+
+def test_view_matches_copy_combined():
+    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    res = parser.parse_batch(
+        generate_combined_lines(512, seed=9, garbage_fraction=0.05)
+    )
+    tv = _assert_tables_match(res)
+    assert str(tv.column(HEADLINE_FIELDS[0]).type) == "string_view"
+
+
+def test_view_matches_copy_uri_fix_and_amp_rows():
+    """URI path/query columns carry fix (%-repair) and amp (?->&) rows —
+    the side-buffer patching must agree with the copy-path splice."""
+    parser = TpuBatchParser(NGINX, URI_FIELDS)
+    lines = [
+        '1.2.3.4 - - [10/Oct/2023:13:55:36 +0000] '
+        f'"GET {path} HTTP/1.1" 200 5 "-" "ua"'
+        for path in [
+            "/plain",
+            "/enc%41ded?q=1",          # good escape in path (decoded)
+            "/bad%zz?x=%zz",           # bad escapes (repair both modes)
+            "/q?a=1&b=2",              # amp row (leading ? -> &)
+            "/sp%20ace?y=%20z",
+            "/" + "x" * 50 + "?long=" + "v" * 40,   # >12-byte views
+            "/tiny?s=1",               # <=12-byte inline views
+        ]
+    ]
+    res = parser.parse_batch(lines * 5)
+    _assert_tables_match(res)
+
+
+def test_view_matches_copy_oracle_override_rows():
+    """Host-override (oracle) rows patch in as side-buffer strings."""
+    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    lines = generate_combined_lines(64, seed=12)
+    # A >18-digit byte count forces the oracle for the line; other
+    # columns of that row become overrides.
+    lines[7] = ('9.9.9.9 - frank [10/Oct/2023:13:55:36 -0700] '
+                '"GET /ov HTTP/1.0" 200 123456789012345678901 "-" "zz"')
+    res = parser.parse_batch(lines)
+    assert res.oracle_rows >= 1
+    tv = _assert_tables_match(res)
+    col = tv.column("IP:connection.client.host").to_pylist()
+    assert col[7] == "9.9.9.9"
+
+
+def test_view_table_ipc_roundtrip():
+    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    res = parser.parse_batch(generate_combined_lines(128, seed=4))
+    tv = res.to_arrow()
+    back = table_from_ipc_bytes(table_to_ipc_bytes(tv))
+    assert back.to_pylist() == tv.to_pylist()
+
+
+def test_view_non_utf8_falls_back_with_stable_type():
+    """Mojibake bytes route the line to the oracle; if a column still
+    bails to the per-row path its type must stay string_view."""
+    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    lines = generate_combined_lines(16, seed=5)
+    lines[3] = lines[3].replace("GET /", "GET /caf\xe9-")
+    res = parser.parse_batch(lines)
+    tv = _assert_tables_match(res)
+    for fid in HEADLINE_FIELDS:
+        if tv.column(fid).type != pa.int64():
+            assert str(tv.column(fid).type) == "string_view", fid
+
+
+def test_view_empty_and_all_null_columns():
+    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    res = parser.parse_batch(["garbage that matches nothing"] * 8)
+    tv = _assert_tables_match(res)
+    assert tv.num_rows == 8
+    res0 = parser.parse_batch([])
+    assert res0.to_arrow().num_rows == 0
+
+
+def test_native_view_encoding_against_pyarrow():
+    """lp_build_views' struct encoding (inline <=12 / prefix+offset) must
+    be exactly what pyarrow decodes — locked over adversarial widths."""
+    from logparser_tpu.native import build_views
+
+    rng = np.random.default_rng(3)
+    B, L = 257, 96
+    buf = rng.integers(33, 126, size=(B, L), dtype=np.uint8)
+    starts = rng.integers(0, 40, size=(1, B)).astype(np.int32)
+    # widths straddling the 12-byte inline boundary + nulls + empties
+    lens = rng.integers(-1, 30, size=(1, B)).astype(np.int32)
+    lens[0, :14] = np.arange(14) - 1  # -1, 0, 1, ..., 12 exactly
+    views = build_views(buf, starts, lens)
+    valid = lens[0] >= 0
+    arr = pa.Array.from_buffers(
+        pa.string_view(), B,
+        [pa.py_buffer(np.packbits(valid, bitorder="little")),
+         pa.py_buffer(np.ascontiguousarray(views[0])),
+         pa.py_buffer(buf.reshape(-1))],
+    )
+    arr.validate(full=True)
+    got = arr.to_pylist()
+    for i in range(B):
+        want = (
+            bytes(buf[i, starts[0, i]: starts[0, i] + lens[0, i]]).decode()
+            if valid[i] else None
+        )
+        assert got[i] == want, i
