@@ -131,6 +131,35 @@ def build_configs():
             [GeoIPCityDissector(city), GeoIPASNDissector(asn)],
         ))
 
+    def zonetext_lines(n):
+        # %Z-bearing corpus over the DEVICE zone vocabulary (round-3
+        # verdict item 4: oracle_fraction must be 0.0 here) — DST
+        # abbreviations, fixed zones and region ids, resolved through
+        # the tzdata transition tables on device.
+        zones = ["CET", "EST", "UTC", "Europe/Paris", "America/New_York",
+                 "Asia/Tokyo", "PST", "GMT", "Australia/Sydney", "CEST"]
+        out = []
+        for i, ln in enumerate(combined_lines(n, 48)):
+            try:
+                cut = ln.rindex(' "', 0, ln.rindex(' "'))
+                ln = ln[:cut]
+            except ValueError:
+                pass
+            out.append(_re.sub(
+                r"([+-]\d{4})\]", zones[i % len(zones)] + "]", ln, count=1
+            ))
+        return out
+
+    configs.append((
+        "strftime_zonetext",
+        '%h %l %u [%{%d/%b/%Y:%H:%M:%S %Z}t] "%r" %>s %b',
+        ["IP:connection.client.host",
+         "TIME.EPOCH:request.receive.time.epoch",
+         "TIME.HOUR:request.receive.time.hour_utc",
+         "STRING:request.status.last"],
+        zonetext_lines, None,
+    ))
+
     def mixed_lines(n):
         combined = combined_lines(n // 2, 46)
 

@@ -57,78 +57,36 @@ class LocaleData:
 
 _EN = LocaleData("en", MONTHS_SHORT, MONTHS_FULL, DAYS_SHORT, DAYS_FULL)
 
-LOCALES = {
-    # Locale.UK: English names, ISO week fields — the reference's default.
-    "en": _EN,
-    "en_gb": _EN,
-    "en_uk": _EN,
-    # Locale.US: same names, Sunday-first weeks with min 1 day.
-    "en_us": LocaleData("en_US", MONTHS_SHORT, MONTHS_FULL, DAYS_SHORT,
-                        DAYS_FULL, week_first_day=7, week_min_days=1),
-    "fr": LocaleData(
-        "fr",
-        ["janv.", "févr.", "mars", "avr.", "mai", "juin",
-         "juil.", "août", "sept.", "oct.", "nov.", "déc."],
-        ["janvier", "février", "mars", "avril", "mai", "juin", "juillet",
-         "août", "septembre", "octobre", "novembre", "décembre"],
-        ["lun.", "mar.", "mer.", "jeu.", "ven.", "sam.", "dim."],
-        ["lundi", "mardi", "mercredi", "jeudi", "vendredi", "samedi",
-         "dimanche"],
-    ),
-    "de": LocaleData(
-        "de",
-        ["Jan.", "Feb.", "März", "Apr.", "Mai", "Juni",
-         "Juli", "Aug.", "Sept.", "Okt.", "Nov.", "Dez."],
-        ["Januar", "Februar", "März", "April", "Mai", "Juni", "Juli",
-         "August", "September", "Oktober", "November", "Dezember"],
-        ["Mo.", "Di.", "Mi.", "Do.", "Fr.", "Sa.", "So."],
-        ["Montag", "Dienstag", "Mittwoch", "Donnerstag", "Freitag",
-         "Samstag", "Sonntag"],
-    ),
-    "es": LocaleData(
-        "es",
-        ["ene.", "feb.", "mar.", "abr.", "may.", "jun.",
-         "jul.", "ago.", "sept.", "oct.", "nov.", "dic."],
-        ["enero", "febrero", "marzo", "abril", "mayo", "junio", "julio",
-         "agosto", "septiembre", "octubre", "noviembre", "diciembre"],
-        ["lun.", "mar.", "mié.", "jue.", "vie.", "sáb.", "dom."],
-        ["lunes", "martes", "miércoles", "jueves", "viernes", "sábado",
-         "domingo"],
-        ampm=("a. m.", "p. m."),
-    ),
-    "it": LocaleData(
-        "it",
-        ["gen", "feb", "mar", "apr", "mag", "giu",
-         "lug", "ago", "set", "ott", "nov", "dic"],
-        ["gennaio", "febbraio", "marzo", "aprile", "maggio", "giugno",
-         "luglio", "agosto", "settembre", "ottobre", "novembre",
-         "dicembre"],
-        ["lun", "mar", "mer", "gio", "ven", "sab", "dom"],
-        ["lunedì", "martedì", "mercoledì", "giovedì", "venerdì", "sabato",
-         "domenica"],
-    ),
-    "nl": LocaleData(
-        "nl",
-        ["jan.", "feb.", "mrt.", "apr.", "mei", "jun.",
-         "jul.", "aug.", "sep.", "okt.", "nov.", "dec."],
-        ["januari", "februari", "maart", "april", "mei", "juni", "juli",
-         "augustus", "september", "oktober", "november", "december"],
-        ["ma", "di", "wo", "do", "vr", "za", "zo"],
-        ["maandag", "dinsdag", "woensdag", "donderdag", "vrijdag",
-         "zaterdag", "zondag"],
-    ),
-    "pt": LocaleData(
-        "pt",
-        ["jan.", "fev.", "mar.", "abr.", "mai.", "jun.",
-         "jul.", "ago.", "set.", "out.", "nov.", "dez."],
-        ["janeiro", "fevereiro", "março", "abril", "maio", "junho",
-         "julho", "agosto", "setembro", "outubro", "novembro", "dezembro"],
-        ["seg.", "ter.", "qua.", "qui.", "sex.", "sáb.", "dom."],
-        ["segunda-feira", "terça-feira", "quarta-feira", "quinta-feira",
-         "sexta-feira", "sábado", "domingo"],
-        week_first_day=7, week_min_days=1,
-    ),
-}
+
+def _load_locales() -> dict:
+    """LOCALES from the CLDR-generated data file (cldr_names.json,
+    produced by tools/cldr_import.py from Babel's vendored CLDR — adding
+    a locale is a one-line edit there plus a regeneration run).  The
+    checked-in JSON is the runtime source of truth; a missing file
+    degrades to the built-in English tables."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "cldr_names.json")
+    out = {"en": _EN, "en_gb": _EN, "en_uk": _EN}
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):  # pragma: no cover - packaging error
+        return out
+    for tag, d in data.items():
+        out[tag] = LocaleData(
+            tag,
+            list(d["months_short"]), list(d["months_full"]),
+            list(d["days_short"]), list(d["days_full"]),
+            ampm=tuple(d["ampm"]),
+            week_first_day=int(d["week_first_day"]),
+            week_min_days=int(d["week_min_days"]),
+        )
+    return out
+
+
+LOCALES = _load_locales()
 
 
 def week_based_fields(

@@ -1,0 +1,321 @@
+"""tzdata -> device transition tables for %Z zone TEXT.
+
+The reference parses zone names inline through java.time's tzdata
+(TimeStampDissector.java:404-424).  The rebuild's host oracle resolves
+them through ``zoneinfo`` (timelayout._parse_zonetext); this module makes
+the same resolution DEVICE-resident: each supported zone's TZif file is
+read directly (RFC 8536; own reader, like the repo's own MaxMind-DB
+reader) and compiled into a wall-clock transition table under the
+oracle's ``fold=0`` semantics, so a batch of timestamps looks its UTC
+offsets up with one ``jnp.searchsorted`` — the same O(log K) SIMD join
+as the GeoIP range tables (geoip/device.py).
+
+fold=0 wall-clock boundary rule (PEP 495, locked by differential tests
+against zoneinfo in tests/test_tztable.py): around a UTC transition at
+``t`` from offset ``o_prev`` to ``o_new``, ``utcoffset`` of a naive
+local time with fold=0 switches exactly at local ``t + max(o_prev,
+o_new)`` — ambiguous times (backward jump) take the PRE-transition
+offset, gap times (forward jump) extrapolate with it.
+
+Bounds (the ADR): local wall minutes span [epoch, epoch + 2^26 min ≈
+year 2097]; zones whose TZif footer carries an active DST rule are valid
+on device only up to their last explicit transition (tzdata precomputes
+those through ~2037) — later rows, pre-1970 rows, and zones outside the
+device vocabulary fall back to the host oracle, which resolves them
+through zoneinfo identically.  The vocabulary is capped at 63 zones so
+(zone_idx, minute) packs into one uint32 searchsorted key.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Minutes per zone segment of the packed uint32 key space: covers
+# 1970..2097; zone index must stay < 64.
+SPAN_MINUTES = 1 << 26
+
+# Canonical zones the curated abbreviation table maps into
+# (timelayout._ZONE_ABBREVIATIONS values).
+_ABBREVIATION_TARGETS = [
+    "UTC", "CET", "MET", "WET", "EET",
+    "EST5EDT", "CST6CDT", "MST7MDT", "PST8PDT",
+]
+
+# Default region-id vocabulary: the canonical targets plus widespread
+# region ids.  Total must stay under 64 (uint32 key packing).
+DEFAULT_DEVICE_ZONES = _ABBREVIATION_TARGETS + [
+    "Etc/UTC", "GMT",
+    "America/New_York", "America/Chicago", "America/Denver",
+    "America/Los_Angeles", "America/Phoenix", "America/Anchorage",
+    "America/Toronto", "America/Mexico_City", "America/Sao_Paulo",
+    "America/Argentina/Buenos_Aires",
+    "Europe/London", "Europe/Dublin", "Europe/Lisbon", "Europe/Paris",
+    "Europe/Berlin", "Europe/Madrid", "Europe/Rome", "Europe/Amsterdam",
+    "Europe/Brussels", "Europe/Zurich", "Europe/Vienna", "Europe/Prague",
+    "Europe/Warsaw", "Europe/Stockholm", "Europe/Oslo",
+    "Europe/Helsinki", "Europe/Athens",
+    "Europe/Bucharest", "Europe/Istanbul", "Europe/Moscow", "Europe/Kyiv",
+    "Asia/Tokyo", "Asia/Shanghai", "Asia/Hong_Kong", "Asia/Singapore",
+    "Asia/Seoul", "Asia/Taipei", "Asia/Kolkata", "Asia/Karachi",
+    "Asia/Dubai", "Asia/Jerusalem", "Asia/Bangkok", "Asia/Jakarta",
+    "Asia/Manila",
+    "Australia/Sydney", "Australia/Melbourne", "Australia/Perth",
+    "Pacific/Auckland",
+    "Africa/Cairo", "Africa/Johannesburg", "Africa/Lagos",
+    "Africa/Nairobi",
+]
+assert len(DEFAULT_DEVICE_ZONES) < 64, "uint32 key packing caps zones at 63"
+
+
+def _tzpath_candidates() -> List[str]:
+    try:
+        import zoneinfo
+
+        paths = list(zoneinfo.TZPATH)
+    except Exception:  # pragma: no cover - zoneinfo is stdlib
+        paths = []
+    return paths or ["/usr/share/zoneinfo"]
+
+
+def read_tzif(zone: str) -> Optional[Tuple[List[int], List[int], int, bool]]:
+    """Read a TZif file (RFC 8536): (utc transition times, offset after
+    each transition, offset before the first transition, footer has an
+    active DST rule).  None when the zone file is missing/unreadable."""
+    path = None
+    for base in _tzpath_candidates():
+        cand = os.path.join(base, *zone.split("/"))
+        if os.path.isfile(cand):
+            path = cand
+            break
+    if path is None:
+        return None
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+
+    def parse_block(buf: bytes, pos: int, time_size: int):
+        if buf[pos:pos + 4] != b"TZif":
+            raise ValueError("bad magic")
+        version = buf[pos + 4:pos + 5]
+        (isutcnt, isstdcnt, leapcnt, timecnt, typecnt, charcnt) = (
+            struct.unpack(">6I", buf[pos + 20:pos + 44])
+        )
+        p = pos + 44
+        fmt = ">%d%s" % (timecnt, "q" if time_size == 8 else "l")
+        times = list(struct.unpack(fmt, buf[p:p + timecnt * time_size]))
+        p += timecnt * time_size
+        type_idx = list(buf[p:p + timecnt])
+        p += timecnt
+        ttinfo = []
+        for _ in range(typecnt):
+            utoff, _isdst, _desig = struct.unpack(">lBB", buf[p:p + 6])
+            ttinfo.append(utoff)
+            p += 6
+        p += charcnt
+        p += leapcnt * (time_size + 4)
+        p += isstdcnt + isutcnt
+        return version, times, type_idx, ttinfo, p
+
+    try:
+        version, times, type_idx, ttinfo, end = parse_block(data, 0, 4)
+        footer = b""
+        if version >= b"2":
+            # 64-bit section follows the v1 block, then the TZ footer.
+            _, times, type_idx, ttinfo, end = parse_block(data, end, 8)
+            footer = data[end:]
+        if not ttinfo:
+            return None
+        offsets = [ttinfo[i] for i in type_idx]
+        base = ttinfo[0]
+        if times:
+            # RFC 8536: the offset before the first transition is the
+            # first standard-time type; type 0 is the common convention
+            # and matches zoneinfo's behavior for these files.
+            base = ttinfo[0]
+        # Footer like "\nCET-1CEST,M3.5.0,M10.5.0/3\n": a comma means an
+        # active DST rule governs times past the last transition.
+        footer_dst = b"," in footer
+        return times, offsets, base, footer_dst
+    except (ValueError, struct.error, IndexError):
+        return None
+
+
+def wall_table(
+    zone: str, span_minutes: int = SPAN_MINUTES
+) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:
+    """Wall-clock (fold=0) transition table for one zone:
+    (boundaries_min int32 ascending — first entry 0, offsets_s int32 per
+    segment, valid_until_min).  None when the zone cannot be represented
+    exactly (missing file, non-minute-aligned boundary, non-monotone
+    wall boundaries)."""
+    got = read_tzif(zone)
+    if got is None:
+        return None
+    times, offsets, base, footer_dst = got
+
+    bounds: List[int] = [0]
+    segs: List[int] = []
+    cur = base
+    # Offset in effect at wall minute 0 = offset at the last transition
+    # with wall boundary <= 0.
+    wall_bounds: List[Tuple[int, int]] = []  # (wall_seconds, offset_after)
+    prev = base
+    for t, off in zip(times, offsets):
+        if off == prev:
+            # No-op transition (e.g. the INT32_MAX sentinel some tzdata
+            # builds append): no wall-clock boundary.
+            continue
+        wall = t + max(prev, off)
+        wall_bounds.append((wall, off))
+        prev = off
+    base_off = base
+    for wall, off in wall_bounds:
+        if wall <= 0:
+            base_off = off
+    segs = [base_off]
+    last_bound = 0
+    for wall, off in wall_bounds:
+        if wall <= 0:
+            continue
+        if wall % 60 != 0:
+            return None  # sub-minute boundary: keep the zone on the host
+        m = wall // 60
+        if m >= span_minutes:
+            break
+        if m <= last_bound:
+            return None  # non-monotone wall clock: host-only
+        bounds.append(m)
+        segs.append(off)
+        last_bound = m
+    valid_until = span_minutes - 1
+    if footer_dst:
+        # Past the last explicit transition the footer's DST rule takes
+        # over; the device table is only exact up to that point.
+        valid_until = last_bound if last_bound > 0 else 0
+    return (
+        np.asarray(bounds, dtype=np.int64),
+        np.asarray(segs, dtype=np.int32),
+        valid_until,
+    )
+
+
+def _probe_offset(zone_obj, minute: int) -> Optional[int]:
+    """zoneinfo ground truth: utcoffset (fold=0) at a wall minute."""
+    import datetime as _dt
+
+    days, rem = divmod(minute, 1440)
+    try:
+        local = _dt.datetime(1970, 1, 1) + _dt.timedelta(
+            days=days, minutes=rem
+        )
+        delta = local.replace(tzinfo=zone_obj, fold=0).utcoffset()
+        return int(delta.total_seconds())
+    except (OverflowError, ValueError):
+        return None
+
+
+def _validate_against_zoneinfo(
+    zone: str, bounds: np.ndarray, segs: np.ndarray, valid_until: int
+) -> bool:
+    """Build-time self-check: every derived segment's offset must equal
+    zoneinfo's fold=0 utcoffset just at and just before each boundary —
+    so the device table can NEVER silently disagree with the oracle's
+    tzdata path (TimeLayout._parse_zonetext resolves through zoneinfo)."""
+    try:
+        from zoneinfo import ZoneInfo
+
+        zobj = ZoneInfo(zone)
+    except Exception:
+        return False
+    bl = bounds.tolist()
+    sl = segs.tolist()
+    for i, (b, off) in enumerate(zip(bl, sl)):
+        probe_at = b if b < valid_until else None
+        if probe_at is not None and _probe_offset(zobj, probe_at) != off:
+            return False
+        if i > 0:
+            before = bl[i] - 1
+            if before < valid_until and _probe_offset(
+                zobj, before
+            ) != sl[i - 1]:
+                return False
+    if valid_until > 0:
+        last = min(valid_until - 1, bl[-1] + 2 * 365 * 1440)
+        if last >= bl[-1] and _probe_offset(zobj, last) != sl[-1]:
+            return False
+    return True
+
+
+@dataclass
+class ZoneDeviceTable:
+    """Device arrays for a zone vocabulary: packed uint32 searchsorted
+    keys (zone_idx * SPAN + wall_minute) + per-segment offsets."""
+
+    zones: Tuple[str, ...]
+    keys: np.ndarray          # [T] uint32 ascending
+    offsets_s: np.ndarray     # [T] int32
+    valid_until: np.ndarray   # [Z] int32 (exclusive wall-minute bound)
+
+    @classmethod
+    def build(cls, zones: Sequence[str]) -> "ZoneDeviceTable":
+        if len(zones) >= 64:
+            raise ValueError("device zone vocabulary caps at 63 zones")
+        kept: List[str] = []
+        keys: List[int] = []
+        offs: List[int] = []
+        valid: List[int] = []
+        for zone in zones:
+            table = wall_table(zone)
+            if table is None:
+                continue
+            bounds, segs, valid_until = table
+            if not _validate_against_zoneinfo(zone, bounds, segs,
+                                              valid_until):
+                continue  # any disagreement: the zone stays host-only
+            z = len(kept)
+            kept.append(zone)
+            for b, o in zip(bounds.tolist(), segs.tolist()):
+                keys.append(z * SPAN_MINUTES + b)
+                offs.append(o)
+            valid.append(valid_until)
+        return cls(
+            tuple(kept),
+            np.asarray(keys, dtype=np.uint32),
+            np.asarray(offs, dtype=np.int32),
+            np.asarray(valid, dtype=np.int32),
+        )
+
+    def lookup(self, zone_idx, minutes):
+        """[B] zone indices + [B] wall minutes -> (offset_s, ok).
+        Jittable; rows with ok=False (outside a zone's exact window)
+        must route to the oracle."""
+        import jax.numpy as jnp
+
+        m = jnp.clip(minutes, 0, SPAN_MINUTES - 1).astype(jnp.uint32)
+        key = zone_idx.astype(jnp.uint32) * np.uint32(SPAN_MINUTES) + m
+        keys = jnp.asarray(self.keys)
+        pos = jnp.searchsorted(keys, key, side="right")
+        idx = jnp.clip(pos - 1, 0, max(len(self.keys) - 1, 0))
+        off = jnp.asarray(self.offsets_s)[idx]
+        ok = (
+            (minutes >= 0)
+            & (minutes < jnp.asarray(self.valid_until)[zone_idx])
+        )
+        return off, ok
+
+
+_TABLE_CACHE: Dict[Tuple[str, ...], ZoneDeviceTable] = {}
+
+
+def default_zone_table() -> ZoneDeviceTable:
+    key = tuple(DEFAULT_DEVICE_ZONES)
+    got = _TABLE_CACHE.get(key)
+    if got is None:
+        got = _TABLE_CACHE[key] = ZoneDeviceTable.build(key)
+    return got

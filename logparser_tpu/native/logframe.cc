@@ -23,6 +23,7 @@
 #include <functional>
 #include <mutex>
 #include <thread>
+#include <unistd.h>
 #include <vector>
 
 // Persistent worker pool: per-call std::thread spawns (~50us each) used
@@ -99,16 +100,21 @@ void lp_run(int64_t n, int32_t threads,
     return;
   }
   static Pool* pool = nullptr;
+  static pid_t pool_pid = 0;
   static std::mutex create_m;
   {
     std::lock_guard<std::mutex> lk(create_m);
-    if (pool == nullptr) {
+    if (pool == nullptr || pool_pid != getpid()) {
       // Size by the hardware, not the first caller's thread count — the
       // pool is process-wide and a small first request must not cap
-      // every later call's parallelism.
+      // every later call's parallelism.  A fork() child inherits the
+      // pointer but none of the worker threads (waiting on it would
+      // deadlock) — detect by pid and build a fresh pool; the stale
+      // object is deliberately leaked (its threads do not exist here).
       unsigned hw = std::thread::hardware_concurrency();
       int n = std::max<int>(threads, hw ? static_cast<int>(hw) : threads);
       pool = new Pool(n);
+      pool_pid = getpid();
     }
   }
   int64_t chunk = std::max<int64_t>(512, n / (threads * 4));

@@ -1,0 +1,126 @@
+"""CLDR -> locale name tables for the timestamp engine.
+
+The reference resolves localized month/day names and week rules through
+``java.util.Locale`` — JDK 9+ defaults to CLDR data
+(TimeStampDissector.java:73-78 setLocale; WeekFields.of(locale)
+:455-459).  This importer generates the same tables from CLDR (via
+Babel's vendored CLDR distribution) into a checked-in JSON data file —
+``dissectors/cldr_names.json`` — that ``timelayout.LOCALES`` loads at
+import time.  Adding a locale is a one-line edit to LOCALE_TAGS below
+plus a regeneration run::
+
+    python -m logparser_tpu.tools.cldr_import        # rewrites the JSON
+
+The JSON is the source of truth at runtime (no Babel dependency);
+tests/test_cldr_locales.py regenerates from Babel when it is available
+and asserts the checked-in file has not drifted.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+# Tags to generate: tag -> (names source, week-data source).  Week data
+# in CLDR is keyed by TERRITORY, so the week source always carries one
+# (Babel does not resolve likely subtags; a bare "fr" would fall to the
+# world default's min_days=1 where WeekFields.of(fr) gives 4).  The
+# engine's bare "en" is the reference's Locale.UK default (English
+# names, ISO weeks); its NAMES come from root "en" (Sep/AM), its weeks
+# from en_GB.
+LOCALE_TAGS: Dict[str, Tuple[str, str]] = {
+    "en": ("en", "en_GB"),
+    "en_gb": ("en", "en_GB"),
+    "en_uk": ("en", "en_GB"),
+    "en_us": ("en", "en_US"),
+    "fr": ("fr", "fr_FR"), "de": ("de", "de_DE"), "es": ("es", "es_ES"),
+    "it": ("it", "it_IT"), "nl": ("nl", "nl_NL"),
+    "pt": ("pt", "pt_BR"), "pt_pt": ("pt_PT", "pt_PT"),
+    "da": ("da", "da_DK"), "sv": ("sv", "sv_SE"), "nb": ("nb", "nb_NO"),
+    "fi": ("fi", "fi_FI"), "is": ("is", "is_IS"),
+    "pl": ("pl", "pl_PL"), "cs": ("cs", "cs_CZ"), "sk": ("sk", "sk_SK"),
+    "hu": ("hu", "hu_HU"), "ro": ("ro", "ro_RO"), "tr": ("tr", "tr_TR"),
+    "ru": ("ru", "ru_RU"), "uk": ("uk", "uk_UA"), "el": ("el", "el_GR"),
+    "bg": ("bg", "bg_BG"), "ca": ("ca", "ca_ES"), "hr": ("hr", "hr_HR"),
+    "sl": ("sl", "sl_SI"), "et": ("et", "et_EE"), "lv": ("lv", "lv_LV"),
+    "lt": ("lt", "lt_LT"), "id": ("id", "id_ID"), "vi": ("vi", "vi_VN"),
+    "ms": ("ms", "ms_MY"), "ja": ("ja", "ja_JP"), "ko": ("ko", "ko_KR"),
+    "zh": ("zh", "zh_CN"), "zh_tw": ("zh_Hant_TW", "zh_Hant_TW"),
+}
+
+# JDK-flavored pins where the vendored CLDR vintage differs from the
+# name forms Java's formatter resolves (and the engine's locked tests
+# assert): dotted Spanish/Dutch abbreviations, plain-space Spanish
+# day-period spelling, uppercase AM/PM for nl.  Everything else comes
+# straight from CLDR.
+OVERRIDES: Dict[str, Dict] = {
+    "es": {
+        "months_short": ["ene.", "feb.", "mar.", "abr.", "may.", "jun.",
+                         "jul.", "ago.", "sept.", "oct.", "nov.", "dic."],
+        "days_short": ["lun.", "mar.", "mié.", "jue.", "vie.", "sáb.",
+                       "dom."],
+        "ampm": ["a. m.", "p. m."],
+    },
+    "nl": {
+        "months_short": ["jan.", "feb.", "mrt.", "apr.", "mei", "jun.",
+                         "jul.", "aug.", "sep.", "okt.", "nov.", "dec."],
+        "ampm": ["AM", "PM"],
+    },
+}
+
+DATA_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "dissectors", "cldr_names.json",
+)
+
+
+def generate_locale(tag: str, names_src: str, weeks_src: str) -> Dict:
+    """One locale's tables from Babel's CLDR data (+ JDK pins)."""
+    from babel import Locale
+
+    loc = Locale.parse(names_src)
+    weeks = Locale.parse(weeks_src)
+    months = loc.months["format"]
+    days = loc.days["format"]
+    periods = loc.day_periods["format"]["abbreviated"]
+
+    def month_list(style: str) -> List[str]:
+        return [str(months[style][i]) for i in range(1, 13)]
+
+    def day_list(style: str) -> List[str]:
+        # CLDR day indices: 0=Monday .. 6=Sunday (Babel numbering).
+        return [str(days[style][i]) for i in range(7)]
+
+    out = {
+        "source": names_src,
+        "weeks_source": weeks_src,
+        "months_short": month_list("abbreviated"),
+        "months_full": month_list("wide"),
+        "days_short": day_list("abbreviated"),
+        "days_full": day_list("wide"),
+        "ampm": [str(periods["am"]), str(periods["pm"])],
+        # Babel: 0=Monday..6=Sunday; the engine uses ISO 1=Monday..7=Sunday.
+        "week_first_day": int(weeks.first_week_day) + 1,
+        "week_min_days": int(weeks.min_week_days),
+    }
+    out.update(OVERRIDES.get(tag, {}))
+    return out
+
+
+def generate_all() -> Dict[str, Dict]:
+    return {
+        tag: generate_locale(tag, names_src, weeks_src)
+        for tag, (names_src, weeks_src) in sorted(LOCALE_TAGS.items())
+    }
+
+
+def main() -> None:
+    data = generate_all()
+    with open(DATA_PATH, "w", encoding="utf-8") as f:
+        json.dump(data, f, ensure_ascii=False, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {len(data)} locales to {DATA_PATH}")
+
+
+if __name__ == "__main__":
+    main()

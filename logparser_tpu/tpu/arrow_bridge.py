@@ -22,6 +22,11 @@ import numpy as np
 if TYPE_CHECKING:  # pragma: no cover
     from .batch import BatchResult
 
+# Sentinel from the batched view prefetch: "this column was tried and
+# cannot take the view path" — _column_to_arrow goes straight to the
+# copy fallback instead of rebuilding the column only to fail again.
+_VIEW_FAILED = object()
+
 
 
 def _spans_to_string_array(
@@ -559,6 +564,9 @@ def _span_view_arrays(result: "BatchResult", field_ids) -> Dict[str, Any]:
             pre_cache[fid] = _view_column_inputs(
                 result, fid, buf, base=(valid_k[k], starts_k[k], lens_k[k])
             )
+    for fid in span_fids:
+        if pre_cache[fid] is None:
+            out[fid] = _VIEW_FAILED  # copy path; don't rebuild per column
     pres = [
         (fid, pre_cache[fid]) for fid in span_fids
         if pre_cache[fid] is not None
@@ -570,8 +578,7 @@ def _span_view_arrays(result: "BatchResult", field_ids) -> Dict[str, Any]:
     views = build_views(buf, starts, lens)
     for k, (fid, (st, _lm, state)) in enumerate(pres):
         arr = _assemble_view_array(result, buf, st, views[k], state)
-        if arr is not None:
-            out[fid] = arr
+        out[fid] = arr if arr is not None else _VIEW_FAILED
     return out
 
 
@@ -591,11 +598,16 @@ def _column_to_arrow(
             # Older pyarrow without the BinaryView type (added in 14,
             # buildable from buffers in 16): classic StringArrays.
             return _column_to_arrow(result, field_id, flat, strings="copy")
-        arr = prebuilt if prebuilt is not None else _spans_to_view_array(
-            result, field_id
-        )
-        if arr is not None:
-            return arr
+        if prebuilt is None:
+            # Standalone call (no batched prefetch attempted).
+            prebuilt = _spans_to_view_array(result, field_id)
+        elif prebuilt is _VIEW_FAILED:
+            # The batched pass already tried and failed this column
+            # (non-str override / non-UTF-8) — don't rebuild it just to
+            # fail the same way.
+            prebuilt = None
+        if prebuilt is not None:
+            return prebuilt
         # Copy-path fallback (non-str overrides / oversized buffer /
         # non-UTF-8): cast string results to string_view so the column
         # type stays stable across batches.

@@ -57,8 +57,11 @@ class _DevItem:
     field: str = ""  # num: layout field; name: "month" | "dayofweek"
     text: bytes = b""            # lit
     table: Tuple[bytes, ...] = ()  # name/ampm/zone: per-entry bytes
-    # zone only: per-entry UTC offset seconds (parallel to `table`).
-    offsets_s: Tuple[int, ...] = ()
+    # zone only: per-entry index into the layout's ZoneDeviceTable
+    # (parallel to `table`) + whether the entry matches case-insensitively
+    # (abbreviations do, region ids are exact like zoneinfo's file paths).
+    zone_idx: Tuple[int, ...] = ()
+    fold_flags: Tuple[bool, ...] = ()
 
 
 @dataclass
@@ -77,6 +80,9 @@ class DeviceTimeLayout:
     default_offset_seconds: int    # applied when tail == ""
     locale: Optional[LocaleData] = None
     min_prefix: int = 0            # lower bound of the pre-tail width
+    # zonetext layouts: the tzdata transition tables the matched zone
+    # index resolves through (dissectors/tztable.py).
+    zone_table: Optional[object] = None
 
 
 # Numeric layout fields the device models, with their post-parse range
@@ -155,32 +161,46 @@ def compile_layout_for_device(layout: TimeLayout) -> Optional[DeviceTimeLayout]:
                 return None  # variable width is only decodable at the tail
             tail = kind
         elif kind == "zonetext":
-            # %Z zone TEXT: the device models the fixed-offset
-            # ABBREVIATIONS (UTC/GMT/UT/Z today), derived from the host's
-            # own tables so they cannot drift; rows stamped with DST
-            # zones (CET, EST, region ids — incl. case-sensitive ids
-            # like Etc/UTC, since abbreviation matching is
-            # case-INsensitive on the host but region ids are not) fail
-            # device validation and take the oracle, which resolves them
-            # through tzdata.  The host consumes the zone token GREEDILY
-            # over [A-Za-z0-9_/+-], so the match also checks the byte
-            # AFTER the entry is outside that class ("UTCX" must not
+            # %Z zone TEXT, resolved on device through tzdata transition
+            # tables (dissectors/tztable.py; the TPU analogue of
+            # TimeStampDissector.java:404-424's java.time zone
+            # resolution): abbreviations match case-insensitively and map
+            # through the host's own _ZONE_ABBREVIATIONS table, region
+            # ids match byte-exactly (zoneinfo paths are case-sensitive).
+            # Rows with zones outside the device vocabulary — or wall
+            # times outside a zone's exact window — fail device
+            # validation and take the oracle, which resolves identically
+            # through zoneinfo.  The host consumes the zone token
+            # GREEDILY over [A-Za-z0-9_/+-], so the match also checks the
+            # byte AFTER the entry is outside that class ("UTCX" must not
             # device-accept as UTC) — the +1 width gives the peek byte.
             from ..dissectors.timelayout import _ZONE_ABBREVIATIONS
+            from ..dissectors.tztable import default_zone_table
 
-            abbrevs = sorted(
-                (k for k, v in _ZONE_ABBREVIATIONS.items()
-                 if v in _FIXED_OFFSET_ZONES),
-                key=len, reverse=True,
-            )
-            table = tuple(a.encode() for a in abbrevs)
-            offsets_s = tuple(
-                _FIXED_OFFSET_ZONES[_ZONE_ABBREVIATIONS[a]] for a in abbrevs
-            )
+            ztab = default_zone_table()
+            zone_of = {name: i for i, name in enumerate(ztab.zones)}
+            entries: List[Tuple[bytes, int, bool]] = []
+            # Abbreviations first: the host checks its abbreviation table
+            # before treating the token as a region id.
+            for abbr, target in _ZONE_ABBREVIATIONS.items():
+                zi = zone_of.get(target)
+                if zi is not None:
+                    entries.append((abbr.encode(), zi, True))
+            for name, zi in zone_of.items():
+                entries.append((name.encode(), zi, False))
+            if not entries:
+                # No usable tzdata on this host (empty vocabulary):
+                # %Z layouts stay host-only instead of crashing compile.
+                return None
+            table = tuple(e[0] for e in entries)
             close_segment()
             segments.append((
-                _DevItem("zone", 0, max(len(t) for t in table) + 1,
-                         field="zone", table=table, offsets_s=offsets_s),
+                _DevItem(
+                    "zone", 0, max(len(t) for t in table) + 1,
+                    field="zone", table=table,
+                    zone_idx=tuple(e[1] for e in entries),
+                    fold_flags=tuple(e[2] for e in entries),
+                ),
             ))
             seg_widths.append(-1)
             min_prefix += min(len(t) for t in table)
@@ -208,9 +228,14 @@ def compile_layout_for_device(layout: TimeLayout) -> Optional[DeviceTimeLayout]:
     if not ((("year" in fields) or ("year2" in fields)) and has_month
             and "day" in fields):
         return None  # incomplete date resolves through host paths
+    zone_table = None
+    if has_zone_item:
+        from ..dissectors.tztable import default_zone_table
+
+        zone_table = default_zone_table()
     return DeviceTimeLayout(
         tuple(segments), tuple(seg_widths), tail, default_offset,
-        locale=loc, min_prefix=min_prefix,
+        locale=loc, min_prefix=min_prefix, zone_table=zone_table,
     )
 
 
@@ -260,10 +285,10 @@ def parse_device_timestamp(
 
         return digits
 
-    def match_entry(b, lower, off: int, entry: bytes):
+    def match_entry(b, lower, off: int, entry: bytes, fold: bool = True):
         m = None
         for i, byte in enumerate(entry):
-            folded = _fold_byte(byte)
+            folded = _fold_byte(byte) if fold else None
             if folded is not None:
                 part = lower[:, off + i] == np.uint8(folded)
             else:
@@ -314,7 +339,11 @@ def parse_device_timestamp(
                 matched = jnp.zeros(B, dtype=bool)
                 for idx in reversed(range(len(it.table))):
                     entry = it.table[idx]
-                    m = match_entry(b, lower, it.offset, entry) & (
+                    fold = (
+                        it.fold_flags[idx]
+                        if it.kind == "zone" and it.fold_flags else True
+                    )
+                    m = match_entry(b, lower, it.offset, entry, fold) & (
                         cursor + len(entry) <= end
                     )
                     if it.kind == "zone":
@@ -339,14 +368,15 @@ def parse_device_timestamp(
                     matched = matched | m
                 ok = ok & matched
                 if it.kind == "zone":
-                    # The matched entry supplies the offset (all fixed
-                    # zones; per-entry so the table can never silently
-                    # disagree with a default).
-                    zoff = zeros
-                    for idx, secs in enumerate(it.offsets_s):
-                        if secs:
-                            zoff = jnp.where(value == idx, secs, zoff)
-                    comp["offset_seconds"] = zoff
+                    # The matched entry maps to its ZoneDeviceTable index;
+                    # the offset resolves AFTER the date/time fields are
+                    # known (the transition lookup needs the wall clock).
+                    zsel = zeros
+                    for idx in reversed(range(len(it.zone_idx))):
+                        zi = it.zone_idx[idx]
+                        if zi:
+                            zsel = jnp.where(value == idx, zi, zsel)
+                    comp["zone_idx"] = zsel
                 elif it.kind == "ampm":
                     comp["ampm"] = value
                 elif it.field == "month":
@@ -424,6 +454,24 @@ def parse_device_timestamp(
     minute = comp.get("minute", zeros)
     second = comp.get("second", zeros)
     milli = comp.get("milli", zeros)
+
+    if dl.zone_table is not None and "zone_idx" in comp:
+        # Zone-text offset: wall minutes since epoch (days-from-civil,
+        # proleptic Gregorian) through the tzdata transition tables.
+        # Years outside [1970, 2096] leave the tables' exact window (and
+        # would overflow the int32 minute math) — those rows take the
+        # oracle, like every other zone-window miss.
+        yy = year - (month <= 2)
+        era = yy // 400
+        yoe = yy - era * 400
+        doy = (153 * (month + jnp.where(month > 2, -3, 9)) + 2) // 5 + day - 1
+        doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+        days = era * 146097 + doe - 719468
+        in_years = (year >= 1970) & (year <= 2096)
+        minutes = jnp.where(in_years, days * 1440 + hour * 60 + minute, -1)
+        zoff, zok = dl.zone_table.lookup(comp["zone_idx"], minutes)
+        comp["offset_seconds"] = zoff
+        ok = ok & zok & in_years
 
     # Range checks = what datetime() construction enforces on the host.
     leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)

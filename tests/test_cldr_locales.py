@@ -1,0 +1,82 @@
+"""CLDR-generated locale tables (round-4 verdict item 5).
+
+timelayout.LOCALES is generated output (dissectors/cldr_names.json,
+produced by tools/cldr_import.py from Babel's vendored CLDR).  These
+tests pin: the JSON has not drifted from its generator, the historical
+8 locales kept their exact (test-locked) values, the set grew to >= 28
+locales, and new locales parse device-resident round trips.
+"""
+import json
+import os
+
+import pytest
+
+from logparser_tpu.dissectors.timelayout import LOCALES, get_locale
+from logparser_tpu.tools.cldr_import import DATA_PATH, LOCALE_TAGS
+
+
+def test_locales_are_generated_output():
+    with open(DATA_PATH, encoding="utf-8") as f:
+        data = json.load(f)
+    assert set(LOCALE_TAGS) == set(data)
+    # The runtime table is built from the file.
+    for tag in data:
+        assert tag in LOCALES, tag
+        assert list(LOCALES[tag].months_short) == data[tag]["months_short"]
+
+
+def test_regeneration_matches_checked_in_file():
+    """Babel regeneration == the committed JSON (drift guard).  Skipped
+    when Babel is unavailable (the runtime itself never needs it)."""
+    pytest.importorskip("babel")
+    from logparser_tpu.tools.cldr_import import generate_all
+
+    with open(DATA_PATH, encoding="utf-8") as f:
+        committed = json.load(f)
+    assert generate_all() == committed
+
+
+def test_locale_count_and_legacy_values():
+    assert len(LOCALE_TAGS) >= 28  # 8 historical + >= 20 new
+    # The historical 8 keep their locked values (spot pins).
+    assert LOCALES["fr"].months_short[1] == "févr."
+    assert LOCALES["de"].months_full[2] == "März"
+    assert LOCALES["es"].ampm == ("a. m.", "p. m.")
+    assert LOCALES["nl"].months_short[2] == "mrt."
+    assert LOCALES["pt"].week_first_day == 7
+    assert LOCALES["en"].months_short[8] == "Sep"
+    assert LOCALES["en_us"].week_min_days == 1
+    assert LOCALES["it"].months_short[0] == "gen"
+
+
+@pytest.mark.parametrize("tag,month_probe", [
+    ("pl", None), ("cs", None), ("tr", None), ("ru", None),
+    ("ja", None), ("sv", None), ("fi", None), ("ro", None),
+])
+def test_new_locales_parse_device_resident(tag, month_probe):
+    """A corpus written with a NEW locale's month names parses on device
+    and matches the oracle."""
+    from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+    loc = get_locale(tag)
+    fmt = '%h %l %u [%{%d/%b/%Y:%H:%M:%S %z}t] "%r" %>s %b'
+    fields = ["TIME.EPOCH:request.receive.time.epoch",
+              "TIME.MONTHNAME:request.receive.time.monthname"]
+    parser = TpuBatchParser(fmt, fields, locale=tag)
+    lines = [
+        f'10.0.0.{m} - - [0{(m % 9) + 1}/{loc.months_short[m]}/2026:'
+        f'10:0{m % 10}:00 +0100] "GET /{m} HTTP/1.1" 200 5'
+        for m in range(12)
+    ]
+    res = parser.parse_batch(lines)
+    assert res.bad_lines == 0
+    assert res.oracle_rows == 0, f"{tag} corpus fell off the device path"
+    got = res.to_pylist(fields[1])
+    for m in range(12):
+        want = parser.oracle.parse(
+            lines[m], _CollectingRecord()).values[fields[1]]
+        assert got[m] == want == loc.months_full[m], (tag, m)
+
+
+def test_unknown_locale_falls_back_to_english():
+    assert get_locale("xx_notreal").months_short[0] == "Jan"

@@ -396,10 +396,11 @@ def test_one_shot_window_clamped_to_narrow_buffer():
     assert int(np.asarray(comp2["year"])[0]) == 2026
 
 
-def test_zonetext_utc_family_device_resident():
-    """%Z zone TEXT: the UTC-family abbreviations parse on device with a
-    0 offset; DST zones / region ids / greedy-longer tokens fail device
-    validation (the oracle resolves them through tzdata)."""
+def test_zonetext_device_resident():
+    """%Z zone TEXT: abbreviations (case-insensitive) AND region ids
+    (exact case) parse on device through the tzdata transition tables
+    (round 4); greedy-longer tokens and unknown zones fail device
+    validation (the oracle rejects them identically)."""
     layout = compile_strftime("%d/%b/%Y %H:%M:%S %Z")
     dl = compile_layout_for_device(layout)
     assert dl is not None
@@ -409,18 +410,17 @@ def test_zonetext_utc_family_device_resident():
         "07/Mar/2026 10:00:00 utc",      # host is case-insensitive here
         "07/Mar/2026 10:00:00 Z",
         "07/Mar/2026 10:00:00 UT",
-        "07/Mar/2026 10:00:00 CET",      # DST zone: host-only
+        "07/Mar/2026 10:00:00 CET",      # DST zone via transition table
         "07/Mar/2026 10:00:00 Europe/Amsterdam",
+        "07/Jul/2026 10:00:00 CET",      # summer: CEST offset applies
         "07/Mar/2026 10:00:00 UTCX",     # greedy token: unknown zone
         "07/Mar/2026 10:00:00 UTC2",     # greedy token: unknown zone
+        "07/Mar/2026 10:00:00 europe/amsterdam",  # region ids: exact case
     ]
     comp, ok = run_device(dl, samples)
-    assert ok.tolist() == [True] * 5 + [False] * 4
+    assert ok.tolist() == [True] * 8 + [False] * 3
     epochs = timefields.derive(comp, "epoch")
-    for i in range(5):
+    for i in range(8):
         want = layout.parse(samples[i])
         assert epochs[i] == want.epoch_millis, samples[i]
-        assert comp["offset_seconds"][i] == 0
-    # The host also accepts the rejected rows (oracle fallback is sound).
-    for s in samples[5:7]:
-        layout.parse(s)
+        assert comp["offset_seconds"][i] == want.offset_seconds, samples[i]
