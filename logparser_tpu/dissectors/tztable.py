@@ -132,12 +132,11 @@ def read_tzif(zone: str) -> Optional[Tuple[List[int], List[int], int, bool]]:
         if not ttinfo:
             return None
         offsets = [ttinfo[i] for i in type_idx]
+        # Offset before the first transition: type 0.  (RFC 8536 says the
+        # first *standard-time* type; type 0 is the near-universal file
+        # convention, and _validate_against_zoneinfo drops any zone where
+        # the two disagree, so the simpler rule is safe here.)
         base = ttinfo[0]
-        if times:
-            # RFC 8536: the offset before the first transition is the
-            # first standard-time type; type 0 is the common convention
-            # and matches zoneinfo's behavior for these files.
-            base = ttinfo[0]
         # Footer like "\nCET-1CEST,M3.5.0,M10.5.0/3\n": a comma means an
         # active DST rule governs times past the last transition.
         footer_dst = b"," in footer

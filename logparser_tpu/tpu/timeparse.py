@@ -429,10 +429,11 @@ def parse_device_timestamp(
             )
     else:
         ok = ok & (tail_w == 0)
-        if "offset_seconds" not in comp:  # a zone item may have set it
-            comp["offset_seconds"] = jnp.full(
-                B, dl.default_offset_seconds, dtype=jnp.int32
-            )
+        # Layout default; a zone-text layout overwrites this below once
+        # the zone_table block resolves comp["zone_idx"] to an offset.
+        comp["offset_seconds"] = jnp.full(
+            B, dl.default_offset_seconds, dtype=jnp.int32
+        )
 
     # ---- resolve components (mirrors TimeLayout._resolve) -------------
     year = comp.get("year")

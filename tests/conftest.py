@@ -19,3 +19,47 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--shard",
+        default=None,
+        metavar="i/N",
+        help="Run only shard i (0-based) of N: whole test modules are "
+        "assigned to shards by deterministic greedy bin-packing over the "
+        "full collection (identical in every shard for a given tree; "
+        "membership may shift when tests are added), so one CI timeout "
+        "cannot kill the whole slow tier and per-module jit/compile "
+        "fixtures are paid in exactly one shard.",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    spec = config.getoption("--shard")
+    if not spec:
+        return
+    idx, total = (int(x) for x in spec.split("/"))
+    assert 0 <= idx < total, f"--shard {spec}: need 0 <= i < N"
+    # Deterministic greedy bin-packing over modules: every shard collects
+    # the FULL suite, so every shard computes the identical assignment —
+    # heaviest module first onto the lightest bin.  Weight = test count,
+    # slow-marked tests x8 (the differential/fuzz suites dominate wall
+    # time far beyond their headcount).
+    weights: dict = {}
+    for item in items:
+        module = os.path.basename(str(item.fspath))
+        w = 8 if item.get_closest_marker("slow") else 1
+        weights[module] = weights.get(module, 0) + w
+    bins = [0] * total
+    assign = {}
+    for module in sorted(weights, key=lambda m: (-weights[m], m)):
+        target = min(range(total), key=lambda b: (bins[b], b))
+        assign[module] = target
+        bins[target] += weights[module]
+    keep, drop = [], []
+    for item in items:
+        module = os.path.basename(str(item.fspath))
+        (keep if assign[module] == idx else drop).append(item)
+    items[:] = keep
+    config.hook.pytest_deselected(items=drop)

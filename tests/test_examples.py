@@ -71,6 +71,27 @@ def test_streaming_beam():
     assert len(parsed) == 300
 
 
+def test_streaming_avro():
+    """Avro-record variants (reference: TestParserDoFnAvro.java /
+    TestParserMapFunctionAvroClass.java): the nested Click record built
+    through @field setters, round-tripped through Avro binary encoding."""
+    from examples import streaming_avro
+
+    click = streaming_avro.main()
+    assert click["timestamp"] == 1640424245000
+    assert click["device"] == {"screenWidth": 1280, "screenHeight": 1024}
+    assert click["visitor"]["ip"] == "80.100.47.45"
+    assert click["visitor"]["isp"]["ispName"] == "Basjes ISP"
+    geo = click["visitor"]["geoLocation"]
+    assert geo["cityName"] == "Amstelveen"
+    assert geo["countryIso"] == "NL"
+    assert geo["locationLatitude"] == 52.5
+    # The binary bytes decode back to the identical record (the codec is
+    # spec-subset Avro: zigzag varints + length-prefixed utf8 + LE doubles).
+    raw = streaming_avro.encode_click(click)
+    assert streaming_avro.decode_click(raw) == click
+
+
 def test_storm_bolt():
     from examples import storm_bolt
 
