@@ -64,6 +64,8 @@ CLICK_SCHEMA: Dict[str, Any] = {
                         {"name": "continentCode", "type": "string"},
                         {"name": "countryName", "type": "string"},
                         {"name": "countryIso", "type": "string"},
+                        {"name": "subdivisionName", "type": "string"},
+                        {"name": "subdivisionIso", "type": "string"},
                         {"name": "cityName", "type": "string"},
                         {"name": "postalCode", "type": "string"},
                         {"name": "locationLatitude", "type": "double"},
@@ -168,6 +170,7 @@ class ClickSetter:
                 "geoLocation": {
                     "continentName": "", "continentCode": "",
                     "countryName": "", "countryIso": "",
+                    "subdivisionName": "", "subdivisionIso": "",
                     "cityName": "", "postalCode": "",
                     "locationLatitude": 0.0, "locationLongitude": 0.0,
                 },
@@ -225,6 +228,14 @@ class ClickSetter:
     @field("STRING:connection.client.host.country.iso")
     def set_country_iso(self, value: str):
         self.click["visitor"]["geoLocation"]["countryIso"] = value
+
+    @field("STRING:connection.client.host.subdivision.name")
+    def set_subdivision_name(self, value: str):
+        self.click["visitor"]["geoLocation"]["subdivisionName"] = value
+
+    @field("STRING:connection.client.host.subdivision.iso")
+    def set_subdivision_iso(self, value: str):
+        self.click["visitor"]["geoLocation"]["subdivisionIso"] = value
 
     @field("STRING:connection.client.host.city.name")
     def set_city_name(self, value: str):

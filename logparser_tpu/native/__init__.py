@@ -92,6 +92,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.lp_copy_spans.argtypes = [u8p, i64p, u8p, i64p,
                                       ctypes.c_int64, ctypes.c_int32]
         lib.lp_copy_spans.restype = None
+        if hasattr(lib, "lp_scatter_spans"):
+            lib.lp_scatter_spans.argtypes = [
+                u8p, i64p, i64p, u8p, i64p, ctypes.c_int64, ctypes.c_int32,
+            ]
+            lib.lp_scatter_spans.restype = None
         if hasattr(lib, "lp_build_views"):
             # Older cached .so builds predate the view materializer.
             lib.lp_build_views.argtypes = [
@@ -127,8 +132,11 @@ def _u8(arr: np.ndarray):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
+_DEFAULT_THREADS = min(8, os.cpu_count() or 1)
+
+
 def _default_threads() -> int:
-    return min(8, os.cpu_count() or 1)
+    return _DEFAULT_THREADS
 
 
 def _bucket(max_len: int, min_bucket: int, cap: int) -> int:
@@ -303,6 +311,56 @@ def copy_spans(
         total, dtype=np.int64
     )
     return src_c[idx]
+
+
+def scatter_spans(
+    src: np.ndarray,
+    src_off: np.ndarray,
+    lens: np.ndarray,
+    out: np.ndarray,
+    dst_off: np.ndarray,
+    threads: int = 0,
+) -> None:
+    """Scatter per-row spans into a caller-provided flat buffer:
+    ``out[dst_off[r]:dst_off[r]+lens[r]] = src[src_off[r]:...]``.
+    Unlike :func:`copy_spans`, lengths are explicit and ``dst_off`` need
+    not be contiguous — row subsets interleave into one shared side
+    buffer.  C++ threaded memcpy fan-out; numpy repeat-gather fallback."""
+    if src.dtype != np.uint8 or out.dtype != np.uint8:
+        raise TypeError("scatter_spans needs uint8 src/out")
+    n = len(lens)
+    if n == 0:
+        return
+    src_off64 = np.ascontiguousarray(src_off, dtype=np.int64)
+    dst_off64 = np.ascontiguousarray(dst_off, dtype=np.int64)
+    lens64 = np.ascontiguousarray(lens, dtype=np.int64)
+    src_c = np.ascontiguousarray(src)
+    lib = get_lib()
+    if lib is not None and hasattr(lib, "lp_scatter_spans"):
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.lp_scatter_spans(
+            _u8(src_c), src_off64.ctypes.data_as(i64p),
+            lens64.ctypes.data_as(i64p), _u8(out),
+            dst_off64.ctypes.data_as(i64p),
+            n, threads or _default_threads(),
+        )
+        return
+    live = lens64 > 0
+    if not live.any():
+        return
+    sl = lens64[live]
+    src_idx = np.repeat(src_off64[live], sl) + _ramp(sl)
+    dst_idx = np.repeat(dst_off64[live], sl) + _ramp(sl)
+    out[dst_idx] = src_c[src_idx]
+
+
+def _ramp(lens: np.ndarray) -> np.ndarray:
+    """[0..l0-1, 0..l1-1, ...] for positive lens."""
+    total = int(lens.sum())
+    ends = np.cumsum(lens)
+    return np.arange(total, dtype=np.int64) - np.repeat(
+        ends - lens, lens
+    )
 
 
 def build_views(

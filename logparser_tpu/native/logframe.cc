@@ -93,9 +93,17 @@ class Pool {
   uint64_t gen_ = 0;
 };
 
+// `weight` = relative per-unit cost (default 1): callers whose units do
+// K-times the work (e.g. the row-major view builder, K columns per row)
+// pass it so the go-parallel cutoff and chunk size reflect actual work,
+// not unit count — lp_run(B, ...) with K=12 columns must not fall into
+// the small-n single-thread path that lp_run(K*B, ...) would have
+// cleared.
 void lp_run(int64_t n, int32_t threads,
-            const std::function<void(int64_t, int64_t)>& body) {
-  if (threads <= 1 || n < 4096) {
+            const std::function<void(int64_t, int64_t)>& body,
+            int64_t weight = 1) {
+  if (weight < 1) weight = 1;
+  if (threads <= 1 || n * weight < 4096) {
     body(0, n);
     return;
   }
@@ -117,7 +125,8 @@ void lp_run(int64_t n, int32_t threads,
       pool_pid = getpid();
     }
   }
-  int64_t chunk = std::max<int64_t>(512, n / (threads * 4));
+  int64_t chunk = std::max<int64_t>(
+      std::max<int64_t>(1, 512 / weight), n / (threads * 4));
   pool->Run(n, chunk, body);
 }
 
@@ -251,6 +260,25 @@ void lp_copy_spans(const uint8_t* src, const int64_t* src_off,
   lp_run(n, threads, work);
 }
 
+// Scatter variant of lp_copy_spans: explicit per-row lengths and a
+// caller-provided destination, so subsets of rows can be written into a
+// shared side buffer at non-contiguous offsets (the view assembler lays
+// clean and repaired rows into ONE allocation instead of copy+concat+
+// recopy rounds).
+void lp_scatter_spans(const uint8_t* src, const int64_t* src_off,
+                      const int64_t* lens, uint8_t* dst,
+                      const int64_t* dst_off, int64_t n, int32_t threads) {
+  if (threads < 1) threads = 1;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      int64_t len = lens[r];
+      if (len <= 0) continue;
+      std::memcpy(dst + dst_off[r], src + src_off[r], len);
+    }
+  };
+  lp_run(n, threads, work);
+}
+
 // Arrow BinaryView (string_view) materializer: K span columns over the
 // same [B, L] buffer -> packed 16-byte Arrow view structs, NO byte
 // gather.  Strings of <= 12 bytes are inlined in the view (the Arrow
@@ -281,47 +309,54 @@ void lp_build_views(const uint8_t* buf, int64_t B, int64_t L,
     return true;
   }();
   (void)masks_init;
-  auto work = [&](int64_t lo, int64_t hi) {
-    int64_t r = lo % B;                 // incremental row tracking: the
-    int64_t row_base = r * L;          // per-element % B div was ~half
-    for (int64_t i = lo; i < hi; ++i) {  // the single-core loop cost
-      uint8_t* v = views + i * 16;
-      int32_t len = lens[i];
-      if (len < 0) {
-        std::memset(v, 0, 16);
-        if (++r == B) { r = 0; row_base = 0; } else row_base += L;
-        continue;
-      }
-      int64_t off = row_base + starts[i];
-      const uint8_t* src = buf + off;
-      std::memcpy(v, &len, 4);
-      if (len <= 12) {
-        uint64_t a = 0;
-        uint32_t b = 0;
-        if (off + 12 <= size) {
-          std::memcpy(&a, src, 8);
-          std::memcpy(&b, src + 8, 4);
-          a &= mask_a[len];
-          b &= mask_b[len];
-        } else {
-          uint8_t tmp[12] = {0};
-          std::memcpy(tmp, src, static_cast<size_t>(len));
-          std::memcpy(&a, tmp, 8);
-          std::memcpy(&b, tmp + 8, 4);
+  // ROW-major traversal (rows outer, columns inner): all K columns of a
+  // row resolve while that row's line bytes sit in L1.  The flat
+  // column-major loop re-streamed the whole [B, L] buffer once per
+  // column — at 16k x 384 (6.3 MB, beyond L2) that made the builder
+  // ~4x slower from cache misses alone (measured 1.27 ms vs 0.31 ms for
+  // an L1-resident buffer).  starts/lens reads and view writes become
+  // K strided streams (B elements apart), which prefetch fine.
+  auto work = [&](int64_t rlo, int64_t rhi) {
+    for (int64_t r = rlo; r < rhi; ++r) {
+      int64_t row_base = r * L;
+      for (int64_t k = 0; k < K; ++k) {
+        int64_t i = k * B + r;
+        uint8_t* v = views + i * 16;
+        int32_t len = lens[i];
+        if (len < 0) {
+          std::memset(v, 0, 16);
+          continue;
         }
-        std::memcpy(v + 4, &a, 8);
-        std::memcpy(v + 12, &b, 4);
-      } else {
-        std::memcpy(v + 4, src, 4);
-        int32_t bufi = 0;
-        int32_t off32 = static_cast<int32_t>(off);
-        std::memcpy(v + 8, &bufi, 4);
-        std::memcpy(v + 12, &off32, 4);
+        int64_t off = row_base + starts[i];
+        const uint8_t* src = buf + off;
+        std::memcpy(v, &len, 4);
+        if (len <= 12) {
+          uint64_t a = 0;
+          uint32_t b = 0;
+          if (off + 12 <= size) {
+            std::memcpy(&a, src, 8);
+            std::memcpy(&b, src + 8, 4);
+            a &= mask_a[len];
+            b &= mask_b[len];
+          } else {
+            uint8_t tmp[12] = {0};
+            std::memcpy(tmp, src, static_cast<size_t>(len));
+            std::memcpy(&a, tmp, 8);
+            std::memcpy(&b, tmp + 8, 4);
+          }
+          std::memcpy(v + 4, &a, 8);
+          std::memcpy(v + 12, &b, 4);
+        } else {
+          std::memcpy(v + 4, src, 4);
+          int32_t bufi = 0;
+          int32_t off32 = static_cast<int32_t>(off);
+          std::memcpy(v + 8, &bufi, 4);
+          std::memcpy(v + 12, &off32, 4);
+        }
       }
-      if (++r == B) { r = 0; row_base = 0; } else row_base += L;
     }
   };
-  lp_run(n, threads, work);
+  lp_run(B, threads, work, K);
 }
 
 // Re-point selected rows of a [B, 16] Arrow view array at a side buffer

@@ -408,7 +408,7 @@ def _assemble_view_array(result: "BatchResult", buf, starts, views, state):
     """Side-buffer handling + pa.Array assembly for one view column."""
     import pyarrow as pa
 
-    from ..native import copy_spans, patch_views
+    from ..native import copy_spans, patch_views, scatter_spans
 
     (col, valid, arr_valid, lens, special, fix_m, amp_m,
      ov_rows, ov_vals) = state
@@ -417,41 +417,59 @@ def _assemble_view_array(result: "BatchResult", buf, starts, views, state):
     views = np.ascontiguousarray(views.reshape(B, 16))
     variadic = [pa.py_buffer(buf.reshape(-1))]
     if special is not None:
+        # Single-allocation side-buffer assembly: repair segments gather
+        # straight from the batch buffer, then clean-special and repaired
+        # rows SCATTER into one final buffer (the former flow copied all
+        # special bytes up to three times: sub -> f_seg -> concat+recopy).
         rows = np.nonzero(special)[0]
         sub_lens = lens[rows].astype(np.int64)
-        sub_off = np.zeros(rows.size + 1, dtype=np.int64)
-        np.cumsum(sub_lens, out=sub_off[1:])
         src_off = rows.astype(np.int64) * L + starts[rows]
-        sub = copy_spans(buf.reshape(-1), src_off, sub_off)
-        if amp_m is not None:
-            amp_sub = amp_m[rows]
-            if amp_sub.any():
-                sub[sub_off[:-1][amp_sub]] = np.uint8(ord("&"))
         fix_sub = (
             np.nonzero(fix_m[rows])[0] if fix_m is not None
             else np.empty(0, dtype=np.int64)
         )
+        rep_flat = None
         if fix_sub.size:
             f_lens = sub_lens[fix_sub]
             f_off = np.zeros(fix_sub.size + 1, dtype=np.int64)
             np.cumsum(f_lens, out=f_off[1:])
-            f_seg = copy_spans(sub, sub_off[:-1][fix_sub], f_off)
+            f_seg = copy_spans(buf.reshape(-1), src_off[fix_sub], f_off)
+            if amp_m is not None:
+                # ?->& applies before repair sees the bytes (repair rows
+                # can carry the query-normalization flag too).
+                amp_fix = amp_m[rows][fix_sub]
+                if amp_fix.any():
+                    f_seg[f_off[:-1][amp_fix]] = np.uint8(ord("&"))
             rep_flat, rep_lens = _repair_fix_segments(
                 f_seg, f_off, col["fix_mode"]
             )
-            if rep_flat is not f_seg or np.any(rep_lens != f_lens):
-                # Reassemble the side buffer with the repaired values.
-                new_lens = sub_lens.copy()
-                new_lens[fix_sub] = rep_lens
-                src_base = sub_off[:-1].copy()
-                rep_off = np.zeros(fix_sub.size + 1, dtype=np.int64)
-                np.cumsum(rep_lens, out=rep_off[1:])
-                src_base[fix_sub] = len(sub) + rep_off[:-1]
-                combined = np.concatenate([sub, rep_flat])
-                new_off = np.zeros(rows.size + 1, dtype=np.int64)
-                np.cumsum(new_lens, out=new_off[1:])
-                sub = copy_spans(combined, src_base, new_off)
-                sub_off = new_off
+            rep_off = np.zeros(fix_sub.size + 1, dtype=np.int64)
+            np.cumsum(rep_lens, out=rep_off[1:])
+        new_lens = sub_lens
+        if rep_flat is not None:
+            new_lens = sub_lens.copy()
+            new_lens[fix_sub] = rep_lens
+        sub_off = np.zeros(rows.size + 1, dtype=np.int64)
+        np.cumsum(new_lens, out=sub_off[1:])
+        sub = np.empty(int(sub_off[-1]), dtype=np.uint8)
+        if fix_sub.size:
+            nonfix = np.ones(rows.size, dtype=bool)
+            nonfix[fix_sub] = False
+            scatter_spans(buf.reshape(-1), src_off[nonfix],
+                          sub_lens[nonfix], sub, sub_off[:-1][nonfix])
+            scatter_spans(rep_flat, rep_off[:-1], rep_lens,
+                          sub, sub_off[:-1][fix_sub])
+            if amp_m is not None:
+                amp_sub = amp_m[rows] & nonfix
+                if amp_sub.any():
+                    sub[sub_off[:-1][amp_sub]] = np.uint8(ord("&"))
+        else:
+            scatter_spans(buf.reshape(-1), src_off, sub_lens,
+                          sub, sub_off[:-1])
+            if amp_m is not None:
+                amp_sub = amp_m[rows]
+                if amp_sub.any():
+                    sub[sub_off[:-1][amp_sub]] = np.uint8(ord("&"))
         patch_views(views, rows, sub, sub_off, len(variadic))
         variadic.append(pa.py_buffer(sub))
     if ov_rows:
