@@ -156,3 +156,48 @@ def test_zone_vocabulary_corpus_stays_on_device():
     res = parser.parse_batch(lines)
     assert res.oracle_rows == 0
     assert res.bad_lines == 0
+
+
+def test_bucketed_lookup_matches_searchsorted():
+    """The device lookup resolves via the bucket table + chain steps; it
+    must agree with the plain last-key<=query searchsorted semantics for
+    every (zone, minute) — including bucket boundaries, exact transition
+    minutes and the minute just before/after each transition."""
+    import numpy as np
+
+    from logparser_tpu.dissectors.tztable import (
+        SPAN_MINUTES, default_zone_table,
+    )
+
+    tab = default_zone_table()
+    assert len(tab.zones) > 10
+    assert tab.chain >= 1
+    rng = np.random.default_rng(7)
+    Z = len(tab.zones)
+    zi = rng.integers(0, Z, size=4096).astype(np.int32)
+    mins = rng.integers(0, SPAN_MINUTES, size=4096).astype(np.int64)
+    # Adversarial rows: transition boundaries +-1 and bucket edges.
+    edge_keys = tab.keys[rng.integers(0, len(tab.keys), size=512)]
+    edge_z = (edge_keys // SPAN_MINUTES).astype(np.int32)
+    edge_m = (edge_keys % SPAN_MINUTES).astype(np.int64)
+    for dm in (-1, 0, 1):
+        zi = np.concatenate([zi, edge_z])
+        mins = np.concatenate([mins, np.clip(edge_m + dm, 0,
+                                             SPAN_MINUTES - 1)])
+    bucket = 1 << tab.BUCKET_BITS
+    zi = np.concatenate([zi, edge_z])
+    mins = np.concatenate(
+        [mins, np.clip((edge_m // bucket) * bucket, 0, SPAN_MINUTES - 1)]
+    )
+
+    import jax.numpy as jnp
+
+    off, ok = tab.lookup(jnp.asarray(zi), jnp.asarray(mins))
+    off = np.asarray(off)
+
+    key = zi.astype(np.uint64) * np.uint64(SPAN_MINUTES) + mins.astype(
+        np.uint64
+    )
+    pos = np.searchsorted(tab.keys.astype(np.uint64), key, side="right")
+    want = tab.offsets_s[np.clip(pos - 1, 0, len(tab.keys) - 1)]
+    assert np.array_equal(off, want)
