@@ -27,6 +27,23 @@ if TYPE_CHECKING:  # pragma: no cover
 # copy fallback instead of rebuilding the column only to fail again.
 _VIEW_FAILED = object()
 
+# Per-vocab Arrow dictionary cache: a production City database holds
+# ~1e5 names — rebuilding the pa.string() dictionary per batch would
+# out-cost the take() fast path it feeds.  Keyed by id() with the vocab
+# object retained (keeps the id stable); distinct vocabs are few (one
+# per mmdb column).
+_PA_VOCAB_CACHE: Dict[int, Any] = {}
+
+
+def _pa_vocab(dvals):
+    import pyarrow as pa
+
+    ent = _PA_VOCAB_CACHE.get(id(dvals))
+    if ent is None or ent[0] is not dvals:
+        ent = (dvals, pa.array(list(dvals), type=pa.string()))
+        _PA_VOCAB_CACHE[id(dvals)] = ent
+    return ent[1]
+
 
 
 def _spans_to_string_array(
@@ -691,11 +708,34 @@ def _column_to_arrow(
     # build the array; only mixed-type columns fall back to the per-row
     # stringify path below.
     if kind == "obj":
-        vals = np.asarray(col["values"], dtype=object)[:B]
         dead = ~(
             np.asarray(result.valid[:B], dtype=bool)
             & np.asarray(col["ok"][:B], dtype=bool)
         )
+        # Low-cardinality device-joined strings (GeoIP vocab columns)
+        # carry their vocab codes: dictionary.take(codes) builds the
+        # string column entirely in C (the object-array inference below
+        # was ~1 ms/column at 16k rows).
+        codes = col.get("dict_codes")
+        dvals = col.get("dict_values")
+        mixed = col.get("mixed_fill", False)
+        if codes is not None and dvals is not None and not mixed \
+                and not overrides:
+            c = codes[:B].copy()
+            c[dead] = -1
+            miss = c < 0
+            ind = pa.array(
+                np.clip(c, 0, None).astype(np.int32),
+                mask=miss,
+            )
+            return _pa_vocab(dvals).take(ind)
+        # Numeric geo columns (asn.number, lat/lon confidences) carry
+        # their raw typed values + miss mask — same column types as the
+        # inference path (int64/double), no per-element work.
+        if col.get("typed_kind") and not mixed and not overrides:
+            tv = np.asarray(col["typed_values"][:B])
+            return pa.array(tv, mask=dead | col["typed_miss"][:B])
+        vals = np.asarray(col["values"], dtype=object)[:B]
         if dead.any() or overrides:
             vals = vals.copy()
             vals[dead] = None

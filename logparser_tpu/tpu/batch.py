@@ -1145,6 +1145,25 @@ class TpuBatchParser:
 
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _geo_typed_fill(col, sel, typed, miss, kind_ch):
+        """Carry a numeric geo column's raw values + miss mask alongside
+        the object array so the Arrow bridge can build the typed column
+        without per-element inference.  Mixed numeric kinds across fills
+        disable the fast path (typed_kind=None)."""
+        B = len(typed)
+        if "typed_values" not in col:
+            col["typed_values"] = np.zeros(
+                B, dtype=np.float64 if kind_ch == "f" else np.int64
+            )
+            col["typed_miss"] = np.ones(B, dtype=bool)
+            col["typed_kind"] = kind_ch
+        if col.get("typed_kind") == kind_ch:
+            col["typed_values"] = np.where(sel, typed, col["typed_values"])
+            col["typed_miss"] = np.where(sel, miss, col["typed_miss"])
+        else:
+            col["typed_kind"] = None
+
     def parse_batch(self, lines: Sequence[Union[bytes, str]]) -> BatchResult:
         return self._finish_batch(self._start_batch(lines))
 
@@ -1429,6 +1448,11 @@ class TpuBatchParser:
                         comp, plan.comp, memo,
                         locale=getattr(plan.meta, "locale", None),
                     )
+                    # A non-geo fill on a (possibly geo-shared, mixed-
+                    # format) obj column: the Arrow dict/typed fast paths
+                    # only see geo-written state and would null these
+                    # rows — disable them for this column, either order.
+                    col["mixed_fill"] = True
                     col["values"] = np.where(sel, values, col["values"])
                     col["ok"] = np.where(sel, ok, col["ok"])
                 elif plan.kind == "geo":
@@ -1441,13 +1465,33 @@ class TpuBatchParser:
                     ok = (u.layout.get(block, key, "ok") != 0)[:B]
                     arr = table.arrays[column][rows_idx]
                     if column in table.vocabs:
-                        values = table.vocab_arrays[column][arr]
+                        vocab = table.vocab_arrays[column]
+                        values = vocab[arr]
+                        # Keep the vocab CODES for the Arrow bridge: geo
+                        # strings are low-cardinality, so the column can
+                        # build as dictionary.take(codes) with zero
+                        # per-row inference.  A second fill from a
+                        # DIFFERENT vocab (mixed-format batch over
+                        # distinct .mmdb tables) disables the fast path.
+                        if "dict_codes" not in col:
+                            col["dict_codes"] = np.full(B, -1, dtype=np.int64)
+                            col["dict_values"] = vocab
+                        if col.get("dict_values") is vocab:
+                            col["dict_codes"] = np.where(
+                                sel, arr.astype(np.int64), col["dict_codes"]
+                            )
+                        else:
+                            col["dict_values"] = None
                     elif arr.dtype.kind == "f":
                         values = arr.astype(object)
                         values[np.isnan(arr)] = None
+                        self._geo_typed_fill(col, sel, arr.astype(np.float64),
+                                             np.isnan(arr), "f")
                     else:
                         values = arr.astype(object)
                         values[arr < 0] = None
+                        self._geo_typed_fill(col, sel, arr.astype(np.int64),
+                                             arr < 0, "i")
                     col["values"] = np.where(sel, values, col["values"])
                     col["ok"] = np.where(sel, ok, col["ok"])
                 elif plan.kind == "muid":
@@ -1455,6 +1499,7 @@ class TpuBatchParser:
 
                     key = muid_group_key(plan)
                     ok = unit_get(u, key, "ok") != 0
+                    col["mixed_fill"] = True  # see the ts branch
                     if plan.comp == "ip":
                         u32 = (
                             unit_get(u, key, "ip").astype(np.int64)
