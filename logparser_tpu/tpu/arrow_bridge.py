@@ -30,16 +30,23 @@ _VIEW_FAILED = object()
 # Per-vocab Arrow dictionary cache: a production City database holds
 # ~1e5 names — rebuilding the pa.string() dictionary per batch would
 # out-cost the take() fast path it feeds.  Keyed by id() with the vocab
-# object retained (keeps the id stable); distinct vocabs are few (one
-# per mmdb column).
+# object retained (keeps the id stable); live vocabs are few (one per
+# mmdb column), but a service that RELOADS its databases would otherwise
+# accumulate stale multi-MB entries forever — bound the cache and drop
+# the oldest half when it fills (refilling a live vocab is one cheap
+# rebuild).
 _PA_VOCAB_CACHE: Dict[int, Any] = {}
+_PA_VOCAB_CACHE_MAX = 32
 
 
 def _pa_vocab(dvals):
     import pyarrow as pa
 
     ent = _PA_VOCAB_CACHE.get(id(dvals))
-    if ent is None or ent[0] is not dvals:
+    if ent is None:
+        if len(_PA_VOCAB_CACHE) >= _PA_VOCAB_CACHE_MAX:
+            for k in list(_PA_VOCAB_CACHE)[: _PA_VOCAB_CACHE_MAX // 2]:
+                del _PA_VOCAB_CACHE[k]
         ent = (dvals, pa.array(list(dvals), type=pa.string()))
         _PA_VOCAB_CACHE[id(dvals)] = ent
     return ent[1]

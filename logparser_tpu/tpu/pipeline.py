@@ -1139,7 +1139,29 @@ def units_fn(units: Sequence[FormatUnit]):
     return fn
 
 
+# Tile size for large batches: at 64k x 384 the executor's [B]-shaped
+# intermediates overflow fast memory and XLA inserts HBM<->S(1) copies
+# that dominate the profile (39.6M lines/s @64k vs 47.2M @16k for the
+# same program).  lax.map over 16k tiles keeps each tile's working set
+# resident; the per-tile outputs re-pack into the same [K, B] layout.
+EXEC_TILE_B = 16384
+
+
 def build_units_jnp_fn(units: Sequence[FormatUnit]):
     """Plain-XLA executor over all formats:
     (buf [B,L] uint8, lengths [B]) -> [sum K_i, B] int32."""
-    return jax.jit(units_fn(units))
+    fn = units_fn(units)
+
+    def tiled(buf: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+        B = buf.shape[0]
+        if B > EXEC_TILE_B and B % EXEC_TILE_B == 0:
+            n = B // EXEC_TILE_B
+            out = jax.lax.map(
+                lambda t: fn(t[0], t[1]),
+                (buf.reshape(n, EXEC_TILE_B, buf.shape[1]),
+                 lengths.reshape(n, EXEC_TILE_B)),
+            )  # [n, K, TILE]
+            return jnp.moveaxis(out, 0, 1).reshape(out.shape[1], B)
+        return fn(buf, lengths)
+
+    return jax.jit(tiled)
