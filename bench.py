@@ -336,7 +336,13 @@ def oracle_rate(parser, lines, sample=ORACLE_SAMPLE, trials=3):
     10% regression gate compares this against the previous committed
     round, and on the 1-core bench host a single pass swings with
     scheduler noise (observed 35-48k across same-code runs).  Best-of
-    measures the engine's capability, which is what the gate guards."""
+    measures the engine's capability, which is what the gate guards.
+
+    Methodology-transition note: the round this landed (r04), the gate
+    compares best-of-3 against r03's single-pass baselines — a direction
+    that can only mask, not false-flag, a regression; vacuous in r04
+    because the compiled line engine is 2-3x faster than r03 outright.
+    From r05 on both sides are best-of-3."""
     from logparser_tpu.tpu.batch import _CollectingRecord
 
     sample_lines = lines[:sample]

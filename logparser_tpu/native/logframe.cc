@@ -26,6 +26,10 @@
 #include <unistd.h>
 #include <vector>
 
+#if defined(__SSE2__)
+#include <emmintrin.h>
+#endif
+
 // Persistent worker pool: per-call std::thread spawns (~50us each) used
 // to dominate the small batched calls (view builds, repairs) — the pool
 // is created on first parallel call and reused for every lp_* entry
@@ -294,9 +298,11 @@ void lp_build_views(const uint8_t* buf, int64_t B, int64_t L,
   int64_t n = K * B;
   if (n == 0) return;  // the row-tracking modulo below needs B > 0
   int64_t size = B * L;
+#if !defined(__SSE2__)
   // Inline masks: keep bytes < len of a constant-size 12-byte load
   // (branch-free tail zeroing; the variable-length memcpy + memset pair
-  // was the single-core hot spot).
+  // was the single-core hot spot).  Scalar build only — the SSE2 path
+  // has its own 16-byte mask table.
   static uint64_t mask_a[13];
   static uint32_t mask_b[13];
   static bool masks_init = [] {
@@ -309,6 +315,7 @@ void lp_build_views(const uint8_t* buf, int64_t B, int64_t L,
     return true;
   }();
   (void)masks_init;
+#endif
   // ROW-major traversal (rows outer, columns inner): all K columns of a
   // row resolve while that row's line bytes sit in L1.  The flat
   // column-major loop re-streamed the whole [B, L] buffer once per
@@ -316,6 +323,18 @@ void lp_build_views(const uint8_t* buf, int64_t B, int64_t L,
   // ~4x slower from cache misses alone (measured 1.27 ms vs 0.31 ms for
   // an L1-resident buffer).  starts/lens reads and view writes become
   // K strided streams (B elements apart), which prefetch fine.
+#if defined(__SSE2__)
+  // 16-byte masks for the SSE path: bytes 4..3+l set, bytes 0..3 clear
+  // (the length lane is OR'd in separately).
+  alignas(16) static uint8_t mask16[13][16];
+  static bool mask16_init = [] {
+    for (int l = 0; l <= 12; ++l)
+      for (int b = 0; b < 16; ++b)
+        mask16[l][b] = (b >= 4 && b < 4 + l) ? 0xFF : 0;
+    return true;
+  }();
+  (void)mask16_init;
+#endif
   auto work = [&](int64_t rlo, int64_t rhi) {
     for (int64_t r = rlo; r < rhi; ++r) {
       int64_t row_base = r * L;
@@ -323,6 +342,43 @@ void lp_build_views(const uint8_t* buf, int64_t B, int64_t L,
         int64_t i = k * B + r;
         uint8_t* v = views + i * 16;
         int32_t len = lens[i];
+#if defined(__SSE2__)
+        if (len < 0) {
+          _mm_storeu_si128(reinterpret_cast<__m128i*>(v),
+                           _mm_setzero_si128());
+          continue;
+        }
+        int64_t off = row_base + starts[i];
+        const uint8_t* src = buf + off;
+        if (len <= 12) {
+          __m128i out;
+          if (off + 16 <= size) {
+            // One 16-byte load — reads up to 16-len bytes past the
+            // span, which the off+16<=size guard keeps inside the
+            // buffer (do NOT relax it to off+len+4) — then shift the
+            // 12 inline bytes into place, mask the tail, OR the
+            // length lane.
+            __m128i data = _mm_loadu_si128(
+                reinterpret_cast<const __m128i*>(src));
+            out = _mm_slli_si128(data, 4);
+            out = _mm_and_si128(out, *reinterpret_cast<const __m128i*>(
+                                         mask16[len]));
+            out = _mm_or_si128(out, _mm_cvtsi32_si128(len));
+          } else {
+            alignas(16) uint8_t tmp[16] = {0};
+            std::memcpy(&tmp[0], &len, 4);
+            std::memcpy(&tmp[4], src, static_cast<size_t>(len));
+            out = _mm_load_si128(reinterpret_cast<const __m128i*>(tmp));
+          }
+          _mm_storeu_si128(reinterpret_cast<__m128i*>(v), out);
+        } else {
+          int32_t first4;
+          std::memcpy(&first4, src, 4);
+          _mm_storeu_si128(
+              reinterpret_cast<__m128i*>(v),
+              _mm_set_epi32(static_cast<int32_t>(off), 0, first4, len));
+        }
+#else
         if (len < 0) {
           std::memset(v, 0, 16);
           continue;
@@ -353,6 +409,7 @@ void lp_build_views(const uint8_t* buf, int64_t B, int64_t L,
           std::memcpy(v + 8, &bufi, 4);
           std::memcpy(v + 12, &off32, 4);
         }
+#endif
       }
     }
   };
