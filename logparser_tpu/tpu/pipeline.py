@@ -1156,12 +1156,27 @@ def build_units_jnp_fn(units: Sequence[FormatUnit]):
         B = buf.shape[0]
         if B > EXEC_TILE_B and B % EXEC_TILE_B == 0:
             n = B // EXEC_TILE_B
-            out = jax.lax.map(
-                lambda t: fn(t[0], t[1]),
-                (buf.reshape(n, EXEC_TILE_B, buf.shape[1]),
-                 lengths.reshape(n, EXEC_TILE_B)),
-            )  # [n, K, TILE]
-            return jnp.moveaxis(out, 0, 1).reshape(out.shape[1], B)
+            tb = buf.reshape(n, EXEC_TILE_B, buf.shape[1])
+            tl = lengths.reshape(n, EXEC_TILE_B)
+            # Shape probe (traced once, free): rows K + dtype of the
+            # packed output for the result allocation.
+            probe = jax.eval_shape(fn, tb[0], tl[0])
+            K = probe.shape[0]
+
+            def body(i, acc):
+                # Write each tile's [K, TILE] block straight into the
+                # [K, B] result — no [n, K, TILE] intermediate and no
+                # final transpose pass (lax.map needed both).
+                tile = fn(
+                    jax.lax.dynamic_index_in_dim(tb, i, keepdims=False),
+                    jax.lax.dynamic_index_in_dim(tl, i, keepdims=False),
+                )
+                return jax.lax.dynamic_update_slice(
+                    acc, tile, (0, i * EXEC_TILE_B)
+                )
+
+            init = jnp.zeros((K, B), dtype=probe.dtype)
+            return jax.lax.fori_loop(0, n, body, init)
         return fn(buf, lengths)
 
     return jax.jit(tiled)
