@@ -143,12 +143,60 @@ LINES_PER_FORMAT = 40
 GARBAGE = ["", "complete garbage", '"-', "\\x16\\x03", "a b c d e f g h i"]
 
 
+def assert_arrow_matches_pylist(result, fields, label, columns=None):
+    """Every fuzz case also locks the Arrow bridge (zero-copy views,
+    repair side buffers, dict-coded geo columns, typed numerics) against
+    the per-row to_pylist materializer, under the documented type
+    contracts: string columns stringify, map columns compare as dicts,
+    beyond-int64 values deliver NULL in the typed column."""
+    try:
+        import pyarrow as pa
+    except ImportError:  # pragma: no cover - arrow ships in CI
+        return
+    tbl = result.to_arrow()
+    for f in fields:
+        if f not in tbl.column_names:
+            continue
+        t = tbl[f].type
+        got = tbl[f].to_pylist()
+        want = (
+            columns[f] if columns is not None and f in columns
+            else result.to_pylist(f)
+        )
+        if pa.types.is_map(t):
+            got = [None if g is None else dict(g) for g in got]
+        elif pa.types.is_string(t) or (
+            hasattr(pa.types, "is_string_view") and pa.types.is_string_view(t)
+        ):
+            want = [None if v is None else str(v) for v in want]
+        elif pa.types.is_integer(t):
+            want = [
+                None
+                if v is None
+                or (isinstance(v, int) and not -2**63 <= v < 2**63)
+                else int(v)
+                for v in want
+            ]
+        elif pa.types.is_floating(t):
+            want = [None if v is None else float(v) for v in want]
+        assert got == want, (
+            f"{label}: arrow vs pylist mismatch in {f} ({t})\n"
+            f"  first diff: "
+            f"""{next(
+                ((i, g, w) for i, (g, w) in enumerate(zip(got, want))
+                 if g != w),
+                ('length', len(got), len(want)),
+            )}"""
+        )
+
+
 def assert_device_matches_oracle(log_format, fields, lines, label,
                                  locale=None):
     parser = TpuBatchParser(log_format, fields, locale=locale)
     result = parser.parse_batch(lines)
     valid = list(result.valid)
     columns = {f: result.to_pylist(f) for f in fields}
+    assert_arrow_matches_pylist(result, fields, label, columns=columns)
 
     oracle = parser.oracle
     n_checked = 0
