@@ -46,6 +46,12 @@ LOCALE_TAGS: Dict[str, Tuple[str, str]] = {
     "lt": ("lt", "lt_LT"), "id": ("id", "id_ID"), "vi": ("vi", "vi_VN"),
     "ms": ("ms", "ms_MY"), "ja": ("ja", "ja_JP"), "ko": ("ko", "ko_KR"),
     "zh": ("zh", "zh_CN"), "zh_tw": ("zh_Hant_TW", "zh_Hant_TW"),
+    "ar": ("ar", "ar_SA"), "he": ("he", "he_IL"), "th": ("th", "th_TH"),
+    "hi": ("hi", "hi_IN"), "fa": ("fa", "fa_IR"), "sr": ("sr", "sr_RS"),
+    "mk": ("mk", "mk_MK"), "sq": ("sq", "sq_AL"), "az": ("az", "az_AZ"),
+    "kk": ("kk", "kk_KZ"), "ka": ("ka", "ka_GE"), "hy": ("hy", "hy_AM"),
+    "sw": ("sw", "sw_KE"), "af": ("af", "af_ZA"), "eu": ("eu", "eu_ES"),
+    "gl": ("gl", "gl_ES"), "bn": ("bn", "bn_BD"), "ta": ("ta", "ta_IN"),
 }
 
 # JDK-flavored pins where the vendored CLDR vintage differs from the
