@@ -52,6 +52,13 @@ def test_locale_count_and_legacy_values():
 @pytest.mark.parametrize("tag,month_probe", [
     ("pl", None), ("cs", None), ("tr", None), ("ru", None),
     ("ja", None), ("sv", None), ("fi", None), ("ro", None),
+    # The RTL and >2-byte-per-char script classes (first added late in
+    # round 4) stress the segmented variable-width device layouts
+    # hardest: Arabic/Hebrew/Farsi RTL, Thai/Bengali/Tamil long
+    # multi-byte month names (up to 33 bytes), Azerbaijani prefix-
+    # colliding day names.
+    ("ar", None), ("he", None), ("fa", None), ("th", None),
+    ("bn", None), ("ta", None), ("az", None), ("hy", None),
 ])
 def test_new_locales_parse_device_resident(tag, month_probe):
     """A corpus written with a NEW locale's month names parses on device
