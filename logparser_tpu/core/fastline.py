@@ -298,9 +298,22 @@ class _Compiler:
             ConvertSecondsWithMillisStringDissector,
         )
 
+        from ..geoip.dissectors import (
+            GeoIPASNDissector,
+            GeoIPCityDissector,
+            GeoIPCountryDissector,
+            GeoIPISPDissector,
+        )
+
         inst = phase.instance
         if isinstance(inst, TimeStampDissector):
             return self._compile_timestamp(inst, input_name)
+        # EXACT types only: AbstractGeoIPDissector is an extension point;
+        # a subclass overriding dissect()/extract() (or touching Parsable
+        # methods beyond add_dissection) must keep the generic path.
+        if type(inst) in (GeoIPCountryDissector, GeoIPCityDissector,
+                          GeoIPASNDissector, GeoIPISPDissector):
+            return self._compile_geoip(inst, input_name)
         if isinstance(inst, HttpFirstLineDissector):
             return self._compile_firstline(inst, input_name)
         if isinstance(inst, HttpFirstLineProtocolDissector):
@@ -332,6 +345,61 @@ class _Compiler:
                 out(ctx, int(seconds_str) * 1000 + int(millis_str))
             return secms
         return None
+
+    def _compile_geoip(self, inst, input_name: str) -> Route:
+        """Value-level GeoIP replay: the per-line work (IP parse, mmdb
+        lookup, extract) reuses the dissector's own code — semantics stay
+        single-sourced — but `extract`'s add_dissection calls dispatch
+        through precompiled routes instead of a real Parsable (the
+        routing was ~the whole non-lookup cost in the generic engine)."""
+        import ipaddress
+
+        compiler = self
+
+        # Resolve every possible output's route at COMPILE time so first-
+        # line latency doesn't pay route compilation (route() memoizes;
+        # the shim then pays one dict probe per produced output).
+        for out in inst.get_possible_output():
+            ot, _, oname = out.partition(":")
+            compiler.route(input_name, ot, oname)
+
+        class _GeoShim:
+            __slots__ = ("ctx",)
+
+            def __init__(self, ctx):
+                self.ctx = ctx
+
+            def add_dissection(self, base, ftype, name, value):
+                compiler.route(base, ftype, name)(self.ctx, value)
+
+        # String-keyed memo over the whole parse+lookup: repeated client
+        # IPs (the norm in real corpora) cost one dict probe per line —
+        # even ipaddress parsing is skipped.  Unparseable strings cache
+        # as misses too.  Same crude clear-when-full bound as the reader.
+        memo: Dict[str, Any] = {}
+        _MISS = object()
+
+        def geo_emit(ctx: _Ctx, v) -> None:
+            s = _to_string(v)
+            if not s:
+                return
+            data = memo.get(s, _MISS)
+            if data is _MISS:
+                reader = inst._reader
+                try:
+                    addr = ipaddress.ip_address(s)
+                except ValueError:
+                    data = None
+                else:
+                    data = reader.lookup_address(addr) if reader else None
+                if len(memo) >= 65536:
+                    memo.clear()
+                memo[s] = data
+            if data is None:
+                return
+            inst.extract(_GeoShim(ctx), input_name, data)
+
+        return geo_emit
 
     def _compile_timestamp(self, inst, input_name: str) -> Route:
         from .exceptions import DissectionFailure as DF

@@ -176,6 +176,8 @@ class MMDBReader:
         # Data section starts after the tree plus a 16-byte zero separator.
         self._decoder = _Decoder(self.buf, self.tree_size + 16)
         self._ipv4_start: Optional[int] = None
+        self._addr_cache: Dict[bytes, Optional[Dict[str, Any]]] = {}
+        self._record_cache: Dict[int, Any] = {}
 
     @property
     def database_type(self) -> str:
@@ -219,32 +221,52 @@ class MMDBReader:
             return None
         return self.lookup_address(addr)
 
+    # Bound for the per-address result cache: real corpora repeat client
+    # IPs heavily, so this converts the per-line tree walk + record decode
+    # into one dict probe (the reference wraps its reader in a CHMCache
+    # the same way, AbstractGeoIPDissector.java:73-84).  Crude clear-when-
+    # full keeps the bound simple; refilling is one walk per address.
+    _ADDR_CACHE_MAX = 65536
+
     def lookup_address(self, addr) -> Optional[Dict[str, Any]]:
         if addr.version == 6 and self.ip_version == 4:
             return None
         packed = addr.packed
+        cache = self._addr_cache
+        if packed in cache:
+            return cache[packed]
         if addr.version == 4 and self.ip_version == 6:
             node = self._ipv4_start_node()
         else:
             node = 0
         bit_count = len(packed) * 8
+        result: Optional[Dict[str, Any]] = None
         for i in range(bit_count):
             if node >= self.node_count:
                 break
             bit = (packed[i >> 3] >> (7 - (i & 7))) & 1
             node = self._read_record(node, bit)
-        if node == self.node_count:
-            return None  # no data for this address
-        if node < self.node_count:
-            return None  # ran out of bits inside the tree (shouldn't happen)
-        return self._data_at(node)
+        if node > self.node_count:
+            result = self._data_at(node)
+        # node == node_count: no data; node < node_count: ran out of bits
+        # inside the tree (shouldn't happen) — both cache as a miss.
+        if len(cache) >= self._ADDR_CACHE_MAX:
+            cache.clear()
+        cache[packed] = result
+        return result
 
     def _data_at(self, record: int) -> Any:
         # record - node_count - 16 is the offset inside the data section.
+        # Distinct data records are few (shared by many ranges) — cache
+        # decodes by offset, like the pointer cache inside the decoder.
         offset = record - self.node_count - 16
         if offset < 0:
             raise InvalidDatabaseError("record points into the separator")
-        return self._decoder.decode(offset)
+        if offset in self._record_cache:
+            return self._record_cache[offset]
+        value = self._decoder.decode(offset)
+        self._record_cache[offset] = value
+        return value
 
     # -- flattening (device-side LPM tables) --------------------------------
 

@@ -184,3 +184,73 @@ def test_fastline_stateful_mode_stays_generic():
     p.add_parse_target("set_value", ["IP:connection.client.host"])
     p.assemble_dissectors()
     assert compile_fastline(p) is None
+
+
+def test_fastline_geoip_matches_generic_all_outputs():
+    """The compiled GeoIP emitter must deliver EVERY possible output of
+    all four dissectors (booleans, confidences, lat/lon doubles, ISP
+    strings) identically to the generic engine — hits, misses, and
+    unparseable host strings alike."""
+    import os
+
+    from logparser_tpu.core.fastline import compile_fastline
+    from logparser_tpu.geoip import (
+        GeoIPASNDissector,
+        GeoIPCityDissector,
+        GeoIPCountryDissector,
+        GeoIPISPDissector,
+    )
+    from logparser_tpu.tools.geoip_testdata import ensure_test_databases
+
+    data = ensure_test_databases()
+    chain = [
+        (GeoIPCityDissector, os.path.join(data, "GeoIP2-City-Test.mmdb")),
+        (GeoIPCountryDissector,
+         os.path.join(data, "GeoIP2-Country-Test.mmdb")),
+        (GeoIPISPDissector, os.path.join(data, "GeoIP2-ISP-Test.mmdb")),
+        (GeoIPASNDissector, os.path.join(data, "GeoLite2-ASN-Test.mmdb")),
+    ]
+    # City + ISP cover Country's and ASN's outputs as supersets; request
+    # every derivable geo field under the host.
+    fields = sorted({
+        f"{out.partition(':')[0]}:connection.client.host."
+        f"{out.partition(':')[2]}"
+        for cls, _ in chain
+        for out in cls().get_possible_output()
+    })
+
+    def build(fast):
+        p = HttpdLoglineParser(Rec, "common")
+        p.all_dissectors[0].stateless = True
+        for cls, path in chain:
+            p.add_dissector(cls(path))
+        p.add_parse_target("set_value", fields)
+        p.use_fastline = fast
+        return p
+
+    fast_p = build(True)
+    fast_p.assemble_dissectors()
+    assert compile_fastline(fast_p) is not None
+    slow_p = build(False)
+
+    lines = [
+        # fixture hit (Amstelveen / Basjes ISP / AS4444)
+        '80.100.47.45 - - [01/Jan/2026:00:00:30 +0100] "GET /a HTTP/1.1" 200 5',
+        # lookup miss
+        '1.2.3.4 - - [01/Jan/2026:00:00:31 +0100] "GET /b HTTP/1.1" 200 5',
+        # not an IP at all (%h can be a hostname)
+        'host.example.com - - [01/Jan/2026:00:00:32 +0100] "GET /c HTTP/1.1" 200 5',
+        # IPv6 hit/miss shapes
+        '2001:db8::1 - - [01/Jan/2026:00:00:33 +0100] "GET /d HTTP/1.1" 200 5',
+    ]
+    any_value = False
+    for line in lines:
+        fast = _run(lambda: fast_p, line)
+        slow = _run(lambda: slow_p, line)
+        assert fast == slow, f"geo divergence on {line!r}:\n {fast}\n {slow}"
+        if fast[0] == "ok" and any(
+            v is not None for k, v in fast[1].items()
+            if k.split(":", 1)[1] != "connection.client.host"
+        ):
+            any_value = True
+    assert any_value, "no geo output delivered on any line (vacuous)"
