@@ -629,7 +629,9 @@ def main():
         cur = configs.get(cname)
         if not (isinstance(prev, dict) and isinstance(cur, dict)):
             continue
-        p_or = prev.get("host_oracle_lines_per_sec")
+        # Rounds <= 4 recorded full per-config dicts; the compact stdout
+        # line (round 5+) uses the short "oracle" key — accept both.
+        p_or = prev.get("host_oracle_lines_per_sec") or prev.get("oracle")
         c_or = cur.get("host_oracle_lines_per_sec")
         if p_or and c_or and c_or < 0.9 * p_or:
             gate_failures.append(
@@ -639,7 +641,7 @@ def main():
 
     headline = round(headline_kern[1], 1) if headline_kern else round(
         device_resident, 1)
-    print(json.dumps({
+    full = {
         "metric": "device kernel loglines/sec/chip (Apache combined)",
         "value": headline,
         "unit": "lines/sec",
@@ -681,7 +683,58 @@ def main():
         # (exit 1) so CI/driver records it.
         "gate_failures": gate_failures,
         "configs": configs,
-    }))
+    }
+    # Full detail goes to bench_last.json (git-TRACKED since round 5, so
+    # each round's driver run leaves a durable full record when the driver
+    # commits end-of-round state); stdout's FINAL line is a compact
+    # (<1.5KB) headline JSON.  The driver records only a 2000-char tail of
+    # stdout — rounds 3 and 4 lost their machine-readable record to a ~4KB
+    # single line (VERDICT r4 weak #1), so the last line must stay small.
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_last.json"), "w") as f:
+        json.dump(full, f, indent=1)
+    compact_cfgs = {}
+    for cname, c in configs.items():
+        if not isinstance(c, dict):
+            compact_cfgs[cname] = {"error": True}
+            continue
+        # Keep whichever rates were measured even when a later phase
+        # errored — phase-1 host numbers survive finish_config failures
+        # and the next round's oracle-regression gate needs them.
+        compact_cfgs[cname] = {
+            k: c[v]
+            for k, v in (("device", "device_kernel_lines_per_sec"),
+                         ("arrow", "arrow_lines_per_sec"),
+                         ("oracle", "host_oracle_lines_per_sec"))
+            if v in c
+        }
+        if "error" in c:
+            compact_cfgs[cname]["error"] = True
+    compact = {
+        "metric": full["metric"],
+        "value": full["value"],
+        "unit": full["unit"],
+        "vs_baseline": full["vs_baseline"],
+        "arrow_lines_per_sec": full["arrow_lines_per_sec"],
+        "host_oracle_lines_per_sec": full["host_oracle_lines_per_sec"],
+        "p99_batch_latency_ms": full["p99_batch_latency_ms"],
+        "oracle_fraction_max": full["oracle_fraction_max"],
+        "gate_failures": gate_failures,
+        "configs": compact_cfgs,
+        "detail": "bench_last.json",
+    }
+    line = json.dumps(compact)
+    if len(line) > 1400:  # belt-and-braces: never exceed the driver's tail
+        compact.pop("configs")
+        line = json.dumps(compact)
+    if len(line) > 1400:  # many gate failures can still blow the budget
+        n = len(gate_failures)
+        compact["gate_failures"] = (
+            [f"{n} gate failures; see bench_last.json"]
+            + [g[:120] for g in gate_failures[:3]]
+        )
+        line = json.dumps(compact)
+    print(line)
     return 1 if gate_failures else 0
 
 
