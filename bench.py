@@ -289,16 +289,19 @@ def device_stage_profile(parser, buf, lengths, batch):
     return out
 
 
-def kernel_rate(parser, lines, iters=5):
+def kernel_rate(parser, lines, iters=5, views=False):
     """Ground-truth kernel time via the xplane profiler (the ROADMAP's
     profile_device tool): (kernel_ms_per_batch, lines_per_sec) or None when
     the xplane proto module is unavailable.  This is the number of record —
     the slope estimator below is cross-checked against it and the bench
     FAILS when they diverge (round-3 verdict: the slope estimator read
-    23M-106M on the same kernel depending on tunnel jitter)."""
+    23M-106M on the same kernel depending on tunnel jitter).
+    ``views=True`` profiles the parse_batch product path (round 5:
+    device-emitted Arrow view rows), so the per-config device numbers
+    include the view-emission cost the Arrow delivery rate depends on."""
     from logparser_tpu.tools.profile_device import profile_parser
 
-    prof = profile_parser(parser, lines, iters=iters)
+    prof = profile_parser(parser, lines, iters=iters, views=views)
     if not prof:
         return None
     ms = prof[0][1] / iters
@@ -456,7 +459,7 @@ def finish_config(cfg, state):
     import) for one config; see :func:`bench_config` for why this runs
     strictly after every host-side measurement."""
     parser, lines, buf, lengths, frac, oracle_lps = state
-    kern = kernel_rate(parser, lines)
+    kern = kernel_rate(parser, lines, views=True)
     if kern is not None:
         # Number of record: xplane-profiled device time of the full fused
         # executor.  The marginal-slope estimator is NOT used per config —
@@ -521,7 +524,10 @@ def main():
 
     # 2b) Productized stream vs serialized parse_batch: the same overlap
     # through the public API (TpuBatchParser.parse_batch_stream), full
-    # materialization included.
+    # materialization included.  Round 5: parse_batch's executor also
+    # emits device Arrow view rows (4 int32 rows per span field), so
+    # these two numbers carry the larger packed D2H — on this tunneled
+    # host that is a real extra cost; on a PCIe host it is DMA noise.
     stream_batch = lines[:CONFIG_BATCH]
     parser.parse_batch(stream_batch)  # warm the shape bucket
     t0 = time.perf_counter()

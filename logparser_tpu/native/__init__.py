@@ -13,8 +13,9 @@ import ctypes
 import hashlib
 import os
 import subprocess
+import sys
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -109,6 +110,25 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 u8p, i64p, i64p, ctypes.c_int64, ctypes.c_int32, u8p,
             ]
             lib.lp_patch_views.restype = None
+        if hasattr(lib, "lp_views_interleave"):
+            lib.lp_views_interleave.argtypes = [
+                i32p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, u8p, ctypes.c_int32,
+            ]
+            lib.lp_views_interleave.restype = None
+        if hasattr(lib, "lp_special_scan"):
+            lib.lp_special_scan.argtypes = [
+                u8p, ctypes.c_int64, i32p, i64p, i64p, u8p, u8p,
+                ctypes.c_int64, ctypes.c_int32, u8p, i64p, u8p,
+                ctypes.c_int32,
+            ]
+            lib.lp_special_scan.restype = None
+            lib.lp_special_write.argtypes = [
+                u8p, ctypes.c_int64, i32p, i64p, i64p, u8p, u8p,
+                ctypes.c_int64, ctypes.c_int32, u8p, i64p, u8p, u8p, u8p,
+                ctypes.c_int32, ctypes.c_int32,
+            ]
+            lib.lp_special_write.restype = None
         if hasattr(lib, "lp_repair_scan"):
             lib.lp_repair_scan.argtypes = [
                 u8p, i64p, ctypes.c_int64, ctypes.c_int32, u8p, i64p, u8p,
@@ -383,7 +403,7 @@ def build_views(
         raise ValueError("buffer too large for int32 view offsets")
     lens2 = np.ascontiguousarray(lens, dtype=np.int32)
     buf_c = np.ascontiguousarray(buf)
-    views = np.empty(K * B * 16, dtype=np.uint8)
+    views = _pooled_empty_u8(K * B * 16)
     lib = get_lib()
     if lib is not None and hasattr(lib, "lp_build_views"):
         lib.lp_build_views(
@@ -488,6 +508,123 @@ def repair_spans(seg: np.ndarray, seg_off: np.ndarray, escape_mode: bool,
         _u8(out if len(out) else np.zeros(1, np.uint8)), nthreads,
     )
     return out, out_lens, py_flags.astype(bool)
+
+
+# Output-buffer pool for the fixed-size per-batch view arrays: a fresh
+# np.empty of ~2 MB pays ~0.2 ms of page faults per call on this host
+# (the kernel itself runs in ~0.18 ms).  An entry is reused only when
+# nothing else holds it — Arrow buffers built on a pooled array keep a
+# reference, so a table still alive blocks reuse (refcount check).
+_BUF_POOL: Dict[int, np.ndarray] = {}
+_BUF_POOL_MAX = 16
+
+
+def _pooled_empty_u8(n: int) -> np.ndarray:
+    arr = _BUF_POOL.get(n)
+    # 3 == dict entry + local binding + getrefcount argument: sole owner.
+    if arr is not None and sys.getrefcount(arr) == 3:
+        return arr
+    if len(_BUF_POOL) >= _BUF_POOL_MAX:
+        _BUF_POOL.clear()
+    arr = np.empty(n, dtype=np.uint8)
+    _BUF_POOL[n] = arr
+    return arr
+
+
+def views_interleave(
+    packed: np.ndarray,
+    field_rows: np.ndarray,
+    B: int,
+    L: int,
+    threads: int = 0,
+):
+    """Device-emitted view rows -> [F, B, 16] Arrow string_view structs.
+
+    ``packed`` is the fetched [K, stride] int32 device output;
+    ``field_rows`` holds, per span field, the row index of its merged
+    span word (rows +1..+3 carry the LE-packed first-12 bytes).  Returns
+    None when the native library is unavailable (callers fall back to the
+    host-side builder)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lp_views_interleave"):
+        return None
+    if packed.dtype != np.int32 or not packed.flags.c_contiguous:
+        return None
+    if B * L >= 2**31:
+        # int32 view offsets (r*L + start) would wrap — same guard as
+        # build_views (callers fall back to paths that raise loudly).
+        return None
+    F = field_rows.size
+    rows64 = np.ascontiguousarray(field_rows, dtype=np.int64)
+    out = _pooled_empty_u8(F * B * 16)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.lp_views_interleave(
+        packed.ctypes.data_as(i32p), packed.shape[1],
+        rows64.ctypes.data_as(i64p), F, B, L, _u8(out),
+        threads or _default_threads(),
+    )
+    return out.reshape(F, B, 16)
+
+
+def assemble_special(
+    buf: np.ndarray,
+    starts: np.ndarray,
+    rows: np.ndarray,
+    span_lens: np.ndarray,
+    fix_flags: np.ndarray,
+    amp_flags: np.ndarray,
+    mode: int,
+    enc_table: np.ndarray,
+    views: np.ndarray,
+    buffer_index: int,
+    threads: int = 0,
+):
+    """Fused side-buffer build + view patch for the Arrow materializer's
+    special rows (URI-repair ``fix`` + ``amp`` query normalization).
+
+    ``buf`` is the [B, L] batch buffer, ``starts`` the column's [B] span
+    starts, ``rows``/``span_lens``/``fix_flags``/``amp_flags`` the
+    per-special-row data, ``views`` the [B, 16] view array patched in
+    place.  Returns (side, side_off, py_flags) — py-flagged rows (exact
+    Python UTF-8 semantics) are zero-length in ``side`` and NOT patched;
+    the caller repairs and patches them itself.  None when the native
+    library (or these entry points) is unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lp_special_scan"):
+        return None
+    n = rows.size
+    L = buf.shape[1]
+    buf_c = np.ascontiguousarray(buf)
+    starts32 = np.ascontiguousarray(starts, dtype=np.int32)
+    rows64 = np.ascontiguousarray(rows, dtype=np.int64)
+    lens64 = np.ascontiguousarray(span_lens, dtype=np.int64)
+    fix_u8 = np.ascontiguousarray(fix_flags, dtype=np.uint8)
+    amp_u8 = np.ascontiguousarray(amp_flags, dtype=np.uint8)
+    enc_c = np.ascontiguousarray(enc_table, dtype=np.uint8)
+    out_lens = np.empty(n, dtype=np.int64)
+    py_flags = np.empty(n, dtype=np.uint8)
+    nthreads = threads or _default_threads()
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.lp_special_scan(
+        _u8(buf_c), L, starts32.ctypes.data_as(i32p),
+        rows64.ctypes.data_as(i64p), lens64.ctypes.data_as(i64p),
+        _u8(fix_u8), _u8(amp_u8), n, mode, _u8(enc_c),
+        out_lens.ctypes.data_as(i64p), _u8(py_flags), nthreads,
+    )
+    side_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(out_lens, out=side_off[1:])
+    side = np.empty(int(side_off[-1]), dtype=np.uint8)
+    lib.lp_special_write(
+        _u8(buf_c), L, starts32.ctypes.data_as(i32p),
+        rows64.ctypes.data_as(i64p), lens64.ctypes.data_as(i64p),
+        _u8(fix_u8), _u8(amp_u8), n, mode, _u8(enc_c),
+        side_off.ctypes.data_as(i64p), _u8(py_flags),
+        _u8(side if len(side) else np.zeros(1, np.uint8)), _u8(views),
+        buffer_index, nthreads,
+    )
+    return side, side_off, py_flags.astype(bool)
 
 
 def _encode_blob_numpy(

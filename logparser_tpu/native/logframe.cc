@@ -416,6 +416,25 @@ void lp_build_views(const uint8_t* buf, int64_t B, int64_t L,
   lp_run(B, threads, work, K);
 }
 
+// The Arrow string_view element encoding (one place — lp_patch_views and
+// lp_special_write both re-point views at side buffers): <= 12 bytes
+// inline zero-padded, longer values as (4-byte prefix, buffer_index,
+// offset).
+static inline void lp_encode_view(uint8_t* v, const uint8_t* src,
+                                  int32_t len, int32_t buffer_index,
+                                  int64_t off) {
+  std::memcpy(v, &len, 4);
+  if (len <= 12) {
+    std::memset(v + 4, 0, 12);
+    std::memcpy(v + 4, src, static_cast<size_t>(len));
+  } else {
+    std::memcpy(v + 4, src, 4);
+    int32_t off32 = static_cast<int32_t>(off);
+    std::memcpy(v + 8, &buffer_index, 4);
+    std::memcpy(v + 12, &off32, 4);
+  }
+}
+
 // Re-point selected rows of a [B, 16] Arrow view array at a side buffer
 // (repaired / overridden values).  rows/side_off are per patch entry;
 // the same inline-vs-reference encoding as lp_build_views.
@@ -423,20 +442,10 @@ void lp_patch_views(const uint8_t* side, const int64_t* side_off,
                     const int64_t* rows, int64_t n_rows,
                     int32_t buffer_index, uint8_t* views) {
   for (int64_t j = 0; j < n_rows; ++j) {
-    uint8_t* v = views + rows[j] * 16;
     int64_t off = side_off[j];
-    int32_t len = static_cast<int32_t>(side_off[j + 1] - off);
-    const uint8_t* src = side + off;
-    std::memcpy(v, &len, 4);
-    if (len <= 12) {
-      std::memset(v + 4, 0, 12);
-      std::memcpy(v + 4, src, len);
-    } else {
-      std::memcpy(v + 4, src, 4);
-      int32_t off32 = static_cast<int32_t>(off);
-      std::memcpy(v + 8, &buffer_index, 4);
-      std::memcpy(v + 12, &off32, 4);
-    }
+    lp_encode_view(views + rows[j] * 16, side + off,
+                   static_cast<int32_t>(side_off[j + 1] - off),
+                   buffer_index, off);
   }
 }
 
@@ -525,6 +534,196 @@ void lp_repair_write(const uint8_t* seg, const int64_t* seg_off, int64_t n,
           }
         }
       }
+    }
+  };
+  lp_run(n, threads, work);
+}
+
+// Device-emitted Arrow views -> host view structs: the TPU executor
+// appends, per span field, 4 int32 rows to its packed output — a merged
+// span word (start | len<<13 | live<<26) and the span's first 12 bytes
+// LE-packed into 3 words (masked beyond len).  This pass interleaves
+// them into [F, B, 16] Arrow string_view structs with streaming stores —
+// the host never touches the [B, L] byte buffer (the whole-buffer
+// prefix gather was the single biggest memory-traffic term of the old
+// host-side builder on a ~6.7 GB/s single-core host).
+void lp_views_interleave(const int32_t* packed, int64_t stride,
+                         const int64_t* field_rows, int64_t F,
+                         int64_t B, int64_t L,
+                         uint8_t* out, int32_t threads) {
+  if (threads < 1) threads = 1;
+  auto work = [&](int64_t flo, int64_t fhi) {
+    for (int64_t f = flo; f < fhi; ++f) {
+      const int32_t* m = packed + field_rows[f] * stride;
+      const int32_t* p0 = m + stride;
+      const int32_t* p1 = p0 + stride;
+      const int32_t* p2 = p1 + stride;
+      uint8_t* o = out + f * B * 16;
+      for (int64_t r = 0; r < B; ++r) {
+        int32_t w = m[r];
+        int32_t v0 = 0, v1 = 0, v2 = 0, v3 = 0;
+        if (w >> 26) {
+          int32_t len = (w >> 13) & 0x1FFF;
+          v0 = len;
+          v1 = p0[r];
+          if (len <= 12) {
+            v2 = p1[r];
+            v3 = p2[r];
+          } else {
+            v2 = 0;  // buffer index: the batch buffer
+            v3 = static_cast<int32_t>(r * L) + (w & 0x1FFF);
+          }
+        }
+#if defined(__SSE2__)
+        // All stores share out's alignment (offsets are 16-multiples);
+        // numpy buffers are 16-aligned in practice, but stay safe.
+        __m128i v = _mm_set_epi32(v3, v2, v1, v0);
+        __m128i* dst = reinterpret_cast<__m128i*>(o + r * 16);
+        if ((reinterpret_cast<uintptr_t>(out) & 15) == 0) {
+          _mm_stream_si128(dst, v);  // write-only output: skip the RFO
+        } else {
+          _mm_storeu_si128(dst, v);
+        }
+#else
+        int32_t* vi = reinterpret_cast<int32_t*>(o + r * 16);
+        vi[0] = v0; vi[1] = v1; vi[2] = v2; vi[3] = v3;
+#endif
+      }
+    }
+  };
+  // weight=B: F is a handful of fields, each B rows of work — without it
+  // the small-n cutoff would pin the pass to one thread on any host.
+  lp_run(F, threads, work, B);
+#if defined(__SSE2__)
+  _mm_sfence();
+#endif
+}
+
+// Fused special-row assembler for the Arrow view materializer: URI-repair
+// (`fix`) and ?->& (`amp`) rows in ONE scan+write pair straight from the
+// [B, L] batch buffer into the side buffer + patched view structs.
+// NOTE: the per-byte repair classification below is a TWIN of
+// lp_repair_scan/lp_repair_write (different source addressing + the i==0
+// amp substitution).  Any semantics change must be applied to BOTH pairs
+// and to arrow_bridge._repair_fix_segments — tests/test_fuzz_differential
+// locks all three against the oracle and fails on divergence.  The
+// Python flow this replaces (gather segments -> repair -> scatter clean +
+// repaired -> patch views) spent more time in numpy indexing and per-call
+// dispatch than in byte work (~1.2 ms/column at 16k rows for ~0.6 MB of
+// bytes).  Per special row j at rows[j]:
+//   - amp_flags[j]: the span's first byte reads '&' (query normalization)
+//     before any repair sees it;
+//   - fix_flags[j]: lp_repair_scan/write semantics apply (mode/enc_table);
+//     rows needing exact Python UTF-8 semantics set py_flags[j] and write
+//     nothing (out_lens[j] = 0; the caller patches them from its own side
+//     buffer);
+//   - otherwise the span bytes copy verbatim.
+// lp_special_write also patches views[rows[j]] with the
+// inline-vs-reference encoding (buffer_index for long values).
+void lp_special_scan(const uint8_t* buf, int64_t L, const int32_t* starts,
+                     const int64_t* rows, const int64_t* span_lens,
+                     const uint8_t* fix_flags, const uint8_t* amp_flags,
+                     int64_t n, int32_t mode, const uint8_t* enc_table,
+                     int64_t* out_lens, uint8_t* py_flags, int32_t threads) {
+  if (threads < 1) threads = 1;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t j = lo; j < hi; ++j) {
+#if defined(__GNUC__)
+      // The span reads jump row-to-row through the [B, L] buffer —
+      // without prefetch each fix row pays a cold DRAM miss (the pass
+      // runs right after a fetch; nothing else streams the buffer).
+      if (j + 8 < hi) {
+        __builtin_prefetch(buf + rows[j + 8] * L + starts[rows[j + 8]]);
+      }
+#endif
+      int64_t len = span_lens[j];
+      if (!fix_flags[j]) {
+        py_flags[j] = 0;
+        out_lens[j] = len;
+        continue;
+      }
+      const uint8_t* s = buf + rows[j] * L + starts[rows[j]];
+      bool amp = amp_flags[j] != 0;
+      bool py = false;
+      int64_t out = len;
+      for (int64_t i = 0; i < len; ++i) {
+        uint8_t c = (i == 0 && amp) ? static_cast<uint8_t>('&') : s[i];
+        if (c >= 0x80) { py = true; break; }
+        if (c == '%' && i + 2 < len && lp_is_hex(s[i + 1]) &&
+            lp_is_hex(s[i + 2])) {
+          if (mode == 0) {
+            int dec = (lp_hex_val(s[i + 1]) << 4) | lp_hex_val(s[i + 2]);
+            if (dec >= 0x80) { py = true; break; }
+            out -= 2;
+            i += 2;
+          }
+        } else if (mode == 1 && (c == '%' || enc_table[c])) {
+          out += 2;
+        }
+      }
+      py_flags[j] = py ? 1 : 0;
+      out_lens[j] = py ? 0 : out;
+    }
+  };
+  lp_run(n, threads, work);
+}
+
+void lp_special_write(const uint8_t* buf, int64_t L, const int32_t* starts,
+                      const int64_t* rows, const int64_t* span_lens,
+                      const uint8_t* fix_flags, const uint8_t* amp_flags,
+                      int64_t n, int32_t mode, const uint8_t* enc_table,
+                      const int64_t* side_off, const uint8_t* py_flags,
+                      uint8_t* side, uint8_t* views, int32_t buffer_index,
+                      int32_t threads) {
+  static const char HEX[] = "0123456789ABCDEF";
+  if (threads < 1) threads = 1;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t j = lo; j < hi; ++j) {
+#if defined(__GNUC__)
+      if (j + 8 < hi) {
+        const uint8_t* p = buf + rows[j + 8] * L + starts[rows[j + 8]];
+        __builtin_prefetch(p);
+        __builtin_prefetch(p + 64);
+      }
+#endif
+      if (py_flags[j]) continue;  // caller patches these rows itself
+      const uint8_t* s = buf + rows[j] * L + starts[rows[j]];
+      int64_t len = span_lens[j];
+      int64_t off = side_off[j];
+      uint8_t* d = side + off;
+      bool amp = amp_flags[j] != 0;
+      if (!fix_flags[j]) {
+        if (len > 0) {
+          std::memcpy(d, s, static_cast<size_t>(len));
+          if (amp) d[0] = '&';
+        }
+      } else {
+        for (int64_t i = 0; i < len; ++i) {
+          uint8_t c = (i == 0 && amp) ? static_cast<uint8_t>('&') : s[i];
+          bool good = c == '%' && i + 2 < len && lp_is_hex(s[i + 1]) &&
+                      lp_is_hex(s[i + 2]);
+          if (mode == 0) {
+            if (good) {
+              *d++ = static_cast<uint8_t>(
+                  (lp_hex_val(s[i + 1]) << 4) | lp_hex_val(s[i + 2]));
+              i += 2;
+            } else {
+              *d++ = c;
+            }
+          } else {
+            if (c == '%' && !good) {
+              *d++ = '%'; *d++ = '2'; *d++ = '5';
+            } else if (c != '%' && enc_table[c]) {
+              *d++ = '%'; *d++ = HEX[c >> 4]; *d++ = HEX[c & 0x0F];
+            } else {
+              *d++ = c;
+            }
+          }
+        }
+      }
+      lp_encode_view(views + rows[j] * 16, side + off,
+                     static_cast<int32_t>(side_off[j + 1] - off),
+                     buffer_index, off);
     }
   };
   lp_run(n, threads, work);

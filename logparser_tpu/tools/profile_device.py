@@ -23,11 +23,12 @@ from .demolog import HEADLINE_FIELDS
 
 
 def profile_parser(
-    parser, lines, iters: int = 5
+    parser, lines, iters: int = 5, views: bool = False
 ) -> Optional[List[Tuple[str, float]]]:
     """Run the parser's fused executor under jax.profiler and return
     [(event name, total_ms)] for the device plane, descending; None when
-    the xplane proto module is unavailable."""
+    the xplane proto module is unavailable.  ``views=True`` profiles the
+    parse_batch product path (device-emitted Arrow view rows included)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -40,7 +41,7 @@ def profile_parser(
         return None
 
     buf, lengths, _ = encode_batch(lines)
-    fn = parser.device_fn()
+    fn = parser.device_views_fn() if views else parser.device_fn()
     if fn is None:
         return []
     jb, jl = jnp.asarray(buf), jnp.asarray(lengths)

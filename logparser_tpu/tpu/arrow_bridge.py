@@ -413,6 +413,19 @@ def _view_column_inputs(result: "BatchResult", field_id: str, buf,
             fix_m &= ~sel
         if amp_m is not None:
             amp_m &= ~sel
+    def sp_tuple(mask):
+        """Per-special-row data for the fused native assembler, in
+        special-row order: (rows, span lens, fix flags, amp flags)."""
+        rows = np.nonzero(mask)[0]
+        return (
+            rows,
+            lens[rows].astype(np.int64),
+            (fix_m[rows].astype(np.uint8) if fix_m is not None
+             else np.zeros(rows.size, dtype=np.uint8)),
+            (amp_m[rows].astype(np.uint8) if amp_m is not None
+             else np.zeros(rows.size, dtype=np.uint8)),
+        )
+
     if fix_m is not None or amp_m is not None:
         special = (
             fix_m if amp_m is None
@@ -420,27 +433,109 @@ def _view_column_inputs(result: "BatchResult", field_id: str, buf,
         )
         lens_main = lens.copy()
         lens_main[special] = -1  # patched from the side buffer
+        # Precomputed (line-invariant, like the masks above) special-row
+        # data.  sp_dev is the reduced set for DEVICE-emitted views:
+        # amp-only rows of <= 12 bytes are fully inline and the device
+        # already rendered their '&', so only fix rows and long amp rows
+        # need the host side buffer.
+        sp = sp_tuple(special)
+        if amp_m is not None:
+            amp_only = amp_m if fix_m is None else (amp_m & ~fix_m)
+            reduced = special & ~(amp_only & (lens <= 12))
+            sp_dev = sp_tuple(reduced) if reduced.any() else None
+        else:
+            sp_dev = sp
     else:
         special = None
         lens_main = lens
-    state = (col, valid, arr_valid, lens, special, fix_m, amp_m,
-             ov_rows, ov_vals)
+        sp = None
+        sp_dev = None
+    state = {
+        "col": col, "valid": valid, "arr_valid": arr_valid, "lens": lens,
+        "special": special, "fix_m": fix_m, "amp_m": amp_m,
+        "ov_rows": ov_rows, "ov_vals": ov_vals, "sp": sp, "sp_dev": sp_dev,
+        # Cached Arrow null bitmap (None = no nulls): packbits per call
+        # was ~7 x 20 us per table on the 1-core host.
+        "null_bitmap": (
+            None if arr_valid.all()
+            else np.packbits(arr_valid, bitorder="little")
+        ),
+    }
     return starts, lens_main, state
 
 
-def _assemble_view_array(result: "BatchResult", buf, starts, views, state):
-    """Side-buffer handling + pa.Array assembly for one view column."""
+def _assemble_view_array(result: "BatchResult", buf, starts, views, state,
+                         dev_views: bool = False):
+    """Side-buffer handling + pa.Array assembly for one view column.
+    ``dev_views`` marks views interleaved from device-emitted rows (short
+    amp-only rows are already rendered inline there)."""
     import pyarrow as pa
 
-    from ..native import copy_spans, patch_views, scatter_spans
+    from ..native import (
+        assemble_special, copy_spans, patch_views, scatter_spans,
+    )
 
-    (col, valid, arr_valid, lens, special, fix_m, amp_m,
-     ov_rows, ov_vals) = state
+    col = state["col"]
+    arr_valid = state["arr_valid"]
+    lens = state["lens"]
+    special = state["special"]
+    fix_m = state["fix_m"]
+    amp_m = state["amp_m"]
+    ov_rows, ov_vals = state["ov_rows"], state["ov_vals"]
+    # Device-emitted views already carry the '&' of short (inline)
+    # amp-only rows — only the reduced special set needs the side buffer.
+    sp = state["sp_dev"] if dev_views else state["sp"]
     B = result.lines_read
     L = buf.shape[1]
     views = np.ascontiguousarray(views.reshape(B, 16))
     variadic = [pa.py_buffer(buf.reshape(-1))]
-    if special is not None:
+    fused = None
+    if special is not None and sp is not None:
+        # Fused native path: ONE scan+write pair builds the side buffer
+        # and patches the views straight from the batch buffer (the
+        # unfused flow below spent ~1.2 ms/column in numpy indexing and
+        # per-call dispatch for ~0.6 MB of actual byte work).
+        sp_rows, sp_lens, sp_fix, sp_amp = sp
+        mode_str = col.get("fix_mode")
+        fused = assemble_special(
+            buf, starts, sp_rows, sp_lens, sp_fix, sp_amp,
+            0 if mode_str in ("path", "userinfo") else 1,
+            _IS_ENC, views, len(variadic),
+        )
+    # dev route with an empty reduced set: every special row was rendered
+    # inline on device; nothing to patch.
+    handled_inline = special is not None and sp is None and dev_views
+    if fused is not None:
+        from .batch import _fix_uri_part
+
+        side, side_off, py_flags = fused
+        variadic.append(pa.py_buffer(side))
+        if py_flags.any():
+            # Exact Python UTF-8 semantics for the flagged rows (non-ASCII
+            # bytes / non-ASCII decode results): amp-normalize, repair,
+            # patch from an extra side buffer.  Twin of the py-row flow in
+            # _repair_fix_segments — change both together (the fuzz suite
+            # locks them against the oracle).
+            sp_rows, sp_lens, sp_fix, sp_amp = sp
+            py_sel = np.nonzero(py_flags)[0]
+            py_vals = []
+            for k in py_sel.tolist():
+                r = int(sp_rows[k])
+                raw = bytes(buf[r, starts[r]: starts[r] + int(sp_lens[k])])
+                if sp_amp[k]:
+                    raw = b"&" + raw[1:]
+                py_vals.append(
+                    _fix_uri_part(
+                        raw.decode("utf-8", "replace"), col["fix_mode"]
+                    ).encode("utf-8")
+                )
+            py_flat = np.frombuffer(b"".join(py_vals), dtype=np.uint8)
+            py_off = np.zeros(len(py_vals) + 1, dtype=np.int64)
+            np.cumsum([len(v) for v in py_vals], out=py_off[1:])
+            patch_views(views, sp_rows[py_sel], py_flat, py_off,
+                        len(variadic))
+            variadic.append(pa.py_buffer(py_flat))
+    elif special is not None and not handled_inline:
         # Single-allocation side-buffer assembly: repair segments gather
         # straight from the batch buffer, then clean-special and repaired
         # rows SCATTER into one final buffer (the former flow copied all
@@ -504,13 +599,11 @@ def _assemble_view_array(result: "BatchResult", buf, starts, views, state):
                     len(variadic))
         variadic.append(pa.py_buffer(ov_flat))
 
-    null_bitmap = (
-        None if arr_valid.all()
-        else pa.py_buffer(np.packbits(arr_valid, bitorder="little"))
-    )
+    nb = state["null_bitmap"]
     arr = pa.Array.from_buffers(
         pa.string_view(), B,
-        [null_bitmap, pa.py_buffer(views)] + variadic,
+        [None if nb is None else pa.py_buffer(nb), pa.py_buffer(views)]
+        + variadic,
     )
     if not result.ascii_only:
         try:
@@ -615,12 +708,35 @@ def _span_view_arrays(result: "BatchResult", field_ids) -> Dict[str, Any]:
     ]
     if not pres:
         return out
-    starts = np.stack([p[1][0] for p in pres])
-    lens = np.stack([p[1][1] for p in pres])
-    views = build_views(buf, starts, lens)
-    for k, (fid, (st, _lm, state)) in enumerate(pres):
-        arr = _assemble_view_array(result, buf, st, views[k], state)
-        out[fid] = arr if arr is not None else _VIEW_FAILED
+    # Columns with device-emitted view rows interleave straight from the
+    # packed fetch (native streaming pass, no [B, L] buffer traffic); the
+    # rest build on host from the stacked starts/lens.
+    dev = [p for p in pres if p[0] in result.device_views]
+    host = [p for p in pres if p[0] not in result.device_views]
+    if dev:
+        from ..native import views_interleave
+
+        field_rows = np.asarray(
+            [result.device_views[fid] for fid, _ in dev], dtype=np.int64
+        )
+        dev_views = views_interleave(result.packed, field_rows, B,
+                                     buf.shape[1])
+        if dev_views is None:
+            host = pres  # no native library: host-built views for all
+        else:
+            if result.dirty_view_rows.size:
+                dev_views[:, result.dirty_view_rows, :] = 0
+            for k, (fid, (st, _lm, state)) in enumerate(dev):
+                arr = _assemble_view_array(result, buf, st, dev_views[k],
+                                           state, dev_views=True)
+                out[fid] = arr if arr is not None else _VIEW_FAILED
+    if host:
+        starts = np.stack([p[1][0] for p in host])
+        lens = np.stack([p[1][1] for p in host])
+        views = build_views(buf, starts, lens)
+        for k, (fid, (st, _lm, state)) in enumerate(host):
+            arr = _assemble_view_array(result, buf, st, views[k], state)
+            out[fid] = arr if arr is not None else _VIEW_FAILED
     return out
 
 

@@ -372,7 +372,21 @@ class BatchResult:
     """Columnar parse result over one batch."""
 
     def __init__(self, lines, buf, lengths, valid, columns, overrides, good, bad,
-                 format_index=None, oracle_rows=0):
+                 format_index=None, oracle_rows=0, packed=None,
+                 device_views=None, dirty_rows=None):
+        # Device-emitted Arrow view rows: `packed` holds ONLY the trailing
+        # view block (4 int32 rows per span field, copied out of the
+        # device fetch); device_views maps field_id -> row index of its
+        # merged span word inside that block (+1..+3 = LE-packed first-12
+        # bytes); the Arrow bridge interleaves them natively.  dirty_rows
+        # marks rows (overflow-truncated lines) whose device views must
+        # be zeroed/patched on host.
+        self.packed = packed
+        self.device_views = device_views or {}
+        self.dirty_view_rows = (
+            dirty_rows if dirty_rows is not None
+            else np.empty(0, dtype=np.int64)
+        )
         # Lines the host oracle had to visit (device-invalid lines plus
         # lines whose winning format left requested fields device-unresolved)
         # — the number bench.py reports as oracle_fraction.
@@ -695,6 +709,7 @@ class TpuBatchParser:
             for u in self.units
         ]
         self._jitted = self._build_jitted()
+        self._jitted_views = None  # lazily built by device_views_fn()
 
     def _build_jitted(self):
         # No point running the device programs when every field is host-only.
@@ -704,6 +719,42 @@ class TpuBatchParser:
         if self.units and any_device_field:
             return build_units_jnp_fn(self.units)
         return None
+
+    def _view_specs(self):
+        """Static spec for device-side Arrow view emission: span-group
+        fields + the units the host would decode each from (the
+        ``_unit_decodable`` rule — other units' lines deliver via oracle
+        overrides, whose views the host patches anyway)."""
+        specs = []
+        for fid in self.requested:
+            if fid.endswith(".*"):
+                continue
+            if self._plan_group(self.plan_by_id[fid]) != "span":
+                continue
+            unit_idx = [
+                ui for ui, u in enumerate(self.units)
+                if not u.plausibility_only and self._unit_decodable(u, fid)
+            ]
+            if unit_idx:
+                specs.append((fid, tuple(unit_idx)))
+        return specs
+
+    def device_views_fn(self):
+        """The executor variant that also emits Arrow view rows (4 int32
+        rows per span field, appended after the unit rows) — the
+        parse_batch product path.  Falls back to the plain executor when
+        no span field is device-decodable."""
+        if self._jitted is None:
+            return None
+        if self._jitted_views is None:
+            specs = self._view_specs()
+            if not specs:
+                self._jitted_views = self._jitted
+                self._views_fields = []
+            else:
+                self._jitted_views = build_units_jnp_fn(self.units, specs)
+                self._views_fields = [fid for fid, _ in specs]
+        return self._jitted_views
 
     def device_fn(self):
         """The fused plain-XLA device executor, or None when every field
@@ -728,6 +779,7 @@ class TpuBatchParser:
             u.layout = PackedLayout.for_plans(u.plans, self.csr_slots)
         assign_row_offsets(self.units)
         self._jitted = self._build_jitted()
+        self._jitted_views = None  # row offsets moved; rebuild lazily
         return True
 
     # ------------------------------------------------------------------
@@ -1235,7 +1287,7 @@ class TpuBatchParser:
         trace = tracer()
         lines, buf, lengths, overflow, B, padded_b = enc
         out = None
-        fn = self.device_fn()
+        fn = self.device_views_fn()
         if fn is not None:
             with trace.stage("device", items=B):
                 out = fn(jnp.asarray(buf), jnp.asarray(lengths))
@@ -1266,7 +1318,7 @@ class TpuBatchParser:
             # result was produced under a stale CSR slot layout (another
             # batch's materialization grew the slots mid-stream).
             if out is None or out_slots != self.csr_slots:
-                fn = self.device_fn()
+                fn = self.device_views_fn()
                 if fn is None:
                     packed = None
                     valid = np.zeros(B, dtype=bool)
@@ -1325,13 +1377,15 @@ class TpuBatchParser:
             valid[i] = False
             winner[i] = -1
             plausible_any[i] = True
-        return lines, buf, lengths, B, packed, valid, winner, plausible_any
+        return (lines, buf, lengths, B, packed, valid, winner,
+                plausible_any, overflow)
 
     def _materialize_packed(self, fetched) -> BatchResult:
         from ..observability import tracer
 
         trace = tracer()
-        lines, buf, lengths, B, packed, valid, winner, plausible_any = fetched
+        (lines, buf, lengths, B, packed, valid, winner, plausible_any,
+         overflow) = fetched
         columns: Dict[str, Dict[str, np.ndarray]] = {}
         zeros_null = np.zeros(B, dtype=bool)
 
@@ -1650,9 +1704,36 @@ class TpuBatchParser:
         )
 
         good = int(B - bad)
+        # Device-emitted Arrow view rows (4 per span field, after the unit
+        # rows): handed to the Arrow bridge, which interleaves them into
+        # string_view structs without touching the byte buffer.  Overflow
+        # rows are flagged dirty — the device judged a truncated prefix,
+        # so its views for those rows are not trustworthy.
+        device_views = None
+        dirty_rows = None
+        view_block = None
+        view_fields = getattr(self, "_views_fields", None)
+        if packed is not None and view_fields:
+            k0 = (
+                self.units[-1].row_offset + self.units[-1].layout.n_rows
+                if self.units else 0
+            )
+            if packed.shape[0] >= k0 + 4 * len(view_fields):
+                # Keep ONLY the trailing view block alive on the result
+                # (contiguous copy): pinning the whole packed fetch would
+                # retain several MB of unit rows the bridge never reads.
+                view_block = packed[k0: k0 + 4 * len(view_fields)].copy()
+                device_views = {
+                    fid: 4 * i for i, fid in enumerate(view_fields)
+                }
+                dirty_rows = np.asarray(
+                    [i for i in overflow if i < B], dtype=np.int64
+                )
         return BatchResult(
             list(lines), buf[:B], lengths[:B], valid, columns, overrides,
             good, bad, format_index=winner[:B], oracle_rows=len(need_oracle),
+            packed=view_block, device_views=device_views,
+            dirty_rows=dirty_rows,
         )
 
     def _materialize_csr(
@@ -2190,6 +2271,7 @@ class TpuBatchParser:
     def __getstate__(self) -> Dict[str, Any]:
         state = self.__dict__.copy()
         state["_jitted"] = None
+        state["_jitted_views"] = None
         state["_oracle_pool"] = None  # worker pools never ship in artifacts
         return state
 
@@ -2207,6 +2289,7 @@ class TpuBatchParser:
         if "_device_covers_all_formats" not in state:  # pre-filter artifacts
             self._device_covers_all_formats = False  # conservatively off
         self._jitted = self._build_jitted()
+        self._jitted_views = None
 
     def to_bytes(self) -> bytes:
         """The compiled parser as a versioned artifact blob (a pickle — see
