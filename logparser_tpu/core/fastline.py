@@ -305,15 +305,31 @@ class _Compiler:
             GeoIPISPDissector,
         )
 
+        from ..dissectors.strftime_stamp import StrfTimeStampDissector
+
         inst = phase.instance
         if isinstance(inst, TimeStampDissector):
             return self._compile_timestamp(inst, input_name)
+        if isinstance(inst, StrfTimeStampDissector):
+            # The strftime wrapper delegates dissect/prepare to its
+            # embedded TimeStampDissector (strftime_stamp.py:210-213), so
+            # the embedded instance carries the layout/locale/wanted set
+            # the timestamp emitter compiles from.
+            return self._compile_timestamp(inst.timestamp_dissector,
+                                           input_name)
         # EXACT types only: AbstractGeoIPDissector is an extension point;
         # a subclass overriding dissect()/extract() (or touching Parsable
         # methods beyond add_dissection) must keep the generic path.
         if type(inst) in (GeoIPCountryDissector, GeoIPCityDissector,
                           GeoIPASNDissector, GeoIPISPDissector):
             return self._compile_geoip(inst, input_name)
+        from ..dissectors.uri import HttpUriDissector
+
+        if type(inst) is HttpUriDissector:
+            # EXACT type: dissect uses only get_parsable_field +
+            # add_dissection with static names (uri.py:217-280); a
+            # subclass overriding dissect keeps the generic path.
+            return self._compile_value_shim(inst, input_name)
         if isinstance(inst, HttpFirstLineDissector):
             return self._compile_firstline(inst, input_name)
         if isinstance(inst, HttpFirstLineProtocolDissector):
@@ -345,6 +361,41 @@ class _Compiler:
                 out(ctx, int(seconds_str) * 1000 + int(millis_str))
             return secms
         return None
+
+    def _compile_value_shim(self, inst, input_name: str) -> Route:
+        """Value-level replay for dissectors whose ``dissect`` touches the
+        Parsable only through get_parsable_field + add_dissection with
+        STATIC output names (contract: outputs ⊆ get_possible_output).
+        Twin of _compile_geoip's shim (that one feeds ``extract`` with
+        looked-up data instead of wrapping a value) — keep their route
+        pre-resolution and dispatch in sync.
+        The dissector's own byte-level code runs unmodified — semantics
+        stay single-sourced — but every emitted value dispatches through
+        precompiled routes (the routing was most of the per-line cost)."""
+        compiler = self
+
+        # Resolve every possible output's route at COMPILE time; the
+        # runtime route() probes below are then memo hits.
+        for out in inst.get_possible_output():
+            ot, _, oname = out.partition(":")
+            compiler.route(input_name, ot, oname)
+
+        class _ValueShim:
+            __slots__ = ("ctx", "value")
+
+            def __init__(self, ctx, value):
+                self.ctx = ctx
+                self.value = value
+
+            def get_parsable_field(self, ftype, name):
+                return ParsedField(ftype, name, self.value)
+
+            def add_dissection(self, base, ftype, name, value):
+                compiler.route(base, ftype, name)(self.ctx, value)
+
+        def shim_emit(ctx: _Ctx, v) -> None:
+            inst.dissect(_ValueShim(ctx, v), input_name)
+        return shim_emit
 
     def _compile_geoip(self, inst, input_name: str) -> Route:
         """Value-level GeoIP replay: the per-line work (IP parse, mmdb

@@ -246,6 +246,29 @@ class ParsedTimestamp:
         )
 
 
+_ZONE_RESOLVE_CACHE: dict = {}
+
+
+def _resolve_zone_cached(name: str) -> Optional[str]:
+    """%Z zone text -> tzdata id (None = unknown): abbreviation table +
+    ZoneInfo validation, memoized — the validation was per-line cost on
+    zone-text layouts and the distinct-name population is tiny."""
+    got = _ZONE_RESOLVE_CACHE.get(name)
+    if got is not None or name in _ZONE_RESOLVE_CACHE:
+        return got
+    zone: Optional[str] = _ZONE_ABBREVIATIONS.get(name.upper(), name)
+    try:
+        from zoneinfo import ZoneInfo
+
+        ZoneInfo(zone)
+    except Exception:
+        zone = None
+    if len(_ZONE_RESOLVE_CACHE) > 4096:  # hostile-corpus bound
+        _ZONE_RESOLVE_CACHE.clear()
+    _ZONE_RESOLVE_CACHE[name] = zone
+    return zone
+
+
 class TimeLayout:
     """A compiled, serializable timestamp layout."""
 
@@ -293,7 +316,8 @@ class TimeLayout:
         """
         parts: List[str] = []
         extractors: List = []  # (kind, field_or_table)
-        for it in self.items:
+        last_index = len(self.items) - 1
+        for i, it in enumerate(self.items):
             kind = it[0]
             if kind == "lit":
                 parts.append(re.escape(it[1]))
@@ -325,7 +349,19 @@ class TimeLayout:
             elif kind == "offset_colon":
                 parts.append(r"(Z|[+-]\d{2}:\d{2})")
                 extractors.append(("offset", None))
-            else:  # zonetext: zone resolution stays on the slow path
+            elif kind == "zonetext" and i == last_index:
+                # Positional check, NOT identity: ("zonetext",) literals
+                # are constant-folded to one shared tuple, so a layout
+                # with two %Z items would pass an `is` test mid-layout.
+                # Zone text as the FINAL item only: the group is greedy
+                # over the same charset the slow parser uses and nothing
+                # follows it, so regex backtracking cannot accept an
+                # input the item-by-item parser rejects.  Zone names
+                # resolve through a cache (abbreviation table + ZoneInfo
+                # validation were ~a third of the per-line cost).
+                parts.append(r"([A-Za-z_/+\-0-9]+)")
+                extractors.append(("zonetext", None))
+            else:  # mid-layout zone text stays on the slow path
                 return None
         return re.compile("".join(parts) + r"\Z", re.IGNORECASE), extractors
 
@@ -483,6 +519,14 @@ class TimeLayout:
                         key, lowered = spec
                         idx = lowered.index(group.lower())
                         fields[key] = idx + 1 if key == "month" else idx
+                    elif kind == "zonetext":
+                        zone = _resolve_zone_cached(group)
+                        if zone is None:
+                            raise TimestampParseError(
+                                f"Text '{s}' could not be parsed: "
+                                f"unknown zone '{group}'"
+                            )
+                        fields["zone"] = zone
                     else:  # offset
                         if group in ("Z", "z"):
                             fields["offset"] = 0
@@ -581,15 +625,11 @@ class TimeLayout:
         if not m:
             raise TimestampParseError(f"Text '{s}' could not be parsed at index {pos}")
         name = m.group(0)
-        zone = _ZONE_ABBREVIATIONS.get(name.upper(), name)
-        try:
-            from zoneinfo import ZoneInfo
-
-            ZoneInfo(zone)
-        except Exception:
+        zone = _resolve_zone_cached(name)
+        if zone is None:
             raise TimestampParseError(
                 f"Text '{s}' could not be parsed: unknown zone '{name}'"
-            ) from None
+            )
         fields["zone"] = zone
         return pos + m.end()
 

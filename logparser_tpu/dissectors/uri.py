@@ -49,7 +49,20 @@ _NUMERIC_ENTITY = re.compile(r"&#(?:[xX]([0-9a-fA-F]+)|([0-9]+));")
 _NAMED_ENTITY = re.compile(r"&([a-zA-Z][a-zA-Z0-9]*);")
 
 
+# Fast-path gate for _encode_bad_uri_chars: any char that is non-ASCII
+# (multi-byte under UTF-8) or in the encode set takes the byte loop;
+# everything else is the identity.
+_NEEDS_ENCODE_RE = re.compile(
+    "[" + re.escape("".join(chr(b) for b in sorted(_ENCODE_BYTES)))
+    + "\u0080-\U0010ffff]"
+)
+
+
 def _encode_bad_uri_chars(s: str) -> str:
+    if _NEEDS_ENCODE_RE.search(s) is None:
+        # Pure-ASCII input with no escapable byte: the byte loop below is
+        # the identity (every byte maps to chr(byte)).
+        return s
     out = []
     for b in s.encode("utf-8"):
         if b in _ENCODE_BYTES:
@@ -228,18 +241,24 @@ class HttpUriDissector(Dissector):
             uri_string = uri_string.replace("&", "?&", 1)
 
         # Fix % signs that are not escape sequences (twice: overlaps).
-        uri_string = _BAD_ESCAPE_PATTERN.sub(r"%25\1", uri_string)
-        uri_string = _BAD_ESCAPE_PATTERN.sub(r"%25\1", uri_string)
+        # Presence gates: every pattern in this repair block requires its
+        # trigger character, so clean URIs skip the regex passes.
+        if "%" in uri_string:
+            uri_string = _BAD_ESCAPE_PATTERN.sub(r"%25\1", uri_string)
+            uri_string = _BAD_ESCAPE_PATTERN.sub(r"%25\1", uri_string)
 
-        # Repair almost-HTML-encoded entities, then unescape HTML4.
-        uri_string = _ALMOST_HTML_ENCODED.sub(r"\1&\2", uri_string)
-        uri_string = _unescape_html4(uri_string)
-        uri_string = _EQUALS_HASH_PATTERN.sub("=", uri_string)
-        uri_string = _HASH_AMP_PATTERN.sub("&", uri_string)
+        if "#" in uri_string:
+            # Repair almost-HTML-encoded entities, then unescape HTML4.
+            uri_string = _ALMOST_HTML_ENCODED.sub(r"\1&\2", uri_string)
+            uri_string = _unescape_html4(uri_string)
+            uri_string = _EQUALS_HASH_PATTERN.sub("=", uri_string)
+            uri_string = _HASH_AMP_PATTERN.sub("&", uri_string)
 
-        # Multiple '#': keep only the last as the fragment marker.
-        while _DOUBLE_HASH_PATTERN.search(uri_string):
-            uri_string = _DOUBLE_HASH_PATTERN.sub(r"~\1#", uri_string)
+            # Multiple '#': keep only the last as the fragment marker.
+            while _DOUBLE_HASH_PATTERN.search(uri_string):
+                uri_string = _DOUBLE_HASH_PATTERN.sub(r"~\1#", uri_string)
+        else:
+            uri_string = _unescape_html4(uri_string)
 
         is_url = True
         try:
