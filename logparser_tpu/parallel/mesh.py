@@ -60,7 +60,7 @@ def data_parallel_runner(program: DeviceProgram, mesh: Mesh):
     return jax.jit(fn, in_shardings=in_shardings)
 
 
-def batch_parallel_runner(units, mesh: Mesh):
+def batch_parallel_runner(units, mesh: Mesh, view_specs=None):
     """The FULL fused field-extraction step under data parallelism:
     jitted fn(buf [B, L], lengths [B]) -> packed [K, B] int32 with the
     batch axis sharded over 'data'.
@@ -70,10 +70,13 @@ def batch_parallel_runner(units, mesh: Mesh):
     stages (firstline/URI splits, timestamps, CSR wildcards, GeoIP joins)
     — exactly what ``TpuBatchParser`` executes per batch.  The per-line
     computation has no cross-line dependency, so XLA partitions it with
-    zero collectives in the hot loop."""
-    from ..tpu.pipeline import units_fn
+    zero collectives in the hot loop.  ``view_specs`` (round 5) appends
+    the device-emitted Arrow view rows, sharded the same way — the
+    parse_batch product path."""
+    from ..tpu.pipeline import units_fn, units_views_fn
 
-    fn = units_fn(units)  # the same executor body TpuBatchParser jits
+    # The same executor body TpuBatchParser jits.
+    fn = units_views_fn(units, view_specs) if view_specs else units_fn(units)
 
     in_shardings = (
         NamedSharding(mesh, P("data", None)),
