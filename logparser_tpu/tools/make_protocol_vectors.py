@@ -1,0 +1,129 @@
+"""Generate the frozen wire-protocol conformance vectors (docs/PROTOCOL.md).
+
+Writes the request byte streams under tests/golden/protocol/ and, with
+``--expected``, computes 01_expected.json by replaying 01 against a live
+ParseService.  The .bin files are FROZEN protocol v1 artifacts: regenerate
+only to add NEW vectors, never to change existing bytes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+GOLDEN_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "tests", "golden", "protocol",
+)
+
+CONFIG = {
+    "log_format": "combined",
+    "fields": [
+        "IP:connection.client.host",
+        "HTTP.QUERYSTRING:request.firstline.uri.query",
+        "BYTES:response.body.bytes",
+        "STRING:request.firstline.uri.query.*",
+    ],
+    "timestamp_format": None,
+}
+
+LINES = [
+    b'1.2.3.4 - - [25/Oct/2015:04:11:25 +0100] "GET /a?x=1&y=%4A HTTP/1.1" '
+    b'200 1234 "http://r.example/" "ua"',
+    b'5.6.7.8 - - [25/Oct/2015:04:11:26 +0100] "GET /b HTTP/1.1" 304 - '
+    b'"-" "ua2"',
+    b'9.9.9.9 - - [25/Oct/2015:04:11:27 +0100] "GET /c? HTTP/1.1" 200 7 '
+    b'"-" "ua3"',
+    b"complete garbage that matches no format",
+]
+
+
+def frame(payload: bytes) -> bytes:
+    return struct.pack(">I", len(payload)) + payload
+
+
+def lines_frame(lines) -> bytes:
+    return frame(struct.pack(">I", len(lines)) + b"\n".join(lines))
+
+
+def build_01() -> bytes:
+    return (
+        frame(json.dumps(CONFIG).encode("utf-8"))
+        + lines_frame(LINES)
+        + frame(struct.pack(">I", 0))  # count=0: empty batch
+        + struct.pack(">I", 0)  # end of session
+    )
+
+
+def build_02() -> bytes:
+    bad = {"log_format": "%{unterminated", "fields": ["IP:connection.client.host"]}
+    return (
+        frame(json.dumps(bad).encode("utf-8"))
+        + lines_frame(LINES[:1])
+        + struct.pack(">I", 0)
+    )
+
+
+def build_03() -> bytes:
+    good_cfg = frame(json.dumps(CONFIG).encode("utf-8"))
+    # count header says 3 but payload has 1 line -> per-request error.
+    broken = frame(struct.pack(">I", 3) + LINES[0])
+    return (
+        good_cfg + broken + lines_frame(LINES[:1]) + struct.pack(">I", 0)
+    )
+
+
+def write_vectors() -> None:
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    for name, blob in (
+        ("01_session_request.bin", build_01()),
+        ("02_bad_config_request.bin", build_02()),
+        ("03_bad_lines_request.bin", build_03()),
+    ):
+        path = os.path.join(GOLDEN_DIR, name)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                if f.read() != blob:
+                    raise SystemExit(
+                        f"{name} exists with DIFFERENT bytes — protocol "
+                        "vectors are frozen; add a new vector instead"
+                    )
+            continue
+        with open(path, "wb") as f:
+            f.write(blob)
+        print("wrote", path)
+
+
+def write_expected() -> None:
+    from logparser_tpu.service import ParseService, read_frame
+
+    import pyarrow as pa
+    import socket
+
+    with ParseService() as svc:
+        with socket.create_connection((svc.host, svc.port)) as sock:
+            with open(os.path.join(GOLDEN_DIR, "01_session_request.bin"),
+                      "rb") as f:
+                sock.sendall(f.read())
+            batches = []
+            for _ in range(2):
+                payload = read_frame(sock)
+                with pa.ipc.open_stream(pa.BufferReader(payload)) as r:
+                    table = r.read_all()
+                batches.append({
+                    col: table[col].to_pylist() for col in table.column_names
+                })
+    out = os.path.join(GOLDEN_DIR, "01_expected.json")
+    with open(out, "w") as f:
+        # Map-column rows arrive as lists of (key, value) tuples;
+        # default=list turns them into JSON [key, value] pairs.
+        json.dump({"batches": batches}, f, indent=1, default=list)
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    import sys
+
+    write_vectors()
+    if "--expected" in sys.argv:
+        write_expected()

@@ -58,6 +58,13 @@ def test_device_matches_oracle_on_hostile_corpus(corpus_lines, parsed):
     # Visible, not hidden: the measured rescue share on REAL attack traffic.
     print(f"\nhackers-access.log oracle_fraction = {frac:.5f} "
           f"({result.oracle_rows}/{len(corpus_lines)} lines)")
+    # And BOUNDED: the corpus is frozen and currently parses fully on
+    # device (fraction 0.0); parity alone would still pass if the device
+    # silently handed every line to the per-line engine.
+    assert frac <= 0.01, (
+        f"device handed {result.oracle_rows}/{len(corpus_lines)} hostile "
+        "lines to the oracle (was 0)"
+    )
 
     oracle_vals = []
     for line in corpus_lines:
