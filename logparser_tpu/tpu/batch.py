@@ -1669,10 +1669,13 @@ class TpuBatchParser:
         # was ~40% of the rescue stage on top of the raw parses, which is
         # exactly the kind of drift the bench's rescue-model validation
         # (combined_rescue config) exists to catch.
-        plan_cache: Dict[Tuple[int, int], Tuple[list, list]] = {}
+        plan_cache: Dict[Tuple[bool, int], Tuple[list, list]] = {}
 
-        def delivery_plan(fields, w):
-            key = (id(fields), w)
+        def delivery_plan(fields, w, is_invalid):
+            # Keyed on what DETERMINES the fields list ((is_invalid, w)),
+            # not its identity — id() is only stable because both lists
+            # happen to be parser-lifetime attributes today.
+            key = (is_invalid, w)
             got = plan_cache.get(key)
             if got is None:
                 concrete, wild = [], []
@@ -1711,7 +1714,9 @@ class TpuBatchParser:
                 continue
             if is_invalid:
                 valid[i] = True
-            concrete, wild = delivery_plan(fields_needed, int(winner[i]))
+            concrete, wild = delivery_plan(
+                fields_needed, int(winner[i]), is_invalid
+            )
             for fid, ov, mode in concrete:
                 v = values.get(fid)
                 if v is None or mode == "plain":
