@@ -143,3 +143,65 @@ def test_native_view_encoding_against_pyarrow():
             if valid[i] else None
         )
         assert got[i] == want, i
+
+
+def test_device_views_present_and_match(monkeypatch):
+    """Round 5: parse_batch emits device view rows; the interleaved
+    columns must equal the host-built views byte-for-byte at the value
+    level (forced by disabling the device-view route for the B side)."""
+    from logparser_tpu import native
+
+    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    lines = generate_combined_lines(256, seed=21, garbage_fraction=0.05)
+    res = parser.parse_batch(lines)
+    assert res.device_views, "device view rows absent on the product path"
+    tv = res.to_arrow()
+    # Host-built comparison: same result object, device views ignored.
+    monkeypatch.setattr(native, "views_interleave", lambda *a, **k: None)
+    res.__dict__.pop("_view_pre", None)
+    th = res.to_arrow()
+    assert tv.to_pylist() == th.to_pylist()
+
+
+def test_device_views_overflow_dirty_rows():
+    """Overflow-truncated lines (devices judged a prefix) are flagged
+    dirty; their device views must not leak truncated-span values."""
+    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    lines = generate_combined_lines(32, seed=22)
+    # An overlong UA blows the 8191-byte line cap -> overflow row.
+    lines[5] = lines[5][:-1] + "x" * 9000 + '"'
+    res = parser.parse_batch(lines)
+    assert res.dirty_view_rows.size >= 1
+    _assert_tables_match(res)
+
+
+def test_device_views_survive_artifact_reload(tmp_path):
+    """A saved/loaded compiled parser rebuilds its views executor lazily
+    and still delivers device-view-backed tables."""
+    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    path = str(tmp_path / "p.lptpu")
+    parser.save(path)
+    loaded = TpuBatchParser.load(path)
+    lines = generate_combined_lines(64, seed=23)
+    res = loaded.parse_batch(lines)
+    assert res.device_views
+    _assert_tables_match(res)
+
+
+def test_device_inline_amp_rendering():
+    """Short (<=12 B) ?->& query rows are rendered inline ON DEVICE (no
+    host side buffer); long amp rows still patch on host — both must
+    read back with the leading '&'."""
+    parser = TpuBatchParser(NGINX, URI_FIELDS)
+    lines = [
+        '1.2.3.4 - - [10/Oct/2023:13:55:36 +0000] '
+        f'"GET {p} HTTP/1.1" 200 5 "-" "ua"'
+        for p in ["/a?q=1", "/b?longquery=" + "v" * 30, "/c?", "/d"]
+    ]
+    res = parser.parse_batch(lines)
+    tv = _assert_tables_match(res)
+    q = tv.column("HTTP.QUERYSTRING:request.firstline.uri.query").to_pylist()
+    assert q[0] == "&q=1"                      # inline, device-rendered
+    assert q[1] == "&longquery=" + "v" * 30    # long, host side buffer
+    assert q[2] == "&"
+    assert q[3] == ""
