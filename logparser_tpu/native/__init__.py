@@ -515,11 +515,17 @@ def repair_spans(seg: np.ndarray, seg_off: np.ndarray, escape_mode: bool,
 # (the kernel itself runs in ~0.18 ms).  An entry is reused only when
 # nothing else holds it — Arrow buffers built on a pooled array keep a
 # reference, so a table still alive blocks reuse (refcount check).
+# The exact-refcount test assumes GIL-serialized refcounting: on a
+# free-threaded build (PEP 703, deferred/biased counts) the pool is
+# disabled and every call allocates fresh.
 _BUF_POOL: Dict[int, np.ndarray] = {}
 _BUF_POOL_MAX = 16
+_BUF_POOL_ENABLED = getattr(sys, "_is_gil_enabled", lambda: True)()
 
 
 def _pooled_empty_u8(n: int) -> np.ndarray:
+    if not _BUF_POOL_ENABLED:
+        return np.empty(n, dtype=np.uint8)
     arr = _BUF_POOL.get(n)
     # 3 == dict entry + local binding + getrefcount argument: sole owner.
     if arr is not None and sys.getrefcount(arr) == 3:
@@ -615,6 +621,11 @@ def assemble_special(
     )
     side_off = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(out_lens, out=side_off[1:])
+    if int(side_off[-1]) >= 2**31:
+        # lp_encode_view stores int32 offsets; a >2 GiB side buffer
+        # (mode-1 repair can expand bytes 3x) would wrap them.  Callers
+        # route the column to the copy path, which has its own guard.
+        return "overflow"
     side = np.empty(int(side_off[-1]), dtype=np.uint8)
     lib.lp_special_write(
         _u8(buf_c), L, starts32.ctypes.data_as(i32p),

@@ -508,6 +508,10 @@ def _assemble_view_array(result: "BatchResult", buf, starts, views, state,
             0 if mode_str in ("path", "userinfo") else 1,
             _IS_ENC, views, len(variadic),
         )
+    if fused == "overflow":
+        # >2 GiB side buffer would wrap the int32 view offsets: the
+        # column takes the copy path (which guards offsets itself).
+        return None
     # dev route with an empty reduced set: every special row was rendered
     # inline on device; nothing to patch.
     handled_inline = special is not None and sp is None and dev_views
@@ -576,6 +580,8 @@ def _assemble_view_array(result: "BatchResult", buf, starts, views, state,
             new_lens[fix_sub] = rep_lens
         sub_off = np.zeros(rows.size + 1, dtype=np.int64)
         np.cumsum(new_lens, out=sub_off[1:])
+        if int(sub_off[-1]) >= 2**31:
+            return None  # int32 view offsets would wrap: copy path
         sub = np.empty(int(sub_off[-1]), dtype=np.uint8)
         if fix_sub.size:
             nonfix = np.ones(rows.size, dtype=bool)

@@ -62,6 +62,25 @@ _FieldPlan = FieldPlan
 _OCTET_STRINGS = np.array([str(i) for i in range(256)], dtype=object)
 
 
+def _apply_setter_casts(value, has_long: bool, has_double: bool):
+    """LONG-then-DOUBLE setter-cast fallthrough (the reference's
+    setter-signature dispatch, Parser.store's Long/Double/String setter
+    preference).  SINGLE home for the ladder — used by both
+    _coerce_casts (remapped sub-dissection deliveries) and the oracle
+    delivery plan, which must type identical values identically."""
+    if has_long:
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            pass
+    if has_double:
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            pass
+    return value
+
+
 def _fix_uri_part(value: str, mode: str) -> str:
     """Per-row URI micro-materialization for device `fix` rows: the exact
     host repair semantics, applied to one sub-span instead of re-parsing
@@ -1727,23 +1746,7 @@ class TpuBatchParser:
                     except (TypeError, ValueError):
                         ov[i] = None
                 else:  # setter casts: LONG then DOUBLE then raw
-                    has_long, has_double = mode
-                    out_v = v
-                    if has_long:
-                        try:
-                            out_v = int(v)
-                        except (TypeError, ValueError):
-                            if has_double:
-                                try:
-                                    out_v = float(v)
-                                except (TypeError, ValueError):
-                                    pass
-                    elif has_double:
-                        try:
-                            out_v = float(v)
-                        except (TypeError, ValueError):
-                            pass
-                    ov[i] = out_v
+                    ov[i] = _apply_setter_casts(v, mode[0], mode[1])
             for fid, ov, prefix in wild:
                 # Wildcard target: deliver {relative.name: value} built
                 # from every concrete field under the prefix (the oracle
@@ -2032,16 +2035,7 @@ class TpuBatchParser:
         casts = self._host_casts.get(fid)
         if casts is not None and value is not None:
             has_long, has_double = self._cast_flags.get(fid, (False, False))
-            if has_long:
-                try:
-                    return int(value)
-                except (TypeError, ValueError):
-                    pass
-            if has_double:
-                try:
-                    return float(value)
-                except (TypeError, ValueError):
-                    pass
+            return _apply_setter_casts(value, has_long, has_double)
         return value
 
     @staticmethod
