@@ -194,13 +194,25 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                         "LINES frame declared 0 lines but carries "
                         f"{len(lines_frame) - 4} payload bytes"
                     )
-                lines = lines_frame[4:].split(b"\n") if count else []
-                if len(lines) != count:
+                blob = lines_frame[4:]
+                n_lines = (blob.count(b"\n") + 1) if count else 0
+                if n_lines != count:
                     raise ValueError(
                         f"LINES frame declared {count} lines, payload has "
-                        f"{len(lines)}"
+                        f"{n_lines}"
                     )
-                result = parser.parse_batch(lines)
+                if count and blob and not blob.endswith(b"\n") \
+                        and b"\r" not in blob:
+                    # (an empty blob is one empty LINE per the protocol,
+                    # which blob framing would drop — split path below)
+                    # Common case: the payload IS the framer's input shape
+                    # (no trailing newline, no carriage returns), so the
+                    # blob ingest path applies — no Python line list.
+                    result = parser.parse_blob(blob)
+                else:
+                    result = parser.parse_batch(
+                        blob.split(b"\n") if count else []
+                    )
                 # Copy mode for the wire: IPC does not dedupe shared
                 # buffers, so string_view columns would each ship a full
                 # copy of the batch buffer.
@@ -213,7 +225,7 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                     writer.write_table(table)
                 write_frame(sock, sink.getvalue().to_pybytes())
             except Exception as e:  # noqa: BLE001 — keep the session alive
-                LOG.exception("parse_batch failed")
+                LOG.exception("parse failed")
                 try:
                     write_error(sock, f"parse failed: {e}")
                 except OSError:

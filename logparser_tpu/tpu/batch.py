@@ -388,6 +388,59 @@ class _LazyWildcard:
         return arr
 
 
+class _BlobLines:
+    """Lazy per-line view of a newline-delimited blob: the batch ingest
+    path never builds a Python line list — rows materialize as bytes only
+    when indexed (oracle-rescued rows, debugging).  Framing semantics are
+    exactly :func:`logparser_tpu.native.encode_blob`'s: a final empty
+    segment after a trailing newline is dropped and one trailing ``\\r``
+    per line is stripped."""
+
+    __slots__ = ("_blob", "_n", "_starts", "_ends")
+
+    def __init__(self, blob: bytes):
+        self._blob = blob
+        # Cheap length only (one C-level count); the per-line index
+        # arrays build lazily on first access — almost no row ever
+        # materializes (only oracle-rescued ones).
+        if not blob:
+            self._n = 0
+        elif blob.endswith(b"\n"):
+            self._n = blob.count(b"\n")
+        else:
+            self._n = blob.count(b"\n") + 1
+        self._starts = None
+        self._ends = None
+
+    def _index(self):
+        if self._starts is None:
+            blob = self._blob
+            arr = np.frombuffer(blob, dtype=np.uint8)
+            nl = np.flatnonzero(arr == 0x0A)
+            starts = np.concatenate([[0], nl + 1]).astype(np.int64)
+            ends = np.concatenate([nl, [len(blob)]]).astype(np.int64)
+            if blob.endswith(b"\n"):
+                starts = starts[:-1]
+                ends = ends[:-1]
+            cr = (arr[np.maximum(ends - 1, 0)] == 0x0D) & (ends > starts)
+            self._starts = starts
+            self._ends = ends - cr
+        return self._starts, self._ends
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        starts, ends = self._index()
+        return self._blob[starts[i]: ends[i]]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
 class BatchResult:
     """Columnar parse result over one batch."""
 
@@ -1246,6 +1299,38 @@ class TpuBatchParser:
     def parse_batch(self, lines: Sequence[Union[bytes, str]]) -> BatchResult:
         return self._finish_batch(self._start_batch(lines))
 
+    def parse_blob(self, data: Union[bytes, bytearray, memoryview]) -> BatchResult:
+        """Newline-delimited log bytes -> BatchResult without building a
+        Python line list: the native framer packs the padded [B, L]
+        buffer straight from the blob, and per-line bytes materialize
+        lazily — only for oracle-rescued rows.  The product ingest path
+        (the sidecar's LINES payload and file readers are exactly this
+        shape; reference analogue: the Hadoop text input path hands raw
+        line Writables to the parser,
+        ApacheHttpdLogfileInputFormat.java:1).
+
+        Framing semantics are encode_blob's: a final empty segment after
+        a trailing newline is dropped, and one trailing ``\\r`` per line
+        is stripped — callers needing exact list semantics for such
+        inputs use :meth:`parse_batch`."""
+        from ..native import encode_blob
+        from ..observability import tracer
+
+        trace = tracer()
+        data = bytes(data)
+        lines = _BlobLines(data)
+        B = len(lines)
+        with trace.stage("encode", items=B):
+            buf, lengths, overflow = encode_blob(data)
+        if buf.shape[0] != B:  # framer/view disagreement: authoritative path
+            return self.parse_batch(list(lines))
+        padded_b = _bucket_batch(B)
+        if padded_b != B:
+            buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
+            lengths = np.pad(lengths, (0, padded_b - B))
+        enc = (lines, buf, lengths, overflow, B, padded_b)
+        return self._finish_batch(self._dispatch_batch(enc))
+
     def parse_batch_stream(
         self,
         batches,
@@ -1788,7 +1873,9 @@ class TpuBatchParser:
                     [i for i in overflow if i < B], dtype=np.int64
                 )
         return BatchResult(
-            list(lines), buf[:B], lengths[:B], valid, columns, overrides,
+            # _encode_batch already listed the caller's lines; _BlobLines
+            # stays lazy (its rows materialize only when indexed).
+            lines, buf[:B], lengths[:B], valid, columns, overrides,
             good, bad, format_index=winner[:B], oracle_rows=len(need_oracle),
             packed=view_block, device_views=device_views,
             dirty_rows=dirty_rows,

@@ -625,3 +625,67 @@ def test_single_char_token_width_enforced():
         assert user[i] == rec.values.get("STRING:connection.client.user")
         assert pipe[i] == rec.values.get("STRING:connection.nginx.pipe")
     assert user[0] == "example.com -"  # the regex's greedy-backtrack answer
+
+
+class TestParseBlob:
+    """parse_blob: the list-free ingest path must deliver identically to
+    parse_batch over the same framing."""
+
+    def _parser(self):
+        from logparser_tpu.tools.demolog import HEADLINE_FIELDS
+
+        return TpuBatchParser("combined", HEADLINE_FIELDS)
+
+    def test_blob_equals_batch(self):
+        from logparser_tpu.tools.demolog import generate_combined_lines
+
+        parser = self._parser()
+        lines = generate_combined_lines(96, seed=31, garbage_fraction=0.05)
+        blob = "\n".join(lines).encode("utf-8")
+        rb = parser.parse_blob(blob)
+        rl = parser.parse_batch(lines)
+        assert rb.lines_read == rl.lines_read
+        assert rb.to_dict() == rl.to_dict()
+        tb = rb.to_arrow()
+        tl = rl.to_arrow()
+        assert tb.to_pylist() == tl.to_pylist()
+
+    def test_blob_lazy_lines_and_oracle_rescue(self):
+        from logparser_tpu.tools.demolog import generate_combined_lines
+
+        parser = self._parser()
+        lines = generate_combined_lines(32, seed=32)
+        # >18-digit %b: plausible but device-rejected -> oracle rescue
+        # must materialize THAT line from the blob.
+        lines[9] = ('9.9.9.9 - x [10/Oct/2023:13:55:36 -0700] '
+                    '"GET /r HTTP/1.0" 200 123456789012345678901 "-" "u"')
+        blob = "\n".join(lines).encode("utf-8")
+        res = parser.parse_blob(blob)
+        assert res.oracle_rows >= 1
+        vals = res.to_pylist("BYTES:response.body.bytes")
+        assert vals[9] == 123456789012345678901
+
+    def test_blob_framing_edges(self):
+        parser = self._parser()
+        ok = ('1.2.3.4 - - [10/Oct/2023:13:55:36 +0000] '
+              '"GET /x HTTP/1.1" 200 5 "-" "ua"')
+        # Trailing newline: final empty segment dropped (encode_blob
+        # semantics); \r stripped; empty middle line is a (bad) row.
+        blob = (ok + "\r\n" + "\n" + ok + "\n").encode("utf-8")
+        res = parser.parse_blob(blob)
+        assert res.lines_read == 3
+        ips = res.to_pylist("IP:connection.client.host")
+        assert ips == ["1.2.3.4", None, "1.2.3.4"]
+        assert parser.parse_blob(b"").lines_read == 0
+
+    def test_blob_overflow_line(self):
+        parser = self._parser()
+        ok = ('1.2.3.4 - - [10/Oct/2023:13:55:36 +0000] '
+              '"GET /x HTTP/1.1" 200 5 "-" "ua"')
+        huge = ok[:-1] + "x" * 9000 + '"'
+        blob = (ok + "\n" + huge).encode("utf-8")
+        res = parser.parse_blob(blob)
+        assert res.lines_read == 2
+        # The overflow row re-parses from the FULL blob bytes on host.
+        ua = res.to_pylist("HTTP.USERAGENT:request.user-agent")
+        assert ua[1] is not None and ua[1].endswith("x" * 20 + '')
