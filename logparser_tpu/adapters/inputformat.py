@@ -153,12 +153,16 @@ class LogfileInputFormat:
         type_remappings: Optional[Dict[str, Any]] = None,
         extra_dissectors: Optional[Sequence[Any]] = None,
         batch_size: int = DEFAULT_BATCH,
+        assembly_workers: Optional[int] = None,
     ):
         self.log_format = log_format
         self.requested_fields = list(requested_fields or [])
         self.type_remappings = dict(type_remappings or {})
         self.extra_dissectors = list(extra_dissectors or [])
         self.batch_size = batch_size
+        # Host-side delivery parallelism, forwarded to the shared parser
+        # (None = auto).
+        self.assembly_workers = assembly_workers
 
     @classmethod
     def from_config(cls, config: Dict[str, str], **kwargs) -> "LogfileInputFormat":
@@ -208,6 +212,10 @@ class LogfileInputFormat:
                 self.requested_fields,
                 type_remappings=self.type_remappings,
                 extra_dissectors=self.extra_dissectors,
+                # Record readers deliver ParsedRecords, never string_view
+                # Arrow columns: device view emission is pure waste here.
+                view_fields=(),
+                assembly_workers=self.assembly_workers,
             )
             self._shared_parser = parser
         return parser

@@ -90,6 +90,7 @@ class Loader:
         self.special_parameters: List[str] = []
         self.only_want_list_of_fields = False
         self.is_building_example = False
+        self.assembly_workers: Optional[int] = None
         self.counters = Counters()
 
         for param in parameters:
@@ -116,6 +117,18 @@ class Loader:
                     load_dissector_by_name(parts[1], parts[2])
                 )
                 continue
+            if param.startswith("-workers:"):
+                # String-protocol extension (loaders only take strings,
+                # Loader.java:90-96): host-side Arrow/record assembly
+                # parallelism for the worker parser.
+                value = param.split(":", 1)[1]
+                if not value.isdigit() or int(value) < 1:
+                    raise ValueError(
+                        f"Found workers with bad parameter:{param}"
+                    )
+                self.special_parameters.append(param)
+                self.assembly_workers = int(value)
+                continue
             if param.lower() == FIELDS_MAGIC:
                 self.only_want_list_of_fields = True
                 self.requested_fields.append(FIELDS_MAGIC)
@@ -137,6 +150,7 @@ class Loader:
             self.requested_fields,
             type_remappings={k: set(v) for k, v in self.type_remappings.items()},
             extra_dissectors=list(self.additional_dissectors),
+            assembly_workers=self.assembly_workers,
         )
 
     # ------------------------------------------------------------------
@@ -155,6 +169,7 @@ class Loader:
             self.requested_fields,
             type_remappings={k: set(v) for k, v in self.type_remappings.items()},
             extra_dissectors=list(self.additional_dissectors),
+            assembly_workers=self.assembly_workers,
         )
 
     def _metadata_parser(self, targets: Optional[Sequence[str]] = None):

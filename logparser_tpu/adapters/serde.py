@@ -97,6 +97,8 @@ class LogDeserializer:
             fields,
             type_remappings=type_remappings,
             extra_dissectors=extra_dissectors,
+            # Row-object delivery: device Arrow view rows are never read.
+            view_fields=(),
         )
         self._field_ids = list(self.parser.requested)
         self.lines_input = 0

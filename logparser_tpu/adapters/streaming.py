@@ -33,12 +33,20 @@ class ParserConfig:
     type_remappings: Dict[str, Any] = field(default_factory=dict)
     micro_batch_size: int = DEFAULT_MICRO_BATCH
     circuit_breaker: bool = False
+    # Host-side Arrow assembly parallelism (None = auto); forwarded to
+    # the worker parser so engine deployments can pin it per task slot.
+    assembly_workers: Optional[int] = None
 
     def build_parser(self):
         from ..tpu.batch import TpuBatchParser
 
         return TpuBatchParser(
-            self.log_format, self.fields, type_remappings=self.type_remappings
+            self.log_format, self.fields,
+            type_remappings=self.type_remappings,
+            # The record surface never delivers string_view columns, so
+            # the device never needs to emit Arrow view rows here.
+            view_fields=(),
+            assembly_workers=self.assembly_workers,
         )
 
 

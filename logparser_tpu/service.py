@@ -12,7 +12,9 @@ Wire protocol (deliberately trivial to implement from any language):
     frame     := u32 big-endian length, then `length` payload bytes
     session   := CONFIG frame, then any number of [LINES frame -> ARROW frame]
     CONFIG    := JSON {"log_format": str, "fields": [str, ...],
-                       "timestamp_format": str|null}
+                       "timestamp_format": str|null,
+                       "assembly_workers": int|null (optional; host-side
+                       Arrow assembly parallelism, default auto)}
     LINES     := u32 big-endian line count, then the loglines joined by '\n'
                  (UTF-8).  Loglines cannot contain '\n' — they are lines.
                  count=0 means an empty batch (an empty ARROW table comes
@@ -115,6 +117,7 @@ class _ParserCache:
             config["log_format"],
             tuple(config["fields"]),
             config.get("timestamp_format"),
+            config.get("assembly_workers"),
         )
         # Compile outside the global lock: a cold compile takes seconds and
         # must not stall sessions whose parser is already cached.  A per-key
@@ -136,6 +139,10 @@ class _ParserCache:
                         config["log_format"],
                         list(config["fields"]),
                         timestamp_format=config.get("timestamp_format"),
+                        # The wire delivers copy-mode Arrow only, so the
+                        # parser never needs device view rows.
+                        view_fields=(),
+                        assembly_workers=config.get("assembly_workers"),
                     )
                     with self._lock:
                         self._parsers[key] = parser
@@ -208,10 +215,13 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                     # Common case: the payload IS the framer's input shape
                     # (no trailing newline, no carriage returns), so the
                     # blob ingest path applies — no Python line list.
-                    result = parser.parse_blob(blob)
+                    # emit_views=False: the wire ships copy-mode Arrow,
+                    # so device view rows would be wasted kernel + D2H.
+                    result = parser.parse_blob(blob, emit_views=False)
                 else:
                     result = parser.parse_batch(
-                        blob.split(b"\n") if count else []
+                        blob.split(b"\n") if count else [],
+                        emit_views=False,
                     )
                 # Copy mode for the wire: IPC does not dedupe shared
                 # buffers, so string_view columns would each ship a full

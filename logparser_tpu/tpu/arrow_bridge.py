@@ -471,10 +471,12 @@ def _view_column_inputs(result: "BatchResult", field_id: str, buf,
 
 
 def _assemble_view_array(result: "BatchResult", buf, starts, views, state,
-                         dev_views: bool = False):
+                         dev_views: bool = False, threads: int = 0):
     """Side-buffer handling + pa.Array assembly for one view column.
     ``dev_views`` marks views interleaved from device-emitted rows (short
-    amp-only rows are already rendered inline there)."""
+    amp-only rows are already rendered inline there).  ``threads`` caps
+    the native side-buffer fan-out (pooled per-column callers pass 1 so
+    the column-level parallelism supplies the concurrency)."""
     import pyarrow as pa
 
     from ..native import (
@@ -506,7 +508,7 @@ def _assemble_view_array(result: "BatchResult", buf, starts, views, state,
         fused = assemble_special(
             buf, starts, sp_rows, sp_lens, sp_fix, sp_amp,
             0 if mode_str in ("path", "userinfo") else 1,
-            _IS_ENC, views, len(variadic),
+            _IS_ENC, views, len(variadic), threads=threads,
         )
     if fused == "overflow":
         # >2 GiB side buffer would wrap the int32 view offsets: the
@@ -655,10 +657,13 @@ def _spans_to_view_array(result: "BatchResult", field_id: str):
     return _assemble_view_array(result, buf, starts, views, state)
 
 
-def _span_view_arrays(result: "BatchResult", field_ids) -> Dict[str, Any]:
+def _span_view_arrays(result: "BatchResult", field_ids,
+                      pool=None) -> Dict[str, Any]:
     """Batched view materialization: ONE native lp_build_views call
     covers every eligible span column (the per-call thread-pool spawn
-    dominated per-column builds).  Ineligible columns are absent."""
+    dominated per-column builds), then the per-column side-buffer
+    assembly fans out over ``pool`` (tpu/hostpool.py).  Ineligible
+    columns are absent."""
     import pyarrow as pa
 
     from ..native import build_views
@@ -722,9 +727,23 @@ def _span_view_arrays(result: "BatchResult", field_ids) -> Dict[str, Any]:
         return out
     # Columns with device-emitted view rows interleave straight from the
     # packed fetch (native streaming pass, no [B, L] buffer traffic); the
-    # rest build on host from the stacked starts/lens.
+    # rest build on host from the stacked starts/lens.  The batched
+    # native passes take the pool's full thread budget; the per-column
+    # assemblies then fan out over the pool with single-threaded native
+    # calls (hostpool contract: the two layers never oversubscribe).
+    from .hostpool import MIN_POOLED_ROWS, VIEW_POOL_MIN_WORKERS
+
+    use_pool = (
+        pool is not None
+        and pool.workers >= VIEW_POOL_MIN_WORKERS
+        and B >= MIN_POOLED_ROWS
+    )
+    n_threads = pool.native_threads if pool is not None else 0
+    task_threads = 1 if use_pool else n_threads
     dev = [p for p in pres if p[0] in result.device_views]
     host = [p for p in pres if p[0] not in result.device_views]
+    tasks = []
+    task_fids = []
     if dev:
         from ..native import views_interleave
 
@@ -732,23 +751,34 @@ def _span_view_arrays(result: "BatchResult", field_ids) -> Dict[str, Any]:
             [result.device_views[fid] for fid, _ in dev], dtype=np.int64
         )
         dev_views = views_interleave(result.packed, field_rows, B,
-                                     buf.shape[1])
+                                     buf.shape[1], threads=n_threads)
         if dev_views is None:
             host = pres  # no native library: host-built views for all
         else:
             if result.dirty_view_rows.size:
                 dev_views[:, result.dirty_view_rows, :] = 0
             for k, (fid, (st, _lm, state)) in enumerate(dev):
-                arr = _assemble_view_array(result, buf, st, dev_views[k],
-                                           state, dev_views=True)
-                out[fid] = arr if arr is not None else _VIEW_FAILED
+                tasks.append(
+                    lambda st=st, v=dev_views[k], state=state:
+                    _assemble_view_array(result, buf, st, v, state,
+                                         dev_views=True,
+                                         threads=task_threads)
+                )
+                task_fids.append(fid)
     if host:
         starts = np.stack([p[1][0] for p in host])
         lens = np.stack([p[1][1] for p in host])
-        views = build_views(buf, starts, lens)
+        views = build_views(buf, starts, lens, threads=n_threads)
         for k, (fid, (st, _lm, state)) in enumerate(host):
-            arr = _assemble_view_array(result, buf, st, views[k], state)
-            out[fid] = arr if arr is not None else _VIEW_FAILED
+            tasks.append(
+                lambda st=st, v=views[k], state=state:
+                _assemble_view_array(result, buf, st, v, state,
+                                     threads=task_threads)
+            )
+            task_fids.append(fid)
+    arrs = pool.run_all(tasks) if use_pool else [t() for t in tasks]
+    for fid, arr in zip(task_fids, arrs):
+        out[fid] = arr if arr is not None else _VIEW_FAILED
     return out
 
 
@@ -918,7 +948,7 @@ def _column_to_arrow(
 
 def batch_to_arrow(
     result: "BatchResult", include_validity: bool = True,
-    strings: str = "view",
+    strings: str = "view", pool=None,
 ):
     """BatchResult -> pyarrow.Table (one column per requested field).
 
@@ -926,27 +956,83 @@ def batch_to_arrow(
     string_view arrays referencing the batch buffer zero-copy — the table
     shares the batch's memory (kept alive by the Arrow buffers).
     ``strings="copy"`` builds classic contiguous StringArrays instead
-    (self-contained value buffers; the pre-round-4 behavior)."""
+    (self-contained value buffers; the pre-round-4 behavior).
+
+    ``pool`` (default: the result's attached assembly pool) fans the
+    per-column assembly across worker threads: span and numeric columns
+    are independent numpy/pyarrow/native work that releases the GIL, so
+    they parallelize; wildcard/obj/fallback columns share mutable
+    per-result caches and stay on the caller thread.  A 1-wide pool is
+    exactly the serial path (thread-count parity is a tested contract)."""
     import pyarrow as pa
 
-    # In copy mode one threaded multi-column gather covers every
-    # flat-eligible span column; in view mode one batched native view
-    # build covers them instead (no byte gather at all).
+    from .hostpool import MIN_POOLED_ROWS, VIEW_POOL_MIN_WORKERS
+
+    if pool is None:
+        pool = getattr(result, "assembly_pool", None)
+    # Mode-dependent engage rule (measured, see hostpool.py): copy-mode
+    # columns are one big GIL-released native gather each — they pool
+    # from 2 workers; view-mode columns are GIL-holding assembly and
+    # need more workers to win.
+    pooled = (
+        pool is not None
+        and result.lines_read >= MIN_POOLED_ROWS
+        and pool.workers >= (
+            VIEW_POOL_MIN_WORKERS if strings == "view" else 2
+        )
+    )
+    result.ascii_only  # compute the lazy batch-wide check once, serially
     span_fids = [f for f in result.field_ids() if not f.endswith(".*")]
     if strings == "view":
         flats: Dict[str, Any] = {}
-        prebuilt = _span_view_arrays(result, span_fids)
+        prebuilt = _span_view_arrays(result, span_fids, pool=pool)
     else:
-        flats = result.span_bytes_many(span_fids, include_fix=True)
         prebuilt = {}
-    arrays = []
-    names = []
-    for field_id in result.field_ids():
-        arrays.append(_column_to_arrow(
-            result, field_id, flats.get(field_id), strings=strings,
+        if pooled:
+            # Per-column gathers fan out over the pool below: each column
+            # gathers into its OWN buffer (native threads=1; concurrency
+            # comes from the column fan-out), so the per-column re-copy
+            # the shared multi-gather buffer forced in
+            # _spans_to_string_array disappears.
+            flats = {}
+        else:
+            flats = result.span_bytes_many(span_fids, include_fix=True)
+
+    def build_column(field_id):
+        flat = flats.get(field_id)
+        if (
+            strings == "copy" and pooled and flat is None
+            and not field_id.endswith(".*")
+            and result.column(field_id)["kind"] == "span"
+        ):
+            flat = result.span_bytes(field_id, include_fix=True, threads=1)
+        return _column_to_arrow(
+            result, field_id, flat, strings=strings,
             prebuilt=prebuilt.get(field_id),
-        ))
-        names.append(field_id)
+        )
+
+    fids = result.field_ids()
+    # Columns safe to assemble concurrently: span/numeric device columns
+    # (own arrays, read-only shared state).  Wildcard maps (_LazyWildcard
+    # materialization), obj columns (shared vocab cache) and anything
+    # else run serially on the caller thread.
+    parallel_ok = {
+        fid for fid in fids
+        if not fid.endswith(".*")
+        and result.column(fid)["kind"] in ("span", "numeric")
+    }
+    by_fid: Dict[str, Any] = {}
+    if pooled and len(parallel_ok) > 1:
+        par = [fid for fid in fids if fid in parallel_ok]
+        arrs = pool.run_all(
+            [lambda f=fid: build_column(f) for fid in par]
+        )
+        by_fid.update(zip(par, arrs))
+    for field_id in fids:
+        if field_id not in by_fid:
+            by_fid[field_id] = build_column(field_id)
+    arrays = [by_fid[fid] for fid in fids]
+    names = list(fids)
     if include_validity:
         arrays.append(pa.array(np.asarray(result.valid, dtype=bool)))
         names.append("__valid__")
@@ -970,12 +1056,20 @@ def table_from_ipc_bytes(data: bytes):
         return reader.read_all()
 
 
-def parse_to_ipc(parser, lines: Sequence[Any]) -> bytes:
+def parse_to_ipc(parser, lines) -> bytes:
     """One-call sidecar surface: lines in, Arrow IPC stream bytes out.
+
+    ``lines`` is a sequence of loglines, or a newline-delimited bytes
+    blob (routed through the list-free ``parse_blob`` ingest).
 
     Serialization uses the contiguous copy mode: IPC does not dedupe
     shared buffers, so a string_view table would ship one copy of the
-    whole batch buffer PER span column over the wire."""
-    return table_to_ipc_bytes(
-        batch_to_arrow(parser.parse_batch(lines), strings="copy")
-    )
+    whole batch buffer PER span column over the wire.  Because no
+    string_view column is ever delivered, the device view-row emission
+    is skipped too (demand-driven: the view rows would be pure kernel
+    and D2H cost on this path)."""
+    if isinstance(lines, (bytes, bytearray, memoryview)):
+        result = parser.parse_blob(lines, emit_views=False)
+    else:
+        result = parser.parse_batch(lines, emit_views=False)
+    return table_to_ipc_bytes(batch_to_arrow(result, strings="copy"))

@@ -446,7 +446,11 @@ class BatchResult:
 
     def __init__(self, lines, buf, lengths, valid, columns, overrides, good, bad,
                  format_index=None, oracle_rows=0, packed=None,
-                 device_views=None, dirty_rows=None):
+                 device_views=None, dirty_rows=None, assembly_pool=None):
+        # Shared delivery-path worker pool (tpu/hostpool.py): to_arrow's
+        # per-column assembly and the native memcpy fan-outs read their
+        # parallelism from it.  None = serial (the pre-pool behavior).
+        self.assembly_pool = assembly_pool
         # Device-emitted Arrow view rows: `packed` holds ONLY the trailing
         # view block (4 int32 rows per span field, copied out of the
         # device fetch); device_views maps field_id -> row index of its
@@ -548,21 +552,26 @@ class BatchResult:
     def to_dict(self) -> Dict[str, List[Any]]:
         return {fid: self.to_pylist(fid) for fid in self._columns}
 
-    def span_bytes(self, field_id: str):
+    def span_bytes(self, field_id: str, include_fix: bool = False,
+                   threads: int = 0):
         """Flat-bytes view of a device span column for non-Arrow consumers:
         (data uint8, offsets int64, valid bool) — row r's raw value is
         ``data[offsets[r]:offsets[r+1]]`` when valid[r].  Uses the native
         threaded gather (numpy fallback inside).  Returns None when the
-        column has host overrides or repair (`fix`) rows — those need the
-        per-row path (:meth:`to_pylist`)."""
+        column has host overrides or repair (`fix`) rows — unless
+        ``include_fix`` (the Arrow bridge gathers repair rows raw and
+        splices the repaired values afterwards); override columns always
+        need the per-row path (:meth:`to_pylist`).  ``threads`` caps the
+        native gather's fan-out (pooled per-column callers pass 1)."""
         from ..native import gather_spans
 
-        inputs = self._span_flat_inputs(field_id)
+        inputs = self._span_flat_inputs(field_id, include_fix=include_fix)
         if inputs is None:
             return None
         starts, lens, valid = inputs
         B = self.lines_read
-        data, offsets = gather_spans(self.buf[:B], starts, lens)
+        data, offsets = gather_spans(self.buf[:B], starts, lens,
+                                     threads=threads)
         self._amp_normalize(field_id, data, offsets, lens, valid)
         return data, offsets, valid
 
@@ -602,7 +611,8 @@ class BatchResult:
             at = at[data[at] == np.uint8(ord("?"))]
             data[at] = np.uint8(ord("&"))
 
-    def span_bytes_many(self, field_ids, include_fix: bool = False):
+    def span_bytes_many(self, field_ids, include_fix: bool = False,
+                        threads: int = 0):
         """Gather several span columns in ONE native call.
 
         Returns {field_id: (data_view, offsets, valid)} covering the
@@ -611,9 +621,12 @@ class BatchResult:
         ``include_fix``); ineligible columns are simply absent.  The
         threaded memcpy fan-out is paid once per batch instead of once
         per column — the difference between ~3M and ~7M rows/s through
-        the Arrow bridge at 16k-row batches."""
+        the Arrow bridge at 16k-row batches.  ``threads`` defaults to
+        the result's assembly pool budget when one is attached."""
         from ..native import gather_spans_multi
 
+        if not threads and self.assembly_pool is not None:
+            threads = self.assembly_pool.native_threads
         B = self.lines_read
         elig = []
         for fid in field_ids:
@@ -624,7 +637,8 @@ class BatchResult:
             return {}
         starts = np.stack([e[1][0] for e in elig])
         lens = np.stack([e[1][1] for e in elig])
-        data, goff = gather_spans_multi(self.buf[:B], starts, lens)
+        data, goff = gather_spans_multi(self.buf[:B], starts, lens,
+                                        threads=threads)
         out = {}
         for k, (fid, (_s, lens_k, valid_k)) in enumerate(elig):
             base = goff[k * B]
@@ -667,9 +681,25 @@ class TpuBatchParser:
         type_remappings: Optional[Dict[str, Any]] = None,
         extra_dissectors: Optional[Sequence[Any]] = None,
         locale: Optional[str] = None,
+        view_fields: Optional[Sequence[str]] = None,
+        assembly_workers: Optional[int] = None,
     ):
         self.log_format = log_format
         self.requested = [cleanup_field_value(f) for f in fields]
+        # Demand-driven view emission: the device emits Arrow view rows
+        # only for span fields the consumer will actually deliver as
+        # string_view columns.  None = all requested span fields (the
+        # to_arrow default delivers every one); a sequence prunes to that
+        # subset; an empty sequence disables view emission entirely
+        # (equivalent to parse_batch(..., emit_views=False) per call).
+        self._view_demand = (
+            None if view_fields is None
+            else frozenset(cleanup_field_value(f) for f in view_fields)
+        )
+        # One parallelism knob for the whole delivery path: Arrow column
+        # assembly fan-out + the native memcpy thread budget.
+        self.assembly_workers = assembly_workers
+        self._assembly_pool = None
 
         # Host oracle parser (also the metadata source).  Pinned STATELESS:
         # the batch path guarantees deterministic per-line registration
@@ -800,14 +830,27 @@ class TpuBatchParser:
             return build_units_jnp_fn(self.units)
         return None
 
+    def assembly_pool(self):
+        """The shared delivery-path worker pool (lazily built; see
+        tpu/hostpool.py).  BatchResults carry a reference so to_arrow
+        inherits the knob wherever the result travels."""
+        if self._assembly_pool is None:
+            from .hostpool import AssemblyPool
+
+            self._assembly_pool = AssemblyPool(self.assembly_workers)
+        return self._assembly_pool
+
     def _view_specs(self):
         """Static spec for device-side Arrow view emission: span-group
         fields + the units the host would decode each from (the
         ``_unit_decodable`` rule — other units' lines deliver via oracle
-        overrides, whose views the host patches anyway)."""
+        overrides, whose views the host patches anyway).  Pruned to the
+        demand set when the parser was built with ``view_fields``."""
         specs = []
         for fid in self.requested:
             if fid.endswith(".*"):
+                continue
+            if self._view_demand is not None and fid not in self._view_demand:
                 continue
             if self._plan_group(self.plan_by_id[fid]) != "span":
                 continue
@@ -1296,10 +1339,24 @@ class TpuBatchParser:
         else:
             col["typed_kind"] = None
 
-    def parse_batch(self, lines: Sequence[Union[bytes, str]]) -> BatchResult:
-        return self._finish_batch(self._start_batch(lines))
+    def parse_batch(
+        self, lines: Sequence[Union[bytes, str]],
+        emit_views: Optional[bool] = None,
+    ) -> BatchResult:
+        """``emit_views=False`` runs the plain executor (no device Arrow
+        view rows): the demand knob for consumers that never deliver
+        string_view columns — copy-mode Arrow (parse_to_ipc, the sidecar
+        wire) and the per-record adapter paths — so they stop paying the
+        view-emission kernel cost and the larger packed D2H.  Default
+        (None/True): the product path with views."""
+        return self._finish_batch(
+            self._dispatch_batch(self._encode_batch(lines), emit_views)
+        )
 
-    def parse_blob(self, data: Union[bytes, bytearray, memoryview]) -> BatchResult:
+    def parse_blob(
+        self, data: Union[bytes, bytearray, memoryview],
+        emit_views: Optional[bool] = None,
+    ) -> BatchResult:
         """Newline-delimited log bytes -> BatchResult without building a
         Python line list: the native framer packs the padded [B, L]
         buffer straight from the blob, and per-line bytes materialize
@@ -1323,18 +1380,19 @@ class TpuBatchParser:
         with trace.stage("encode", items=B):
             buf, lengths, overflow = encode_blob(data)
         if buf.shape[0] != B:  # framer/view disagreement: authoritative path
-            return self.parse_batch(list(lines))
+            return self.parse_batch(list(lines), emit_views=emit_views)
         padded_b = _bucket_batch(B)
         if padded_b != B:
             buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
             lengths = np.pad(lengths, (0, padded_b - B))
         enc = (lines, buf, lengths, overflow, B, padded_b)
-        return self._finish_batch(self._dispatch_batch(enc))
+        return self._finish_batch(self._dispatch_batch(enc, emit_views))
 
     def parse_batch_stream(
         self,
         batches,
         depth: int = 1,
+        emit_views: Optional[bool] = None,
     ):
         """Batches-in-flight streaming: yields one BatchResult per input
         batch, in order, overlapping the host-side stages with device
@@ -1367,10 +1425,10 @@ class TpuBatchParser:
                 # (link order), then materialize it while the new batch
                 # computes.
                 fetched = self._fetch_packed(pending.popleft())
-                pending.append(self._dispatch_batch(enc))
+                pending.append(self._dispatch_batch(enc, emit_views))
                 yield self._materialize_packed(fetched)
             else:
-                pending.append(self._dispatch_batch(enc))
+                pending.append(self._dispatch_batch(enc, emit_views))
         while pending:
             yield self._finish_batch(pending.popleft())
 
@@ -1378,6 +1436,14 @@ class TpuBatchParser:
         """Encode + pad + asynchronously dispatch the device program.
         Returns the in-flight state ``_finish_batch`` consumes."""
         return self._dispatch_batch(self._encode_batch(lines))
+
+    def _executor_for(self, emit_views: Optional[bool]):
+        """The executor an emit_views choice selects: the view-emitting
+        product executor by default, the plain one when views are
+        disabled (per call or by an empty parser-level demand set)."""
+        if emit_views is None or emit_views:
+            return self.device_views_fn()
+        return self._jitted
 
     def _encode_batch(self, lines: Sequence[Union[bytes, str]]):
         from ..observability import tracer
@@ -1393,13 +1459,13 @@ class TpuBatchParser:
             lengths = np.pad(lengths, (0, padded_b - B))
         return list(lines), buf, lengths, overflow, B, padded_b
 
-    def _dispatch_batch(self, enc):
+    def _dispatch_batch(self, enc, emit_views: Optional[bool] = None):
         from ..observability import tracer
 
         trace = tracer()
         lines, buf, lengths, overflow, B, padded_b = enc
         out = None
-        fn = self.device_views_fn()
+        fn = self._executor_for(emit_views)
         if fn is not None:
             with trace.stage("device", items=B):
                 out = fn(jnp.asarray(buf), jnp.asarray(lengths))
@@ -1409,7 +1475,7 @@ class TpuBatchParser:
                     # the fetch stage (only when someone is looking).
                     out = jax.block_until_ready(out)
         return (lines, buf, lengths, overflow, B, padded_b, out,
-                self.csr_slots)
+                self.csr_slots, emit_views)
 
     def _finish_batch(self, state) -> BatchResult:
         return self._materialize_packed(self._fetch_packed(state))
@@ -1421,7 +1487,8 @@ class TpuBatchParser:
         from ..observability import tracer
 
         trace = tracer()
-        lines, buf, lengths, overflow, B, padded_b, out, out_slots = state
+        (lines, buf, lengths, overflow, B, padded_b, out, out_slots,
+         emit_views) = state
 
         from .pipeline import CSR_OVERFLOW_BIT
 
@@ -1430,7 +1497,7 @@ class TpuBatchParser:
             # result was produced under a stale CSR slot layout (another
             # batch's materialization grew the slots mid-stream).
             if out is None or out_slots != self.csr_slots:
-                fn = self.device_views_fn()
+                fn = self._executor_for(emit_views)
                 if fn is None:
                     packed = None
                     valid = np.zeros(B, dtype=bool)
@@ -1878,7 +1945,7 @@ class TpuBatchParser:
             lines, buf[:B], lengths[:B], valid, columns, overrides,
             good, bad, format_index=winner[:B], oracle_rows=len(need_oracle),
             packed=view_block, device_views=device_views,
-            dirty_rows=dirty_rows,
+            dirty_rows=dirty_rows, assembly_pool=self.assembly_pool(),
         )
 
     def _materialize_csr(
@@ -2380,12 +2447,17 @@ class TpuBatchParser:
         return out
 
     def close(self) -> None:
-        """Release the fallback worker pool (if one was started)."""
+        """Release the fallback worker pool (if one was started) and the
+        Arrow assembly thread pool."""
         pool = getattr(self, "_oracle_pool", None)
         if pool:
             pool.terminate()
             pool.join()
         self._oracle_pool = None
+        apool = getattr(self, "_assembly_pool", None)
+        if apool is not None:
+            apool.close()
+        self._assembly_pool = None
 
     # ------------------------------------------------------------------
     # serialization — the compiled format program (token tables, split ops,
@@ -2408,6 +2480,7 @@ class TpuBatchParser:
         state["_jitted"] = None
         state["_jitted_views"] = None
         state["_oracle_pool"] = None  # worker pools never ship in artifacts
+        state["_assembly_pool"] = None  # rebuilt lazily from the knob
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -2429,6 +2502,11 @@ class TpuBatchParser:
                 for f, c in self._host_casts.items()
                 if c is not None
             }
+        if "_view_demand" not in state:  # pre-round-6 artifacts
+            self._view_demand = None
+        if "assembly_workers" not in state:
+            self.assembly_workers = None
+        self._assembly_pool = None
         self._jitted = self._build_jitted()
         self._jitted_views = None
 

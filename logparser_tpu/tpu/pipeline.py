@@ -695,6 +695,47 @@ class PackedLayout:
         return comp, ok
 
 
+def span_prefix_words(
+    b32: jnp.ndarray,
+    s: jnp.ndarray,
+    e: jnp.ndarray,
+    ok: jnp.ndarray,
+    null: Optional[jnp.ndarray],
+    amp: Optional[jnp.ndarray],
+    extract,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """LE-packed first-12-byte words of one span field, computed IN the
+    unit pass (bytes masked beyond len; dead rows all-zero).  Gathering
+    here — where the split/chain stages are already streaming the byte
+    buffer — folds the view-prefix extraction into the same fusion
+    cluster; the pre-round-6 post-merge gather depended on every unit's
+    packed rows, so XLA had to re-stream the whole [B, L] buffer in a
+    separate HBM sweep per view field.  The '?'->'&' query normalization
+    is rendered in place so <= 12-byte amp values need no host patching."""
+    length = e - s
+    live = ok if null is None else (ok & ~null)
+    first12 = extract(b32, s, 12)
+    pos = jnp.arange(12, dtype=jnp.int32)[None, :]
+    masked = jnp.where(
+        live[:, None] & (pos < length[:, None]),
+        first12.astype(jnp.int32),
+        0,
+    )
+    if amp is not None:
+        amp_row = amp & live & (length > 0) & (masked[:, 0] == ord("?"))
+        masked = masked.at[:, 0].set(
+            jnp.where(amp_row, ord("&"), masked[:, 0])
+        )
+    words = []
+    for w in range(3):
+        b = masked[:, 4 * w: 4 * w + 4]
+        words.append(
+            (b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16)
+             | (b[:, 3] << 24)).astype(jnp.int32)
+        )
+    return words[0], words[1], words[2]
+
+
 def compute_rows(
     program: DeviceProgram,
     plans: Sequence[FieldPlan],
@@ -702,10 +743,14 @@ def compute_rows(
     b32: jnp.ndarray,
     lengths: jnp.ndarray,
     need_plausible: bool = False,
-) -> List[jnp.ndarray]:
+    view_fields: Sequence[str] = (),
+) -> Tuple[List[jnp.ndarray], Dict[str, Tuple[jnp.ndarray, ...]]]:
     """The fused computation: split + per-plan post-stages -> K rows of [B]
     int32 (row 0: bit 0 = line validity, bit 1 = plausibility when
-    requested).  Returned as a list; the executor stacks them."""
+    requested).  Returns (rows, view_prefix): the executor stacks the
+    rows; ``view_prefix`` maps each requested ``view_fields`` span field
+    to its 3 LE-packed first-12-byte words (see span_prefix_words),
+    consumed by the winner merge in :func:`compute_view_rows`."""
     B = b32.shape[0]
     starts, ends, valid, plausible = compute_split(
         program, b32, lengths, need_plausible
@@ -713,6 +758,8 @@ def compute_rows(
     extract = postproc.gather_span_bytes
 
     rows: List[Optional[jnp.ndarray]] = [None] * layout.n_rows
+    view_set = frozenset(view_fields)
+    view_prefix: Dict[str, Tuple[jnp.ndarray, ...]] = {}
 
     def put(fid: str, comp: str, val: jnp.ndarray) -> None:
         row, shift, bits = layout.slots[fid][comp]
@@ -731,6 +778,10 @@ def compute_rows(
             put(fid, "amp", jnp.where(amp, 1, 0))
         if fix is not None:
             put(fid, "fix", jnp.where(fix, 1, 0))
+        if fid in view_set:
+            view_prefix[fid] = span_prefix_words(
+                b32, s, e, ok, null, amp, extract
+            )
 
     # ---- span-transform chains (device sub-dissectors) ----------------
     # chain(token, steps) -> (start, end, ok, null, amp); each prefix is
@@ -1042,7 +1093,7 @@ def compute_rows(
         )
     rows[0] = row0
     zero = jnp.zeros(B, dtype=jnp.int32)
-    return [r if r is not None else zero for r in rows]
+    return [r if r is not None else zero for r in rows], view_prefix
 
 
 # ---------------------------------------------------------------------------
@@ -1091,20 +1142,18 @@ def assign_row_offsets(units: Sequence[FormatUnit]) -> int:
     return off
 
 
-def compute_units_rows(
+def _units_rows_and_prefixes(
     units: Sequence[FormatUnit],
     buf: jnp.ndarray,
     lengths: jnp.ndarray,
-) -> List[jnp.ndarray]:
-    """All formats' packed rows for one batch — the single executor body
-    shared by the jnp path (via :func:`units_fn`), the mesh runners, and
-    bench.py.  Every compare and range check is correct under both uint8
-    and int32 inputs: uint8 wraparound "negatives" land >= 230 and int32
-    gives true negatives, and each fails the <= 9 / < 26 digit and letter
-    range checks identically (the timestamp parser digit-checks every
-    numeric byte explicitly for exactly this reason)."""
+    view_specs: Sequence[Tuple[str, Sequence[int]]] = (),
+) -> Tuple[List[jnp.ndarray], Dict[Tuple[int, str], Tuple[jnp.ndarray, ...]]]:
+    """All formats' packed rows for one batch, plus — when ``view_specs``
+    names (field, unit) pairs — each unit's in-pass first-12-byte view
+    prefix words, keyed (unit_index, field_id)."""
     rows: List[jnp.ndarray] = []
-    for u in units:
+    prefixes: Dict[Tuple[int, str], Tuple[jnp.ndarray, ...]] = {}
+    for ui, u in enumerate(units):
         # Plausibility is computed for EVERY unit (not just non-final
         # ones): besides the multi-format winner contest, the host uses
         # "implausible for all formats" as a sound definitely-bad filter —
@@ -1118,10 +1167,30 @@ def compute_units_rows(
             )
             rows.append(jnp.where(plausible, 2, 0).astype(jnp.int32))
             continue
-        rows.extend(compute_rows(
+        vf = [fid for fid, unit_idx in view_specs if ui in unit_idx]
+        unit_rows, unit_prefix = compute_rows(
             u.program, u.plans, u.layout, buf, lengths,
-            need_plausible=True,
-        ))
+            need_plausible=True, view_fields=vf,
+        )
+        rows.extend(unit_rows)
+        for fid, words in unit_prefix.items():
+            prefixes[(ui, fid)] = words
+    return rows, prefixes
+
+
+def compute_units_rows(
+    units: Sequence[FormatUnit],
+    buf: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> List[jnp.ndarray]:
+    """All formats' packed rows for one batch — the single executor body
+    shared by the jnp path (via :func:`units_fn`), the mesh runners, and
+    bench.py.  Every compare and range check is correct under both uint8
+    and int32 inputs: uint8 wraparound "negatives" land >= 230 and int32
+    gives true negatives, and each fails the <= 9 / < 26 digit and letter
+    range checks identically (the timestamp parser digit-checks every
+    numeric byte explicitly for exactly this reason)."""
+    rows, _ = _units_rows_and_prefixes(units, buf, lengths)
     return rows
 
 
@@ -1146,7 +1215,9 @@ def units_fn(units: Sequence[FormatUnit]):
 # string_view structs with one streaming interleave pass
 # (native lp_views_interleave) instead of re-streaming the whole [B, L]
 # buffer — on the 1-core bench host the byte gather runs at ~6.7 GB/s,
-# on the TPU at HBM speed.
+# on the TPU at HBM speed.  The prefix bytes themselves are extracted
+# inside each unit's pass (span_prefix_words) and only winner-SELECTED
+# here, so view emission adds [B]-shaped selects, not buffer sweeps.
 VIEW_ROWS_PER_FIELD = 4
 VIEW_LEN_SHIFT = _SPAN_BITS
 VIEW_LIVE_SHIFT = 2 * _SPAN_BITS
@@ -1154,9 +1225,9 @@ VIEW_LIVE_SHIFT = 2 * _SPAN_BITS
 
 def compute_view_rows(
     units: Sequence[FormatUnit],
-    buf: jnp.ndarray,
     rows: List[jnp.ndarray],
     view_specs: Sequence[Tuple[str, Sequence[int]]],
+    prefixes: Dict[Tuple[int, str], Tuple[jnp.ndarray, ...]],
 ) -> List[jnp.ndarray]:
     """Winner-merged Arrow view rows for span fields, computed ON DEVICE.
 
@@ -1164,10 +1235,12 @@ def compute_view_rows(
     ``view_specs`` is [(field_id, [unit_index, ...])] listing, per span
     field, the units the host would decode it from (``_unit_decodable``
     semantics — lines won by other units deliver via oracle overrides and
-    the host patches their views).  The winner/contested computation
-    mirrors TpuBatchParser._fetch_packed exactly."""
-    B = buf.shape[0]
-    span_mask = (1 << _SPAN_BITS) - 1
+    the host patches their views).  ``prefixes`` carries each unit's
+    in-pass first-12-byte words ((unit_index, field_id) ->
+    span_prefix_words output); the merge is pure per-line selects.  The
+    winner/contested computation mirrors TpuBatchParser._fetch_packed
+    exactly."""
+    B = rows[0].shape[0]
 
     # Per-line winner by registration priority + the contested rule (an
     # earlier format still plausible un-claims the line; the host then
@@ -1192,50 +1265,26 @@ def compute_view_rows(
 
     out: List[jnp.ndarray] = []
     zero32 = jnp.zeros(B, dtype=jnp.int32)
-    false_b = jnp.zeros(B, dtype=bool)
     for fid, unit_idx in view_specs:
         merged = zero32
-        amp_sel = false_b
+        pwords = [zero32, zero32, zero32]
         for ui in unit_idx:
             u = units[ui]
             r, _, _ = u.layout.slots[fid]["start"]
             w = rows[u.row_offset + r]
             ok = ((w >> (2 * _SPAN_BITS)) & 1) != 0
             null = ((w >> (2 * _SPAN_BITS + 1)) & 1) != 0
-            amp = ((w >> (2 * _SPAN_BITS + 2)) & 1) != 0
             sel = (winner == ui) & valid_any & ok & ~null
             live_word = (w & ((1 << (2 * _SPAN_BITS)) - 1)) | (
                 1 << VIEW_LIVE_SHIFT
             )
             merged = jnp.where(sel, live_word, merged)
-            amp_sel = jnp.where(sel, amp, amp_sel)
-        start = merged & span_mask
-        length = (merged >> VIEW_LEN_SHIFT) & span_mask
-        first12 = postproc.gather_span_bytes(buf, start, 12)  # [B, 12]
-        live = (merged >> VIEW_LIVE_SHIFT) != 0
-        pos = jnp.arange(12, dtype=jnp.int32)[None, :]
-        masked = jnp.where(
-            live[:, None] & (pos < length[:, None]),
-            first12.astype(jnp.int32),
-            0,
-        )
-        # Query ?->& normalization rendered in place: for <= 12-byte
-        # values the view IS the value, so those rows need no host side
-        # buffer at all; longer amp rows get patched on host anyway.
-        amp_row = (
-            amp_sel & live & (length > 0)
-            & (masked[:, 0] == ord("?"))
-        )
-        masked = masked.at[:, 0].set(
-            jnp.where(amp_row, ord("&"), masked[:, 0])
-        )
-        out.append(jnp.where(live, merged, 0))
-        for w in range(3):
-            b = masked[:, 4 * w: 4 * w + 4]
-            out.append(
-                (b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16)
-                 | (b[:, 3] << 24)).astype(jnp.int32)
-            )
+            unit_words = prefixes[(ui, fid)]
+            pwords = [
+                jnp.where(sel, unit_words[k], pwords[k]) for k in range(3)
+            ]
+        out.append(merged)
+        out.extend(pwords)
     return out
 
 
@@ -1247,8 +1296,10 @@ def units_views_fn(
     [sum K_i + 4 * n_view_fields, B] int32."""
 
     def fn(buf: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
-        rows = compute_units_rows(units, buf, lengths)
-        rows.extend(compute_view_rows(units, buf, rows, view_specs))
+        rows, prefixes = _units_rows_and_prefixes(
+            units, buf, lengths, view_specs
+        )
+        rows.extend(compute_view_rows(units, rows, view_specs, prefixes))
         return jnp.stack(rows)
 
     return fn

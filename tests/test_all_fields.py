@@ -96,6 +96,7 @@ class TestCombinedAllFields:
             got = None if got is None else str(got)
             assert got == want, (fid, got, want)
 
+    @pytest.mark.slow  # ~50-field device compile: slow tier (re-tier r06); oracle golden stays fast.
     def test_batch_path_delivers_golden(self):
         # The same all-fields sweep through the DEVICE path: every field the
         # oracle delivers must come out of parse_batch identically.

@@ -18,6 +18,8 @@ from logparser_tpu.tpu.arrow_bridge import (
 )
 from logparser_tpu.tools.demolog import HEADLINE_FIELDS, generate_combined_lines
 
+from _shared_parsers import shared_parser
+
 NGINX = (
     '$remote_addr - $remote_user [$time_local] "$request" $status '
     '$body_bytes_sent "$http_referer" "$http_user_agent"'
@@ -41,7 +43,7 @@ def _assert_tables_match(res):
 
 
 def test_view_matches_copy_combined():
-    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    parser = shared_parser("combined", HEADLINE_FIELDS)
     res = parser.parse_batch(
         generate_combined_lines(512, seed=9, garbage_fraction=0.05)
     )
@@ -72,7 +74,7 @@ def test_view_matches_copy_uri_fix_and_amp_rows():
 
 def test_view_matches_copy_oracle_override_rows():
     """Host-override (oracle) rows patch in as side-buffer strings."""
-    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    parser = shared_parser("combined", HEADLINE_FIELDS)
     lines = generate_combined_lines(64, seed=12)
     # A >18-digit byte count forces the oracle for the line; other
     # columns of that row become overrides.
@@ -86,7 +88,7 @@ def test_view_matches_copy_oracle_override_rows():
 
 
 def test_view_table_ipc_roundtrip():
-    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    parser = shared_parser("combined", HEADLINE_FIELDS)
     res = parser.parse_batch(generate_combined_lines(128, seed=4))
     tv = res.to_arrow()
     back = table_from_ipc_bytes(table_to_ipc_bytes(tv))
@@ -96,7 +98,7 @@ def test_view_table_ipc_roundtrip():
 def test_view_non_utf8_falls_back_with_stable_type():
     """Mojibake bytes route the line to the oracle; if a column still
     bails to the per-row path its type must stay string_view."""
-    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    parser = shared_parser("combined", HEADLINE_FIELDS)
     lines = generate_combined_lines(16, seed=5)
     lines[3] = lines[3].replace("GET /", "GET /caf\xe9-")
     res = parser.parse_batch(lines)
@@ -107,7 +109,7 @@ def test_view_non_utf8_falls_back_with_stable_type():
 
 
 def test_view_empty_and_all_null_columns():
-    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    parser = shared_parser("combined", HEADLINE_FIELDS)
     res = parser.parse_batch(["garbage that matches nothing"] * 8)
     tv = _assert_tables_match(res)
     assert tv.num_rows == 8
@@ -151,7 +153,7 @@ def test_device_views_present_and_match(monkeypatch):
     level (forced by disabling the device-view route for the B side)."""
     from logparser_tpu import native
 
-    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    parser = shared_parser("combined", HEADLINE_FIELDS)
     lines = generate_combined_lines(256, seed=21, garbage_fraction=0.05)
     res = parser.parse_batch(lines)
     assert res.device_views, "device view rows absent on the product path"
@@ -166,7 +168,7 @@ def test_device_views_present_and_match(monkeypatch):
 def test_device_views_overflow_dirty_rows():
     """Overflow-truncated lines (devices judged a prefix) are flagged
     dirty; their device views must not leak truncated-span values."""
-    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    parser = shared_parser("combined", HEADLINE_FIELDS)
     lines = generate_combined_lines(32, seed=22)
     # An overlong UA blows the 8191-byte line cap -> overflow row.
     lines[5] = lines[5][:-1] + "x" * 9000 + '"'
@@ -178,7 +180,7 @@ def test_device_views_overflow_dirty_rows():
 def test_device_views_survive_artifact_reload(tmp_path):
     """A saved/loaded compiled parser rebuilds its views executor lazily
     and still delivers device-view-backed tables."""
-    parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    parser = shared_parser("combined", HEADLINE_FIELDS)
     path = str(tmp_path / "p.lptpu")
     parser.save(path)
     loaded = TpuBatchParser.load(path)

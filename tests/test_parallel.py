@@ -134,6 +134,7 @@ class TestSequenceParallelBoundaries:
         assert np.asarray(out["valid"]).all()
 
 
+@pytest.mark.slow  # 8-device full-step compile; dryrun_multichip covers it every round: slow tier (re-tier r06).
 def test_full_step_batch_parallel_matches_single():
     """The complete TpuBatchParser pipeline (split + chained stages + CSR)
     sharded over the data axis: packed output bit-identical to one device."""

@@ -61,6 +61,7 @@ def _tupleless(values):
     return values
 
 
+@pytest.mark.slow  # Full golden-vector session (service-side parser compile): slow tier (re-tier r06).
 def test_01_session_vector(service):
     import pyarrow as pa
 
@@ -83,6 +84,7 @@ def test_01_session_vector(service):
         sock.close()
 
 
+@pytest.mark.slow  # Full golden-vector session (service-side parser compile): slow tier (re-tier r06).
 def test_01_column_types(service):
     import pyarrow as pa
 

@@ -47,8 +47,8 @@ class _DelayedParser(TpuBatchParser):
         self._mat_s = mat_s
         self._deadline = {}
 
-    def _dispatch_batch(self, enc):
-        state = super()._dispatch_batch(enc)
+    def _dispatch_batch(self, enc, emit_views=None):
+        state = super()._dispatch_batch(enc, emit_views)
         self._deadline[id(state)] = time.monotonic() + self._compute_s
         return state
 

@@ -16,6 +16,8 @@ from logparser_tpu.tpu.batch import _CollectingRecord
 from logparser_tpu.tpu.program import compile_device_program
 from logparser_tpu.tpu.runtime import encode_batch, run_program
 
+from _shared_parsers import shared_parser
+
 FIELDS = [
     "IP:connection.client.host",
     "STRING:connection.client.user",
@@ -81,7 +83,7 @@ class TestDifferential:
     @pytest.mark.parametrize("garbage", [0.0, 0.05])
     def test_against_oracle(self, garbage):
         lines = generate_combined_lines(400, seed=7, garbage_fraction=garbage)
-        batch = TpuBatchParser("combined", FIELDS)
+        batch = shared_parser("combined", FIELDS)
         result = batch.parse_batch(lines)
         expected = oracle_parse(lines)
 
@@ -103,7 +105,7 @@ class TestDifferential:
 
     def test_counters(self):
         lines = generate_combined_lines(200, seed=3, garbage_fraction=0.1)
-        batch = TpuBatchParser("combined", FIELDS)
+        batch = shared_parser("combined", FIELDS)
         result = batch.parse_batch(lines)
         n_garbage = sum(
             1 for rec in oracle_parse(lines) if rec is None
@@ -121,7 +123,7 @@ class TestEdge:
             '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET / HTTP/1.1" 200 5 '
             '"-" "weird" agent"'
         )
-        batch = TpuBatchParser("combined", FIELDS)
+        batch = shared_parser("combined", FIELDS)
         result = batch.parse_batch([line])
         expected = oracle_parse([line])[0]
         ua = result.to_pylist("HTTP.USERAGENT:request.user-agent")[0]
@@ -138,7 +140,7 @@ class TestEdge:
             + ' HTTP/1.1" 200 5 "-" "-"'
         )
         assert len(line) <= 8191
-        batch = TpuBatchParser("combined", FIELDS)
+        batch = shared_parser("combined", FIELDS)
         result = batch.parse_batch([line])
         assert result.valid[0]
         assert result.oracle_rows == 0
@@ -152,7 +154,7 @@ class TestEdge:
             + "a" * 8300
             + ' HTTP/1.1" 200 5 "-" "-"'
         )
-        batch = TpuBatchParser("combined", FIELDS)
+        batch = shared_parser("combined", FIELDS)
         result = batch.parse_batch([line])
         # Overflows the max device bucket -> host oracle handles it.
         assert result.oracle_rows == 1
@@ -239,12 +241,12 @@ class TestMultiFormat:
         return lines
 
     def test_two_units_compiled(self):
-        parser = TpuBatchParser("combined\n" + COMMON, self.FIELDS)
+        parser = shared_parser("combined\n" + COMMON, self.FIELDS)
         assert len(parser.units) == 2
         assert parser.units[1].row_offset == parser.units[0].layout.n_rows
 
     def test_winner_per_line(self):
-        parser = TpuBatchParser("combined\n" + COMMON, self.FIELDS)
+        parser = shared_parser("combined\n" + COMMON, self.FIELDS)
         res = parser.parse_batch(self._mixed())
         # Interleaved combined/common lines -> alternating winners.
         assert list(res.format_index[:6]) == [0, 1, 0, 1, 0, 1]
@@ -401,7 +403,7 @@ class TestDefinitelyBadFilter:
     the oracle_rows accounting itself is locked)."""
 
     def test_garbage_skips_oracle(self):
-        batch = TpuBatchParser("combined", FIELDS)
+        batch = shared_parser("combined", FIELDS)
         lines = [
             '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /x HTTP/1.1" '
             '200 5 "-" "-"',
@@ -415,7 +417,7 @@ class TestDefinitelyBadFilter:
         assert result.oracle_rows == 0
 
     def test_plausible_reject_still_visits_oracle(self):
-        batch = TpuBatchParser("combined", FIELDS)
+        batch = shared_parser("combined", FIELDS)
         lines = [
             # 20-digit bytes: device limb cap rejects, oracle accepts.
             '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /x HTTP/1.1" '
@@ -428,7 +430,7 @@ class TestDefinitelyBadFilter:
     def test_overflow_lines_always_oracle(self):
         # Truncated lines: the device's plausibility verdict covers only
         # the prefix, so overflow rows must keep their oracle visit.
-        batch = TpuBatchParser("combined", FIELDS)
+        batch = shared_parser("combined", FIELDS)
         line = (
             '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /'
             + "a" * 8300
@@ -446,7 +448,7 @@ class TestDefinitelyBadFilter:
             '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /x HTTP/1.1" '
             '200 5 "-" "ua"'
         )
-        batch = TpuBatchParser("combined", FIELDS)
+        batch = shared_parser("combined", FIELDS)
         result = batch.parse_batch([base + "\n", base, base + "\n\n"])
         expected = oracle_parse([base + "\n", base, base + "\n\n"])
         assert [bool(v) for v in result.valid] == [

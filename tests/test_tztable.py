@@ -23,6 +23,8 @@ from logparser_tpu.dissectors.tztable import (
     wall_table,
 )
 
+from _shared_parsers import shared_parser
+
 
 def _probe(zobj, minute):
     local = dt.datetime(1970, 1, 1) + dt.timedelta(minutes=minute)
@@ -92,16 +94,15 @@ ZONE_FIELDS = [
 
 
 def test_zone_format_compiles_fully_on_device():
-    from logparser_tpu.tpu.batch import TpuBatchParser
-
-    parser = TpuBatchParser(ZONE_FMT, ZONE_FIELDS)
+    parser = shared_parser(ZONE_FMT, ZONE_FIELDS)
     assert parser._unit_oracle_fields == [[]]
 
 
+@pytest.mark.slow  # Differential sweep over the full zone vocabulary: slow tier (re-tier r06).
 def test_device_vs_oracle_zone_corpus():
-    from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+    from logparser_tpu.tpu.batch import _CollectingRecord
 
-    parser = TpuBatchParser(ZONE_FMT, ZONE_FIELDS)
+    parser = shared_parser(ZONE_FMT, ZONE_FIELDS)
     rng = random.Random(3)
     zones = list(DEFAULT_DEVICE_ZONES) + [
         "EST", "CST", "PDT", "cet", "gmt", "Z", "UT",     # abbreviations
@@ -143,9 +144,7 @@ def test_device_vs_oracle_zone_corpus():
 def test_zone_vocabulary_corpus_stays_on_device():
     """A corpus using only device-vocabulary zones must not touch the
     oracle at all (the bench gate's oracle_fraction 0.0 contract)."""
-    from logparser_tpu.tpu.batch import TpuBatchParser
-
-    parser = TpuBatchParser(ZONE_FMT, ZONE_FIELDS)
+    parser = shared_parser(ZONE_FMT, ZONE_FIELDS)
     zones = ["CET", "EST", "UTC", "Europe/Paris", "America/New_York",
              "Asia/Tokyo", "Australia/Sydney", "PST", "GMT"]
     lines = [

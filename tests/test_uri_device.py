@@ -13,6 +13,8 @@ import pytest
 
 from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
 
+from _shared_parsers import shared_parser
+
 pytestmark = pytest.mark.slow
 
 FIELDS = [
@@ -76,7 +78,7 @@ class TestDeviceUriSplit:
             uris.append(p)
             for q in QUERY_PARTS:
                 uris.append(f"{p}?{q}")
-        parser = TpuBatchParser("common", FIELDS)
+        parser = shared_parser("common", FIELDS)
         assert_matches(parser, make_lines(uris))
 
     def test_fuzzed_uris(self):
@@ -86,7 +88,7 @@ class TestDeviceUriSplit:
         for _ in range(300):
             n = rng.randint(1, 24)
             uris.append("/" + "".join(rng.choice(alphabet) for _ in range(n)))
-        parser = TpuBatchParser("common", FIELDS)
+        parser = shared_parser("common", FIELDS)
         assert_matches(parser, make_lines(uris))
 
     # Absolute-URL coverage (JavaUri authority semantics on device).
@@ -123,7 +125,7 @@ class TestDeviceUriSplit:
     ]
 
     def test_absolute_urls(self):
-        parser = TpuBatchParser("common", FIELDS)
+        parser = shared_parser("common", FIELDS)
         assert_matches(parser, make_lines(self.ABSOLUTE))
 
     def test_fuzzed_absolute_urls(self):
@@ -141,13 +143,13 @@ class TestDeviceUriSplit:
                 s = rng.choice(["u", "u:p", "a@b", ""]) + "@" + s[len("x://"):]
                 s = rng.choice(heads) + "://" + s
             uris.append(s + rng.choice(tails) + rng.choice(paths))
-        parser = TpuBatchParser("common", FIELDS)
+        parser = shared_parser("common", FIELDS)
         assert_matches(parser, make_lines(uris))
 
     def test_fix_rows_stay_on_device(self):
         # %-escapes must not cost a full oracle re-parse.
         uris = ["/logo%20big.png?q=%C3%A9", "/x?broken=50%-off", "/plain"]
-        parser = TpuBatchParser("common", FIELDS)
+        parser = shared_parser("common", FIELDS)
         result = parser.parse_batch(make_lines(uris))
         assert result.oracle_rows == 0
         assert list(result.valid) == [True, True, True]
@@ -221,7 +223,7 @@ class TestDeviceUriSplit:
             "example.com/no/scheme",
             "/relative/still?fine=1",
         ]
-        parser = TpuBatchParser("common", FIELDS)
+        parser = shared_parser("common", FIELDS)
         result = parser.parse_batch(make_lines(uris))
         assert result.oracle_rows == 0
         assert list(result.valid) == [True] * len(uris)
@@ -265,13 +267,13 @@ class TestRound3DeviceCoverage:
     ]
 
     def test_pool_is_device_resident(self):
-        parser = TpuBatchParser("common", FIELDS)
+        parser = shared_parser("common", FIELDS)
         result = parser.parse_batch(make_lines(self.POOL))
         assert result.oracle_rows == 0
         assert all(result.valid)
 
     def test_pool_matches_oracle(self):
-        parser = TpuBatchParser("common", FIELDS)
+        parser = shared_parser("common", FIELDS)
         assert_matches(parser, make_lines(self.POOL))
 
     def test_fuzzed_mixed_pool(self):
@@ -285,5 +287,5 @@ class TestRound3DeviceCoverage:
             rng.choice(schemes) + rng.choice(atoms) + rng.choice(paths)
             for _ in range(200)
         ]
-        parser = TpuBatchParser("common", FIELDS)
+        parser = shared_parser("common", FIELDS)
         assert_matches(parser, make_lines(uris))
