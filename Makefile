@@ -1,7 +1,7 @@
 # Dev entry points (the reference's Maven/devtools tier, L0).
 PY ?= python
 
-.PHONY: test test-fast bench native clean
+.PHONY: test test-fast metrics-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -17,6 +17,13 @@ test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow"; rc=$$?; \
 	echo "fast-tier wall time: $$(( $$(date +%s) - start ))s (budget 300s)"; \
 	exit $$rc
+
+# Telemetry smoke: boot a sidecar with the /metrics endpoint, parse one
+# batch, scrape over HTTP and fail on malformed Prometheus exposition or
+# missing stage metrics (docs/OBSERVABILITY.md).  CI runs this after the
+# fast tier.
+metrics-smoke:
+	$(PY) -m logparser_tpu.tools.metrics_smoke
 
 lint:
 	$(PY) -m ruff check logparser_tpu tests

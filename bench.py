@@ -714,8 +714,15 @@ def main():
     # the assembly-pool efficiency figure: the same table built with the
     # pool clamped to 1 worker (the serial pre-round-6 path) vs the
     # configured pool.
+    from logparser_tpu.observability import metrics
     from logparser_tpu.tpu.hostpool import AssemblyPool, default_workers
 
+    # Stage breakdown window: reset the process registry so the recorded
+    # per-stage breakdown covers exactly the headline delivery measurement
+    # (one 64k parse + the arrow-rate iterations), using the SAME metric
+    # definitions as live serving (/metrics, STATS frame) — a delivery-gate
+    # regression in a future round names the offending stage.
+    metrics().reset()
     headline_result = parser.parse_batch(lines)
     pool_workers = headline_result.assembly_pool.workers
     arrow_lps, arrow_spread = arrow_rate(headline_result)
@@ -732,6 +739,10 @@ def main():
     arrow_copy_1w_lps, _ = arrow_rate(headline_result, strings="copy")
     headline_result.assembly_pool = saved_pool
     del headline_result
+    # The per-stage delivery breakdown (registry stage_seconds histograms
+    # accumulated over the window opened above): bench and live serving
+    # share one stage-name vocabulary (docs/OBSERVABILITY.md).
+    delivery_stage_breakdown = metrics().stage_breakdown()
 
     # Packed D2H sizes (tunnel-independent latency figure, VERDICT r05
     # weak #3): the exact bytes each batch ships device->host under the
@@ -930,6 +941,10 @@ def main():
                if headline_kern_views else {}),
             "packed_d2h_bytes_per_batch": d2h_views,
             "packed_d2h_bytes_per_batch_no_views": d2h_plain,
+            # Same stage names + definitions as the service /metrics
+            # endpoint and STATS frame (observability.stage_breakdown):
+            # measured over the headline 64k parse + arrow iterations.
+            "stage_breakdown": delivery_stage_breakdown,
         },
         "pipelined_end_to_end_lines_per_sec": round(pipelined, 1),
         "stream_lines_per_sec": round(stream_lps, 1),

@@ -15,10 +15,19 @@ __version__ = "0.1.0"
 from .observability import (  # noqa: F401
     CappedLogger,
     CounterRegistry,
+    Histogram,
+    MetricsRegistry,
     Tracer,
     counters,
+    disable_stage_annotations,
     disable_tracing,
+    enable_stage_annotations,
     enable_tracing,
+    log_warning_once,
+    metrics,
+    observe_stage,
+    pipeline_stage,
+    suppressed_warning_counts,
     tracer,
     version_banner,
 )

@@ -155,6 +155,11 @@ class LogfileInputFormat:
         batch_size: int = DEFAULT_BATCH,
         assembly_workers: Optional[int] = None,
     ):
+        from ..observability import log_version_banner_once
+
+        # Engine entry point: the reference banners once per JVM when the
+        # first parser component loads (HttpdLoglineParser.java:54-94).
+        log_version_banner_once(LOG)
         self.log_format = log_format
         self.requested_fields = list(requested_fields or [])
         self.type_remappings = dict(type_remappings or {})

@@ -15,6 +15,7 @@ columns.
 """
 from __future__ import annotations
 
+import logging
 import os
 
 import importlib
@@ -83,6 +84,11 @@ class Loader:
     """String-configured batch table loader (Pig LoadFunc equivalent)."""
 
     def __init__(self, *parameters: str):
+        from ..observability import log_version_banner_once
+
+        # Loader construction is the Pig-side entry point (the reference
+        # banners when the parser class loads into the Pig JVM).
+        log_version_banner_once(logging.getLogger(__name__))
         self.log_format: Optional[str] = None
         self.requested_fields: List[str] = []
         self.type_remappings: Dict[str, Set[str]] = {}

@@ -14,6 +14,8 @@ Hive-style >1%-bad-after-1000-lines circuit breaker available opt-in
 """
 from __future__ import annotations
 
+import logging
+
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -66,6 +68,11 @@ class ParserMapOperator:
 
     def open(self) -> None:
         if self.parser is None:
+            from ..observability import log_version_banner_once
+
+            # Worker-side operator startup (RichMapFunction.open / DoFn
+            # setup / bolt prepare): banner once per worker process.
+            log_version_banner_once(logging.getLogger(__name__))
             self.parser = self.config.build_parser()
 
     def close(self) -> None:

@@ -639,6 +639,14 @@ class FastLineEngine:
         # Cache the root field only when the last-chance pass could probe
         # it (nothing else reads it on the compiled path).
         self.cache_root = cache_root
+        # Per-engine outcome tallies, plain ints (GIL-atomic enough for
+        # counters; NO registry/lock work on the per-line path).  The batch
+        # pipeline folds deltas into the metrics registry per batch
+        # (TpuBatchParser._fold_oracle_engine_tally): parsed / rejected
+        # line outcomes plus format_fallback — lines the primary format
+        # rejected that a later registered format accepted (the columnar
+        # "Switched to LogFormat" signal at engine level).
+        self.tally = {"parsed": 0, "rejected": 0, "format_fallback": 0}
 
     def parse(self, line: str, record: Any) -> Any:
         parser = self.parser
@@ -650,6 +658,7 @@ class FastLineEngine:
                 parsable.to_be_parsed.clear()
         ctx = _Ctx(record, parsable)
         programs = self.programs
+        tally = self.tally
         try:
             programs[0].run(ctx, line)
         except DissectionFailure:
@@ -658,14 +667,17 @@ class FastLineEngine:
             # stateless mode, so priority order every line).  Partial
             # deliveries before the failure stay, like the generic path.
             if len(programs) <= 1:
+                tally["rejected"] += 1
                 raise
             for prog in programs:
                 try:
                     prog.run(ctx, line)
+                    tally["format_fallback"] += 1
                     break
                 except DissectionFailure:
                     continue
             else:
+                tally["rejected"] += 1
                 raise
         # Stage 2: sub-dissector waves in FIFO order (the generic worklist
         # equivalent).  Emitters may enqueue further work (firstline -> URI).
@@ -689,6 +701,7 @@ class FastLineEngine:
                     to_be = set(parsable.to_be_parsed)
         if parsable is not None:
             parser._last_chance_converters(parsable)
+        tally["parsed"] += 1
         return record
 
 

@@ -133,12 +133,19 @@ class Token:
         if self.warning_message_when_used:
             import logging
 
+            from ..observability import log_warning_once
+
             # slf4j-style: any remaining {} placeholder takes the output
             # fields (the field-name one was filled at token-match time).
             message = self.warning_message_when_used.replace(
                 "{}", str(self.output_fields), 1
             )
-            logging.getLogger(__name__).warning("%s", message)
+            # Once per process, not once per format assembly: every parser
+            # build (oracle + metadata + per-worker instances) re-emits
+            # identical token warnings — e.g. "Only some parts of localized
+            # timestamps are supported" spamming the bench/multichip tails.
+            # Repeats are counted (observability.suppressed_warning_counts).
+            log_warning_once(logging.getLogger(__name__), message)
 
     def __repr__(self) -> str:
         return f"{{{self.output_fields} ({self.start_pos}+{self.length});Prio={self.prio}}}"

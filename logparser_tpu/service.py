@@ -10,17 +10,24 @@ back, so one TPU-attached process serves many engine workers.
 Wire protocol (deliberately trivial to implement from any language):
 
     frame     := u32 big-endian length, then `length` payload bytes
-    session   := CONFIG frame, then any number of [LINES frame -> ARROW frame]
+    session   := CONFIG frame, then any number of
+                 [LINES frame -> ARROW frame [-> STATS frame]]
     CONFIG    := JSON {"log_format": str, "fields": [str, ...],
                        "timestamp_format": str|null,
                        "assembly_workers": int|null (optional; host-side
-                       Arrow assembly parallelism, default auto)}
+                       Arrow assembly parallelism, default auto),
+                       "stats": bool (optional; true = one STATS JSON frame
+                       after each ARROW frame — v1 sessions that omit the
+                       key get byte-identical v1 behavior)}
     LINES     := u32 big-endian line count, then the loglines joined by '\n'
                  (UTF-8).  Loglines cannot contain '\n' — they are lines.
                  count=0 means an empty batch (an empty ARROW table comes
                  back); an empty logline is a present-but-empty row.
     ARROW     := one Arrow IPC stream (schema + one record batch) with the
                  requested columns plus the `__valid__` validity column
+    STATS     := UTF-8 JSON telemetry frame (docs/PROTOCOL.md "stats" key):
+                 per-request timing/sizes + process-cumulative stage
+                 breakdown from the metrics registry
     error     := in place of an ARROW frame: 0xFFFFFFFF marker frame followed
                  by one frame of UTF-8 error text
     length 0  := end of session (client side); server closes the connection
@@ -28,6 +35,13 @@ Wire protocol (deliberately trivial to implement from any language):
 Compiled parsers are cached per config, so successive sessions with the same
 LogFormat skip recompilation (the service-side analogue of the reference's
 "compile the Pattern only once", TokenFormatDissector.java:209-210).
+
+Observability (docs/OBSERVABILITY.md): the service renders the process-wide
+metrics registry as a Prometheus ``/metrics`` HTTP endpoint
+(``metrics_port=``, or LOGPARSER_TPU_METRICS_PORT for the CLI) and can log a
+periodic one-line stats summary (``stats_interval=`` /
+LOGPARSER_TPU_STATS_INTERVAL).  ``python -m logparser_tpu.service`` runs the
+sidecar standalone with both wired up.
 """
 from __future__ import annotations
 
@@ -37,8 +51,16 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from .observability import (
+    log_version_banner_once,
+    metrics,
+    suppressed_warning_counts,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -168,9 +190,16 @@ class _SessionHandler(socketserver.BaseRequestHandler):
             return
         if config_frame is None:
             return
+        send_stats = False
         try:
             config = json.loads(config_frame)
+            # Optional telemetry opt-in (PROTOCOL.md "stats" CONFIG key):
+            # absent/falsy = byte-identical v1 session.  Not part of the
+            # parser cache key — it changes framing, not parsing.
+            send_stats = bool(config.get("stats")) if isinstance(
+                config, dict) else False
             parser = self.server.parser_cache.get(config)  # type: ignore[attr-defined]
+            metrics().increment("service_sessions_total")
         except Exception as e:  # noqa: BLE001 — relay config errors to client
             # Keep draining the session instead of closing: a client already
             # mid-send of a large LINES frame would otherwise see ECONNRESET
@@ -192,6 +221,7 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 return
             if lines_frame is None:
                 return  # end of session
+            t_request = time.perf_counter()
             try:
                 if len(lines_frame) < 4:
                     raise ValueError("LINES frame shorter than its count header")
@@ -228,25 +258,160 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 # copy of the batch buffer.
                 table = result.to_arrow(include_validity=True,
                                         strings="copy")
-                import pyarrow as pa
+                from .tpu.arrow_bridge import table_to_ipc_bytes
 
-                sink = pa.BufferOutputStream()
-                with pa.ipc.new_stream(sink, table.schema) as writer:
-                    writer.write_table(table)
-                write_frame(sock, sink.getvalue().to_pybytes())
+                payload = table_to_ipc_bytes(table)
+                write_frame(sock, payload)
+                reg = metrics()
+                dt = time.perf_counter() - t_request
+                reg.increment("service_requests_total")
+                reg.increment("service_lines_total", count)
+                reg.observe("service_request_seconds", dt)
+                if send_stats:
+                    # STATS frame: per-request figures + the SAME
+                    # process-cumulative stage breakdown /metrics and
+                    # bench.py report (one metric definition everywhere).
+                    stats = {
+                        "v": 1,
+                        "request": {
+                            "lines": count,
+                            "seconds": round(dt, 6),
+                            "arrow_bytes": len(payload),
+                            "oracle_lines": result.oracle_rows,
+                            "bad_lines": result.bad_lines,
+                        },
+                        "stages": reg.stage_breakdown(),
+                        # as_dict(): counters only — snapshot() would build
+                        # every histogram's bucket view per request.
+                        "counters": dict(sorted(reg.as_dict().items())),
+                    }
+                    write_frame(
+                        sock,
+                        json.dumps(stats, separators=(",", ":"),
+                                   sort_keys=True).encode("utf-8"),
+                    )
             except Exception as e:  # noqa: BLE001 — keep the session alive
                 LOG.exception("parse failed")
+                metrics().increment("service_request_errors_total")
                 try:
                     write_error(sock, f"parse failed: {e}")
                 except OSError:
                     return
 
 
-class ParseService:
-    """The sidecar: `with ParseService() as svc: ... svc.port ...` or call
-    `serve_forever()` from a main program."""
+class _MetricsHandler(BaseHTTPRequestHandler):
+    """GET /metrics -> Prometheus text exposition of the process registry."""
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
+        path = self.path.split("?", 1)[0].rstrip("/") or "/metrics"
+        if path != "/metrics":
+            self.send_error(404)
+            return
+        body = metrics().prometheus_text().encode("utf-8")
+        self.send_response(200)
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # silence stderr
+        LOG.debug("metrics http: " + fmt, *args)
+
+
+class MetricsEndpoint:
+    """Standalone /metrics HTTP scrape endpoint (Prometheus text).  Owned
+    by :class:`ParseService` when ``metrics_port`` is given; usable on its
+    own for non-sidecar processes."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = ThreadingHTTPServer((host, port), _MetricsHandler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "MetricsEndpoint":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="logparser-tpu-metrics", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        # Like ParseService.shutdown: BaseServer.shutdown() waits on an
+        # event only a running serve_forever loop sets — never call it
+        # for an endpoint that was constructed but not started.
+        if self._thread is not None:
+            self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class _StatsLogger:
+    """Daemon thread logging a one-line telemetry summary every
+    ``interval`` seconds: request/line counters, per-stage p99s, and
+    suppressed-warning counts (the end-of-run summary CappedLogger/
+    log_warning_once promise)."""
+
+    def __init__(self, interval: float):
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="logparser-tpu-stats", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.log_once()
+
+    @staticmethod
+    def log_once() -> None:
+        reg = metrics()
+        snap = reg.snapshot()
+        summary = {
+            "counters": {
+                k: v for k, v in snap["counters"].items()
+                if not k.startswith("stage_items_total")
+            },
+            "stage_p99_ms": {
+                stage: d["p99_ms"]
+                for stage, d in reg.stage_breakdown().items()
+            },
+        }
+        suppressed = suppressed_warning_counts()
+        if suppressed:
+            summary["suppressed_warnings"] = suppressed
+        LOG.info("service stats: %s", json.dumps(summary, sort_keys=True))
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class ParseService:
+    """The sidecar: `with ParseService() as svc: ... svc.port ...` or call
+    `serve_forever()` from a main program.
+
+    ``metrics_port`` (int, optional): also serve the process metrics
+    registry as a Prometheus ``/metrics`` HTTP endpoint on that port
+    (0 = ephemeral; read back via :attr:`metrics_port`).
+    ``stats_interval`` (seconds, optional): log a one-line telemetry
+    summary periodically at INFO level."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 metrics_port: Optional[int] = None,
+                 stats_interval: Optional[float] = None):
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -255,6 +420,12 @@ class ParseService:
         self._server.parser_cache = _ParserCache()  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
         self._serving = False
+        self._metrics: Optional[MetricsEndpoint] = None
+        if metrics_port is not None:
+            self._metrics = MetricsEndpoint(host, metrics_port)
+        self._stats_logger: Optional[_StatsLogger] = None
+        if stats_interval:
+            self._stats_logger = _StatsLogger(float(stats_interval))
 
     @property
     def host(self) -> str:
@@ -264,8 +435,22 @@ class ParseService:
     def port(self) -> int:
         return self._server.server_address[1]
 
+    @property
+    def metrics_port(self) -> Optional[int]:
+        """The bound /metrics HTTP port (None when not enabled)."""
+        return self._metrics.port if self._metrics is not None else None
+
+    def _start_sidecars(self) -> None:
+        log_version_banner_once(LOG)
+        if self._metrics is not None:
+            self._metrics.start()
+            LOG.info("serving /metrics on port %d", self._metrics.port)
+        if self._stats_logger is not None:
+            self._stats_logger.start()
+
     def start(self) -> "ParseService":
         self._serving = True
+        self._start_sidecars()
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="logparser-tpu-service",
             daemon=True,
@@ -275,6 +460,7 @@ class ParseService:
 
     def serve_forever(self) -> None:
         self._serving = True
+        self._start_sidecars()
         self._server.serve_forever()
 
     def shutdown(self) -> None:
@@ -283,6 +469,10 @@ class ParseService:
         if self._serving:
             self._server.shutdown()
         self._server.server_close()
+        if self._metrics is not None:
+            self._metrics.shutdown()
+        if self._stats_logger is not None:
+            self._stats_logger.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)
 
@@ -309,17 +499,26 @@ class ParseServiceClient:
         log_format: str,
         fields: Sequence[str],
         timestamp_format: Optional[str] = None,
+        stats: bool = False,
     ):
         self._sock = socket.create_connection((host, port))
+        self._stats = bool(stats)
+        #: Decoded STATS frame of the most recent parse() (stats sessions).
+        self.last_stats: Optional[Dict[str, Any]] = None
         config = {
             "log_format": log_format,
             "fields": list(fields),
             "timestamp_format": timestamp_format,
         }
+        if stats:
+            # Only stats sessions carry the key: a v1 server ignores it,
+            # but omitting it keeps this client byte-exact v1 by default.
+            config["stats"] = True
         write_frame(self._sock, json.dumps(config).encode("utf-8"))
 
     def parse(self, lines: Sequence[Union[str, bytes]]):
-        """Ship one batch; returns a pyarrow.Table."""
+        """Ship one batch; returns a pyarrow.Table.  On a stats session
+        the trailing STATS frame is decoded into :attr:`last_stats`."""
         import pyarrow as pa
 
         encoded = [
@@ -337,7 +536,15 @@ class ParseServiceClient:
         if response is None:
             raise ParseServiceError("server closed the connection")
         with pa.ipc.open_stream(pa.BufferReader(response)) as reader:
-            return reader.read_all()
+            table = reader.read_all()
+        if self._stats:
+            stats_frame = read_frame(self._sock)
+            if stats_frame is None:
+                raise ParseServiceError(
+                    "server closed the connection before the STATS frame"
+                )
+            self.last_stats = json.loads(stats_frame)
+        return table
 
     def close(self) -> None:
         try:
@@ -351,3 +558,70 @@ class ParseServiceClient:
 
     def __exit__(self, *exc: Any) -> None:
         self.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI: run the sidecar standalone with telemetry wired up
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python -m logparser_tpu.service``: serve the sidecar protocol,
+    optionally with a Prometheus /metrics endpoint and periodic stats
+    logging.  Env fallbacks: LOGPARSER_TPU_METRICS_PORT,
+    LOGPARSER_TPU_STATS_INTERVAL."""
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8123)
+    ap.add_argument(
+        "--metrics-port", type=int,
+        default=_env_int("LOGPARSER_TPU_METRICS_PORT"),
+        help="Prometheus /metrics HTTP port (0 = ephemeral; omit to disable)",
+    )
+    ap.add_argument(
+        "--stats-interval", type=float,
+        default=_env_float("LOGPARSER_TPU_STATS_INTERVAL"),
+        help="seconds between one-line telemetry summaries (omit to disable)",
+    )
+    ap.add_argument("--log-level", default=os.environ.get(
+        "LOGPARSER_TPU_LOG_LEVEL", "INFO"))
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=getattr(logging, str(args.log_level).upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    svc = ParseService(
+        args.host, args.port,
+        metrics_port=args.metrics_port,
+        stats_interval=args.stats_interval,
+    )
+    LOG.info("parse service listening on %s:%d", svc.host, svc.port)
+    try:
+        svc.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.shutdown()
+    return 0
+
+
+def _env_int(name: str) -> Optional[int]:
+    import os
+
+    raw = os.environ.get(name, "").strip()
+    return int(raw) if raw else None
+
+
+def _env_float(name: str) -> Optional[float]:
+    import os
+
+    raw = os.environ.get(name, "").strip()
+    return float(raw) if raw else None
+
+
+if __name__ == "__main__":  # pragma: no cover — CLI
+    raise SystemExit(main())

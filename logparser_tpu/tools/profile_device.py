@@ -7,6 +7,13 @@ fused executor under the profiler and prints per-fusion device time —
 ground truth the marginal-slope estimator in bench.py cannot give
 (it is jitter- and floor-limited; see ROADMAP).
 
+Set LOGPARSER_TPU_XPROF_STAGES=1 (or call
+``logparser_tpu.enable_stage_annotations()``) before capturing and the
+host planes of the same xplane trace carry ``lp.<stage>`` scopes named
+exactly like the metrics registry's pipeline stages
+(docs/OBSERVABILITY.md) — device fusions and host stages line up in one
+timeline.
+
 Usage::
 
     python -m logparser_tpu.tools.profile_device            # headline parser

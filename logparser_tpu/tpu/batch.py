@@ -1371,13 +1371,12 @@ class TpuBatchParser:
         is stripped — callers needing exact list semantics for such
         inputs use :meth:`parse_batch`."""
         from ..native import encode_blob
-        from ..observability import tracer
+        from ..observability import pipeline_stage, record_batch_shape
 
-        trace = tracer()
         data = bytes(data)
         lines = _BlobLines(data)
         B = len(lines)
-        with trace.stage("encode", items=B):
+        with pipeline_stage("encode", items=B):
             buf, lengths, overflow = encode_blob(data)
         if buf.shape[0] != B:  # framer/view disagreement: authoritative path
             return self.parse_batch(list(lines), emit_views=emit_views)
@@ -1385,6 +1384,7 @@ class TpuBatchParser:
         if padded_b != B:
             buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
             lengths = np.pad(lengths, (0, padded_b - B))
+        record_batch_shape(B, padded_b, buf.shape[1], int(lengths.sum()))
         enc = (lines, buf, lengths, overflow, B, padded_b)
         return self._finish_batch(self._dispatch_batch(enc, emit_views))
 
@@ -1446,30 +1446,40 @@ class TpuBatchParser:
         return self._jitted
 
     def _encode_batch(self, lines: Sequence[Union[bytes, str]]):
-        from ..observability import tracer
+        from ..observability import pipeline_stage, record_batch_shape
 
-        trace = tracer()
         B = len(lines)
-        with trace.stage("encode", items=B):
+        with pipeline_stage("encode", items=B):
             buf, lengths, overflow = encode_batch(lines)
         # Pad the batch dimension to a bucket so jit recompiles stay bounded.
         padded_b = _bucket_batch(B)
         if padded_b != B:
             buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
             lengths = np.pad(lengths, (0, padded_b - B))
+        record_batch_shape(B, padded_b, buf.shape[1], int(lengths.sum()))
         return list(lines), buf, lengths, overflow, B, padded_b
 
     def _dispatch_batch(self, enc, emit_views: Optional[bool] = None):
-        from ..observability import tracer
+        from ..observability import metrics, pipeline_stage, tracer
 
-        trace = tracer()
         lines, buf, lengths, overflow, B, padded_b = enc
         out = None
         fn = self._executor_for(emit_views)
         if fn is not None:
-            with trace.stage("device", items=B):
+            # Label by the executor actually chosen, not the request: a
+            # viewless parser's device_views_fn() falls back to the plain
+            # executor, and that dispatch must not read as views="on".
+            views_on = (
+                (emit_views is None or emit_views)
+                and bool(getattr(self, "_views_fields", None))
+            )
+            metrics().increment(
+                "device_dispatch_total",
+                labels={"views": "on" if views_on else "off"},
+            )
+            with pipeline_stage("device", items=B):
                 out = fn(jnp.asarray(buf), jnp.asarray(lengths))
-                if trace.enabled:
+                if tracer().enabled:
                     # Dispatch is async: make the device stage contain the
                     # actual kernel time instead of misattributing it to
                     # the fetch stage (only when someone is looking).
@@ -1484,9 +1494,8 @@ class TpuBatchParser:
         """Block on the in-flight device result: returns the fetched
         verdicts (packed rows, per-line validity/winner/plausibility)
         ready for :meth:`_materialize_packed`."""
-        from ..observability import tracer
+        from ..observability import metrics, pipeline_stage, tracer
 
-        trace = tracer()
         (lines, buf, lengths, overflow, B, padded_b, out, out_slots,
          emit_views) = state
 
@@ -1506,13 +1515,14 @@ class TpuBatchParser:
                 # ONE packed [sum K_i, B] int32 output -> ONE device->host
                 # fetch (transfer round-trips dominate on tunneled TPU
                 # attachments).
-                with trace.stage("device", items=B):
+                with pipeline_stage("device", items=B):
                     out = fn(jnp.asarray(buf), jnp.asarray(lengths))
-                    if trace.enabled:
+                    if tracer().enabled:
                         out = jax.block_until_ready(out)
                 out_slots = self.csr_slots
-            with trace.stage("fetch", items=B):
+            with pipeline_stage("fetch", items=B):
                 packed = np.asarray(jax.device_get(out))
+            metrics().increment("d2h_bytes_total", int(packed.nbytes))
             out = None
             # Per-line winner: first registered format whose automaton
             # accepted the line (row_offset row: bit 0 = valid, bit 1 =
@@ -1560,9 +1570,9 @@ class TpuBatchParser:
                 plausible_any, overflow)
 
     def _materialize_packed(self, fetched) -> BatchResult:
-        from ..observability import tracer
+        from ..observability import metrics, observe_stage
 
-        trace = tracer()
+        reg = metrics()
         (lines, buf, lengths, B, packed, valid, winner, plausible_any,
          overflow) = fetched
         columns: Dict[str, Dict[str, np.ndarray]] = {}
@@ -1786,7 +1796,7 @@ class TpuBatchParser:
                     if plan.null_mode == "dash_zero":
                         col["null_zero"] = np.where(sel, True, col["null_zero"])
             columns[fid] = col
-        trace.add("columns", time.perf_counter() - t_columns, items=B)
+        observe_stage("columns", time.perf_counter() - t_columns, items=B)
 
         # Host fallback: invalid lines entirely; host-only fields for every line.
         # Numeric coercion follows the kind of the format that won the
@@ -1813,7 +1823,7 @@ class TpuBatchParser:
             winner[i] = -1
             for fid in self.requested:
                 overrides[fid].pop(i, None)
-        trace.add("csr_materialize", time.perf_counter() - t_csr, items=B)
+        observe_stage("csr_materialize", time.perf_counter() - t_csr, items=B)
         # Invalid AND implausible-for-all-formats: definitely bad, counted
         # without an oracle visit (the single biggest fallback cost on
         # hostile corpora — garbage lines are almost never plausible).
@@ -1829,8 +1839,30 @@ class TpuBatchParser:
         for ui, flds in enumerate(self._unit_oracle_fields):
             if flds:
                 need_oracle.update(int(r) for r in np.nonzero(winner == ui)[0])
+        # Routed-line accounting by reject class (batch granularity): WHY
+        # each line left the device-only path.  overflow = truncated lines
+        # the device judged on a prefix; device_reject = no automaton
+        # accepted but some format stayed plausible; host_fields = the
+        # winning format cannot supply every requested field on device.
+        overflow_rows = {int(i) for i in overflow if 0 <= int(i) < B}
+        if bad:
+            reg.increment("definitely_bad_lines_total", bad)
+        if need_oracle:
+            # Disjoint by construction (overflow rows are forced invalid
+            # in _fetch_packed; the explicit exclusions keep the three
+            # classes summing to len(need_oracle) even if that drifts).
+            n_overflow = len(overflow_rows & need_oracle)
+            n_reject = len(invalid_rows - overflow_rows)
+            n_host = len(need_oracle - invalid_rows - overflow_rows)
+            for reason, n in (("overflow", n_overflow),
+                              ("device_reject", n_reject),
+                              ("host_fields", n_host)):
+                if n:
+                    reg.increment("oracle_routed_lines_total", n,
+                                  labels={"reason": reason})
         t_oracle = time.perf_counter()
         oracle_rows_sorted = sorted(need_oracle)
+        engine_before = self._oracle_engine_tally()
         oracle_results = self._run_oracle_many(
             [lines[i] for i in oracle_rows_sorted]
         )
@@ -1872,6 +1904,7 @@ class TpuBatchParser:
                 plan_cache[key] = got
             return got
 
+        oracle_rescued = oracle_rejected = 0
         for i, values in zip(oracle_rows_sorted, oracle_results):
             is_invalid = i in invalid_rows
             fields_needed = (
@@ -1880,11 +1913,13 @@ class TpuBatchParser:
                 else self._unit_oracle_fields[winner[i]]
             )
             if values is None:
+                oracle_rejected += 1
                 if is_invalid:
                     bad += 1
                 continue
             if is_invalid:
                 valid[i] = True
+                oracle_rescued += 1
             concrete, wild = delivery_plan(
                 fields_needed, int(winner[i]), is_invalid
             )
@@ -1908,12 +1943,20 @@ class TpuBatchParser:
                     for k, v in values.items()
                     if k.startswith(prefix)
                 }
-        trace.add(
+        observe_stage(
             "oracle_fallback", time.perf_counter() - t_oracle,
             items=len(need_oracle),
         )
+        if oracle_rescued:
+            reg.increment("oracle_rescued_lines_total", oracle_rescued)
+        if oracle_rejected:
+            reg.increment("oracle_rejected_lines_total", oracle_rejected)
+        self._fold_oracle_engine_tally(engine_before)
 
         good = int(B - bad)
+        reg.increment("good_lines_total", good)
+        if bad:
+            reg.increment("bad_lines_total", bad)
         # Device-emitted Arrow view rows (4 per span field, after the unit
         # rows): handed to the Arrow bridge, which interleaves them into
         # string_view structs without touching the byte buffer.  Overflow
@@ -2357,6 +2400,33 @@ class TpuBatchParser:
                             overrides[fid][i] = attrs[key]
                     continue
                 overrides[fid][i] = (d.get(p.comp) if d else None)
+
+    def _oracle_engine_tally(self) -> Optional[Dict[str, int]]:
+        """Snapshot of the oracle's compiled line engine tallies (None when
+        no fastline engine is active).  Used to fold per-batch DELTAS into
+        the metrics registry — per-line increments stay plain ints on the
+        engine; the registry is only touched at batch granularity."""
+        engine = getattr(self.oracle, "_fastline", None)
+        tally = getattr(engine, "tally", None)
+        return dict(tally) if isinstance(tally, dict) else None
+
+    def _fold_oracle_engine_tally(self, before: Optional[Dict[str, int]]) -> None:
+        """Fold the oracle engine's tally delta since ``before`` into the
+        registry as oracle_engine_lines_total{outcome=...}.  The spawn-pool
+        path runs engines in child processes, so only inline-parsed lines
+        are covered — the routed/rescued/rejected counters above are the
+        complete view."""
+        after = self._oracle_engine_tally()
+        if after is None:
+            return
+        from ..observability import metrics
+
+        reg = metrics()
+        for outcome, n in after.items():
+            delta = n - (before or {}).get(outcome, 0)
+            if delta > 0:
+                reg.increment("oracle_engine_lines_total", delta,
+                              labels={"outcome": outcome})
 
     def _run_oracle(self, line: Union[bytes, str]) -> Optional[Dict[str, Any]]:
         if isinstance(line, bytes):

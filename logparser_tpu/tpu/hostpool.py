@@ -88,13 +88,51 @@ class AssemblyPool:
     def run_all(self, tasks: Sequence[Callable[[], Any]]) -> List[Any]:
         """Run independent thunks, returning results in order.  Serial
         when the pool is 1-wide, closed, or there is nothing to
-        overlap; the first raised exception propagates either way."""
+        overlap; the first raised exception propagates either way.
+
+        The pooled path feeds the metrics registry (batch granularity —
+        once per run plus one cheap gauge/counter update per TASK, and
+        tasks are per-column, not per-line): queue depth and in-flight
+        gauges, busy/wall second counters (utilization =
+        busy / (wall * workers)), and a per-task (per-column assembly)
+        time histogram.  The 1-wide serial path stays untouched — it is
+        the bit-for-bit pre-pool baseline the parity suite pins."""
         if self.workers == 1 or len(tasks) <= 1:
             return [t() for t in tasks]
         ex = self._get_executor()
         if ex is None:
             return [t() for t in tasks]
-        return list(ex.map(lambda t: t(), tasks))
+
+        import time
+
+        from ..observability import metrics
+
+        reg = metrics()
+        reg.gauge_set("hostpool_workers", self.workers)
+        reg.increment("hostpool_runs_total")
+        reg.increment("hostpool_tasks_total", len(tasks))
+        reg.gauge_add("hostpool_queue_depth", len(tasks))
+
+        def timed(t: Callable[[], Any]) -> Any:
+            # Submitted -> running: the task leaves the queue.
+            reg.gauge_add("hostpool_queue_depth", -1)
+            reg.gauge_add("hostpool_active_workers", 1)
+            t0 = time.perf_counter()
+            try:
+                return t()
+            finally:
+                dt = time.perf_counter() - t0
+                reg.gauge_add("hostpool_active_workers", -1)
+                reg.increment("hostpool_busy_seconds_total", dt)
+                reg.observe("hostpool_task_seconds", dt)
+
+        t_run = time.perf_counter()
+        try:
+            return list(ex.map(timed, tasks))
+        finally:
+            reg.increment(
+                "hostpool_wall_seconds_total", time.perf_counter() - t_run
+            )
 
     def close(self) -> None:
         """Terminal: later run_all calls execute serially instead of

@@ -104,6 +104,58 @@ def test_01_column_types(service):
         sock.close()
 
 
+@pytest.mark.slow  # Full golden-vector session (service-side parser compile): slow tier.
+def test_01_bytes_identical_with_telemetry(service):
+    """Round-7 compatibility rule: a v1 session (no `stats` CONFIG key)
+    replays the golden vector BYTE-identically whether or not telemetry
+    is active in the process (tracing enabled, registry populated, a
+    concurrent stats-enabled session having run)."""
+    import json as _json
+    import struct as _struct
+
+    import logparser_tpu
+
+    def replay():
+        sock = _connect_and_send(service, "01_session_request.bin")
+        try:
+            frames = [recv_response(sock) for _ in range(2)]
+            assert sock.recv(1) == b""
+        finally:
+            sock.close()
+        return frames
+
+    baseline = replay()
+    # Turn telemetry loud: tracer on, registry churned by a stats session
+    # against the SAME server (exercises the stats-enabled code path).
+    tracer = logparser_tpu.enable_tracing()
+    try:
+        sock = socket.create_connection((service.host, service.port))
+        try:
+            config = _json.dumps({
+                "log_format": "combined",
+                "fields": ["IP:connection.client.host"],
+                "stats": True,
+            }).encode()
+            sock.sendall(_struct.pack(">I", len(config)) + config)
+            line = (b'9.8.7.6 - - [01/Jan/2026:00:00:00 +0000] '
+                    b'"GET / HTTP/1.1" 200 5 "-" "x"')
+            payload = _struct.pack(">I", 1) + line
+            sock.sendall(_struct.pack(">I", len(payload)) + payload)
+            kind, _arrow = recv_response(sock)
+            assert kind == "arrow"
+            kind2, stats_frame = recv_response(sock)
+            assert kind2 == "arrow"  # a STATS frame is an ordinary frame
+            assert _json.loads(stats_frame)["v"] == 1
+            sock.sendall(_struct.pack(">I", 0))
+        finally:
+            sock.close()
+        with_telemetry = replay()
+    finally:
+        logparser_tpu.disable_tracing()
+    assert with_telemetry == baseline
+    assert tracer.report()  # the replay really ran under tracing
+
+
 def test_02_bad_config_vector(service):
     sock = _connect_and_send(service, "02_bad_config_request.bin")
     try:
