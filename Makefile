@@ -1,7 +1,7 @@
 # Dev entry points (the reference's Maven/devtools tier, L0).
 PY ?= python
 
-.PHONY: test test-fast metrics-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -24,6 +24,13 @@ test-fast:
 # fast tier.
 metrics-smoke:
 	$(PY) -m logparser_tpu.tools.metrics_smoke
+
+# Feeder smoke: the sharded ingest fabric (2 workers x 2 shard sizes over
+# a demolog corpus) must be byte- and parse-parity-identical to
+# single-process parse_blob, with the feeder_* metric families exposed
+# (docs/FEEDER.md).  CI runs this after metrics-smoke.
+feeder-smoke:
+	$(PY) -m logparser_tpu.tools.feeder_smoke
 
 lint:
 	$(PY) -m ruff check logparser_tpu tests

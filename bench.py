@@ -54,6 +54,14 @@ ARROW_FLOORS = (("combined", 10e6), ("nginx_uri", 5e6))
 # recorded rate, or when its reported spread exceeds this ± band.
 ARROW_REGRESSION_FRACTION = 0.85
 ARROW_SPREAD_GATE_PCT = 15.0
+# Feeder gate (round 8): the sharded ingest fabric's measured feed rate
+# must not regress below this fraction of the previous committed round,
+# and the device consumer must spend < 5% of feed wall time starved
+# (the acceptance bar that replaced BASELINE.md's 83 GB/s prose).
+FEEDER_REGRESSION_FRACTION = 0.85
+FEEDER_STARVATION_GATE = 0.05
+FEEDER_CORPUS_REPEATS = 2
+FEEDER_SHARD_BYTES = 4 << 20
 
 GEO_TEST_DATA = "/root/reference/GeoIP2-TestData/test-data"
 if not os.path.isdir(GEO_TEST_DATA):
@@ -325,6 +333,124 @@ def kernel_rate(parser, lines, iters=5, views=False):
         return None
     ms = prof[0][1] / iters
     return ms, len(lines) / ms * 1000.0
+
+
+def bench_feeder(parser, lines):
+    """The ingest-fabric section (round 8): MEASURED feed rate of the
+    sharded feeder on this host, replacing BASELINE.md's 83 GB/s
+    projection prose with a number.
+
+    Two passes over a disk corpus (the headline lines, repeated):
+
+    - drain-only: workers read + frame at full speed into a no-op
+      consumer — the fabric's raw single-host feed capability in
+      bytes/s (what multi-host scaling multiplies);
+    - device-fed: ``FeederPool.feed(parser)`` drives the real device
+      consumer — ``starvation_fraction`` is the share of feed wall time
+      the consumer spent blocked on an empty queue (the "is the chip
+      starving" gate, < FEEDER_STARVATION_GATE).
+    """
+    import tempfile
+
+    from logparser_tpu.feeder import FeederPool, default_feeder_workers
+
+    blob = "\n".join(lines).encode()
+    corpus = b"\n".join([blob] * FEEDER_CORPUS_REPEATS)
+    n_lines = len(lines) * FEEDER_CORPUS_REPEATS
+    workers = default_feeder_workers()
+
+    fd, path = tempfile.mkstemp(suffix=".log")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(corpus)
+
+        drain = FeederPool([path], workers=workers,
+                           shard_bytes=FEEDER_SHARD_BYTES,
+                           batch_lines=CONFIG_BATCH)
+        drained = 0
+        for eb in drain.batches():
+            drained += eb.source_bytes
+        dstats = drain.stats()
+        assert drained == len(corpus), (
+            f"feeder byte-parity broke: drained {drained} of {len(corpus)}"
+        )
+
+        fed = FeederPool([path], workers=workers,
+                         shard_bytes=FEEDER_SHARD_BYTES,
+                         batch_lines=CONFIG_BATCH)
+        fed_lines = 0
+        for res in fed.feed(parser):
+            fed_lines += res.lines_read
+        fstats = fed.stats()
+        assert fed_lines == n_lines, (
+            f"feeder line-parity broke: parsed {fed_lines} of {n_lines}"
+        )
+    finally:
+        os.unlink(path)
+
+    bps = dstats.get("bytes_per_sec", 0.0)
+    steady_s = dstats["wall_s"] - dstats["startup_s"]
+    return {
+        "workers": workers,
+        "mode": dstats["mode"],
+        "shards": dstats["shards"],
+        "corpus_bytes": len(corpus),
+        "corpus_lines": n_lines,
+        "batch_lines": CONFIG_BATCH,
+        # Raw fabric capability: steady-state framing rate into a no-op
+        # consumer (pipeline-fill startup reported separately).
+        "feed_bytes_per_sec": bps,
+        "feed_gb_per_sec": round(bps / 1e9, 4),
+        "feed_lines_per_sec": round(
+            n_lines / steady_s, 1) if steady_s > 0 else 0.0,
+        "startup_s": round(dstats["startup_s"], 4),
+        "queue_depth_max": dstats["queue_depth_max"],
+        "queue_depth_mean": dstats["queue_depth_mean"],
+        "read_s": round(dstats["read_s"], 4),
+        "encode_s": round(dstats["encode_s"], 4),
+        # Device-fed pass: the gated starvation number.
+        "fed_wall_s": round(fstats["wall_s"], 4),
+        "fed_lines_per_sec": round(
+            n_lines / fstats["wall_s"], 1) if fstats["wall_s"] else 0.0,
+        "starvation_s": round(fstats["starvation_s"], 4),
+        "starvation_fraction": fstats.get("starvation_fraction", 0.0),
+    }
+
+
+def previous_round_feeder():
+    """Latest committed BENCH_r*.json feeder section CARRYING a usable
+    feed rate (the baseline for the regression gate).  A round whose
+    feeder section errored (bench writes ``{"error": true}``) must not
+    become a vacuous baseline — keep scanning older rounds instead of
+    silently disabling the gate.  ({}, None) before round 8."""
+    import glob
+
+    def usable(sec):
+        return (
+            isinstance(sec, dict)
+            and not sec.get("error")
+            and (sec.get("feed_bytes_per_sec") or sec.get("gbps"))
+        )
+
+    paths = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and usable(doc.get("feeder")):
+                return doc["feeder"], os.path.basename(path)
+            text = doc.get("tail", "") if isinstance(doc, dict) else ""
+            key = '"feeder":'
+            idx = text.rindex(key)
+            sec, _ = json.JSONDecoder().raw_decode(
+                text[idx + len(key):].lstrip()
+            )
+            if usable(sec):
+                return sec, os.path.basename(path)
+        except Exception:  # noqa: BLE001 — a malformed record is no baseline
+            continue
+    return {}, None
 
 
 def previous_round_configs():
@@ -755,6 +881,14 @@ def main():
                     ) * 4
     d2h_plain = int(np.prod(jax.eval_shape(fn, jbuf, jlengths).shape)) * 4
 
+    # ---- feeder: the sharded ingest fabric (round 8) --------------------
+    # Still inside the clean phase (worker processes fork/spawn before the
+    # profiler's tensorflow import can pollute the parent).
+    try:
+        feeder_section = bench_feeder(parser, lines)
+    except Exception as e:  # noqa: BLE001 — the section must not kill the run
+        feeder_section = {"error": f"{type(e).__name__}: {e}"}
+
     # ---- all five BASELINE configs: host-side phase ---------------------
     # Strict two-phase order: every HOST measurement (oracle, Arrow) for
     # every config BEFORE the first kernel_rate call — the xplane parse
@@ -893,6 +1027,30 @@ def main():
                 f"{c_ar:.3g} rows/s (below {ARROW_REGRESSION_FRACTION:.0%}"
                 f" of {prev_name})"
             )
+    # (e) Feeder gate (round 8): the ingest fabric must exist and be
+    #     measured, the device consumer must not starve (> 5% of feed
+    #     wall time blocked on an empty queue), and the measured feed
+    #     rate must not regress below the previous committed round's.
+    if "error" in feeder_section:
+        gate_failures.append(f"feeder: {feeder_section['error']}")
+    else:
+        starv = feeder_section.get("starvation_fraction", 0.0)
+        if starv > FEEDER_STARVATION_GATE:
+            gate_failures.append(
+                f"feeder: device consumer starved {starv:.1%} of feed "
+                f"wall time (> {FEEDER_STARVATION_GATE:.0%})"
+            )
+        prev_feeder, prev_feeder_name = previous_round_feeder()
+        p_bps = prev_feeder.get("feed_bytes_per_sec") or (
+            (prev_feeder.get("gbps") or 0) * 1e9
+        )
+        c_bps = feeder_section.get("feed_bytes_per_sec", 0.0)
+        if p_bps and c_bps < FEEDER_REGRESSION_FRACTION * p_bps:
+            gate_failures.append(
+                f"feeder: feed rate regressed {p_bps:.3g} -> {c_bps:.3g} "
+                f"B/s (below {FEEDER_REGRESSION_FRACTION:.0%} of "
+                f"{prev_feeder_name})"
+            )
 
     headline = round(headline_kern[1], 1) if headline_kern else round(
         device_resident, 1)
@@ -946,6 +1104,9 @@ def main():
             # measured over the headline 64k parse + arrow iterations.
             "stage_breakdown": delivery_stage_breakdown,
         },
+        # The sharded ingest fabric: measured single-host feed rate +
+        # device-consumer starvation (BASELINE.md "feeding the mesh").
+        "feeder": feeder_section,
         "pipelined_end_to_end_lines_per_sec": round(pipelined, 1),
         "stream_lines_per_sec": round(stream_lps, 1),
         "serialized_lines_per_sec": round(serialized_lps, 1),
@@ -1020,6 +1181,13 @@ def main():
         "p99_batch_latency_ms": full["p99_batch_latency_ms"],
         "p99_framework_ms": full["p99_framework_ms"],
         "packed_d2h_bytes_per_batch": full["packed_d2h_bytes_per_batch"],
+        "feeder": (
+            {"error": True} if "error" in feeder_section else {
+                "gbps": feeder_section["feed_gb_per_sec"],
+                "starv_pct": round(
+                    feeder_section["starvation_fraction"] * 100.0, 2),
+            }
+        ),
         "oracle_fraction_max": full["oracle_fraction_max"],
         "gate_failures": gate_failures,
         "configs": compact_cfgs,

@@ -1,0 +1,31 @@
+"""Sharded feeder subsystem: the multi-process ingest fabric.
+
+Turns raw log sources into a steady, ordered stream of framed,
+device-ready batches so the device tier is never input-starved
+(docs/FEEDER.md; BASELINE.md's 83 GB/s feed question).  Three layers:
+
+- :mod:`~logparser_tpu.feeder.shards` — byte-range shard planning with
+  newline-boundary healing (the reference InputFormat's split
+  semantics: a line belongs to the shard where it starts);
+- :mod:`~logparser_tpu.feeder.worker` — the jax-free worker loop that
+  reads + frames shards with the ``parse_blob`` framing;
+- :mod:`~logparser_tpu.feeder.pool` — :class:`FeederPool`, the consumer
+  API: ``batches()`` (ordered EncodedBatch stream with backpressure)
+  and ``feed(parser)`` (BatchResults via ``parse_batch_stream``).
+"""
+from .pool import (  # noqa: F401
+    DEFAULT_BATCH_LINES,
+    FeederError,
+    FeederPool,
+    default_feeder_workers,
+)
+from .shards import (  # noqa: F401
+    DEFAULT_SHARD_BYTES,
+    Shard,
+    healed_payload,
+    healed_range,
+    line_start_at_or_after,
+    normalize_sources,
+    plan_shards,
+)
+from .worker import EncodedBatch, split_batches  # noqa: F401

@@ -1,0 +1,427 @@
+"""FeederPool: the multi-process ingest fabric behind one iterator.
+
+``FeederPool(sources).batches()`` turns raw log sources (file paths or
+in-memory blobs) into a steady, ORDERED stream of framed
+:class:`~logparser_tpu.feeder.worker.EncodedBatch` items:
+
+- the shard planner tiles the sources into byte-range shards with
+  newline-boundary healing (``feeder/shards.py`` — the reference's
+  InputFormat split semantics);
+- N workers (processes by default, threads as fallback or on request)
+  read + frame their shards with the ``parse_blob`` framing and push
+  into per-worker BOUNDED queues — a full queue blocks its worker, so
+  the consumer's drain rate backpressures the whole fabric;
+- the consumer drains shards in global order (shard i lives in worker
+  ``i % N``'s queue), so delivery order equals single-process
+  ``parse_blob`` order with no reorder buffer and no deadlock: each
+  queue has exactly one producer and one consumer.
+
+``feed(parser)`` pipes the stream through
+``TpuBatchParser.parse_batch_stream`` (which adopts pre-encoded batches
+without re-framing), yielding one BatchResult per batch in corpus order.
+
+Telemetry (the PR-2 metrics registry, docs/OBSERVABILITY.md):
+``feeder_bytes_read_total``, ``feeder_lines_total``,
+``feeder_batches_total``, ``feeder_shards_total`` counters; the
+``feeder_queue_depth`` gauge (producer-updated in threads mode, sampled
+at every dequeue otherwise); ``feeder_starvation_seconds_total`` (wall
+time the consumer spent blocked on an empty queue — the "is the chip
+starving" number); per-shard/per-batch stage timings via
+``observe_stage`` (``feeder_read``, ``feeder_encode``,
+``feeder_shard``).
+"""
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from ..observability import log_warning_once, metrics, observe_stage
+from .shards import (
+    DEFAULT_SHARD_BYTES,
+    Shard,
+    SourceT,
+    normalize_sources,
+    plan_shards,
+)
+from .worker import (
+    MSG_BATCH,
+    MSG_DONE,
+    MSG_ERROR,
+    MSG_SHARD_DONE,
+    EncodedBatch,
+    make_instrumented_queue,
+    run_worker,
+)
+
+import logging
+
+LOG = logging.getLogger(__name__)
+
+DEFAULT_BATCH_LINES = 16384
+
+
+class FeederError(RuntimeError):
+    """A feeder worker died; carries the worker traceback."""
+
+
+def default_feeder_workers() -> int:
+    """Process-parallel framing saturates around the core count; capped
+    like the assembly pool so a big host doesn't fork 64 readers."""
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+class FeederPool:
+    """See module docstring.  Parameters:
+
+    - ``sources``: file paths and/or bytes blobs, in corpus order.
+    - ``workers``: feeder worker count (default
+      :func:`default_feeder_workers`, clamped to the shard count).
+    - ``shard_bytes``: raw shard size for the planner.
+    - ``batch_lines``: lines per emitted batch (the device batch size).
+    - ``line_len``: pin the framed ``L`` (0 = per-batch length bucket,
+      exactly ``parse_blob``'s default).
+    - ``queue_batches``: per-worker queue bound — the backpressure
+      window, in batches.
+    - ``use_processes``: True/False forces the worker flavor; None
+      prefers processes and falls back to threads when multiprocessing
+      is unavailable.  Processes default to the ``forkserver`` context
+      (``spawn`` where unavailable): the parent may hold an initialized
+      device runtime, which plain ``fork`` would duplicate into
+      children that must never touch the chip, and ``spawn`` re-runs
+      ``__main__`` (bench/driver scripts would re-import heavily).
+    - ``worker_delay_s``: per-batch producer sleep (shaping/test hook).
+    """
+
+    def __init__(
+        self,
+        sources: Sequence[SourceT],
+        workers: Optional[int] = None,
+        shard_bytes: int = DEFAULT_SHARD_BYTES,
+        batch_lines: int = DEFAULT_BATCH_LINES,
+        line_len: int = 0,
+        queue_batches: int = 4,
+        use_processes: Optional[bool] = None,
+        mp_context: Optional[str] = None,
+        worker_delay_s: float = 0.0,
+    ):
+        if not sources:
+            raise ValueError("FeederPool needs at least one source")
+        self._sources = normalize_sources(sources)
+        self.shards: List[Shard] = plan_shards(self._sources, shard_bytes)
+        n_workers = workers if workers else default_feeder_workers()
+        self.workers = max(1, min(int(n_workers), max(1, len(self.shards))))
+        self.batch_lines = int(batch_lines)
+        self.line_len = int(line_len)
+        self.queue_batches = max(1, int(queue_batches))
+        self._use_processes = use_processes
+        self._mp_context = mp_context
+        self._worker_delay_s = float(worker_delay_s)
+        self.mode: Optional[str] = None  # "process" | "thread" once started
+        self._queues: List[Any] = []
+        self._procs: List[Any] = []
+        self._stop: Any = None
+        self._started = False
+        self._closed = False
+        self._stats: Dict[str, Any] = {
+            "shards": len(self.shards),
+            "workers": self.workers,
+            "batches": 0,
+            "lines": 0,
+            "payload_bytes": 0,
+            "read_s": 0.0,
+            "encode_s": 0.0,
+            "starvation_s": 0.0,
+            "startup_s": 0.0,
+            "wall_s": 0.0,
+            "queue_depth_max": 0,
+            "queue_depth_mean": 0.0,
+        }
+        self._depth_samples = 0
+        self._depth_sum = 0
+        self._primed = False  # first item delivered (pipeline filled)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def _start(self) -> None:
+        if self._started:
+            raise RuntimeError("FeederPool.batches() can only run once")
+        self._started = True
+        shards_of = [self._worker_plan(self.shards[w :: self.workers])
+                     for w in range(self.workers)]
+        if self._use_processes is not False:
+            try:
+                self._start_processes(shards_of)
+                return
+            except Exception as e:  # noqa: BLE001 — environment-dependent
+                if self._use_processes:
+                    raise
+                log_warning_once(
+                    LOG,
+                    "feeder: multiprocessing unavailable "
+                    f"({type(e).__name__}); falling back to threads",
+                )
+        self._start_threads(shards_of)
+
+    def _worker_plan(self, shards: List[Shard]):
+        """(sources, shards) restricted to what ONE worker touches: its
+        shard subset with source indices remapped into a filtered source
+        list — spawned workers must not each receive a pickled copy of
+        every in-memory blob in the pool (shard indices stay GLOBAL;
+        only source references are localized)."""
+        from dataclasses import replace
+
+        used = sorted({s.source for s in shards})
+        remap = {g: l for l, g in enumerate(used)}
+        return (
+            [self._sources[g] for g in used],
+            [replace(s, source=remap[s.source]) for s in shards],
+        )
+
+    def _start_processes(self, shards_of) -> None:
+        import multiprocessing as mp
+
+        method = self._mp_context
+        if method is None:
+            method = ("forkserver"
+                      if "forkserver" in mp.get_all_start_methods()
+                      else "spawn")
+        ctx = mp.get_context(method)
+        self._stop = ctx.Event()
+        self._queues = [ctx.Queue(maxsize=self.queue_batches)
+                        for _ in range(self.workers)]
+        procs = []
+        try:
+            for w in range(self.workers):
+                w_sources, w_shards = shards_of[w]
+                p = ctx.Process(
+                    target=run_worker,
+                    args=(w, w_sources, w_shards, self._queues[w],
+                          self.batch_lines, self.line_len, self._stop,
+                          self._worker_delay_s),
+                    name=f"logparser-tpu-feeder-{w}",
+                    daemon=True,
+                )
+                p.start()
+                procs.append(p)
+        except Exception:
+            for p in procs:
+                p.terminate()
+            raise
+        self._procs = procs
+        self.mode = "process"
+
+    def _start_threads(self, shards_of) -> None:
+        self._stop = threading.Event()
+        raw = [_queue.Queue(maxsize=self.queue_batches)
+               for _ in range(self.workers)]
+        # Producer-side gauge updates: only possible in-process.
+        self._queues = raw
+        instrumented = [
+            make_instrumented_queue(q, self._publish_depth) for q in raw
+        ]
+        self._procs = []
+        for w in range(self.workers):
+            w_sources, w_shards = shards_of[w]
+            t = threading.Thread(
+                target=run_worker,
+                args=(w, w_sources, w_shards, instrumented[w],
+                      self.batch_lines, self.line_len, self._stop,
+                      self._worker_delay_s),
+                name=f"logparser-tpu-feeder-{w}",
+                daemon=True,
+            )
+            t.start()
+            self._procs.append(t)
+        self.mode = "thread"
+
+    def close(self) -> None:
+        """Stop workers and drop queues.  Idempotent; also runs on
+        normal exhaustion of :meth:`batches`."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._stop is not None:
+            self._stop.set()
+        # Drain so workers blocked on a full queue observe the stop event
+        # promptly instead of at their next 0.1 s put timeout.
+        for q in self._queues:
+            try:
+                while True:
+                    q.get_nowait() if hasattr(q, "get_nowait") else q.get(
+                        timeout=0
+                    )
+            except Exception:  # noqa: BLE001 — Empty from either flavor
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if hasattr(p, "terminate") and p.is_alive():
+                p.terminate()
+        for q in self._queues:
+            # mp.Queue feeder threads keep the process alive unless
+            # cancelled; plain queue.Queue has no such method.
+            if hasattr(q, "cancel_join_thread"):
+                q.cancel_join_thread()
+        metrics().gauge_set("feeder_queue_depth", 0)
+
+    def __enter__(self) -> "FeederPool":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- metrics helpers -------------------------------------------------
+
+    def _queue_depth(self) -> int:
+        total = 0
+        for q in self._queues:
+            try:
+                total += q.qsize()
+            except (NotImplementedError, OSError):
+                return -1  # platform without qsize (macOS mp queues)
+        return total
+
+    def _publish_depth(self) -> None:
+        depth = self._queue_depth()
+        if depth >= 0:
+            metrics().gauge_set("feeder_queue_depth", depth)
+
+    def _sample_depth(self) -> None:
+        depth = self._queue_depth()
+        if depth < 0:
+            return
+        metrics().gauge_set("feeder_queue_depth", depth)
+        self._depth_samples += 1
+        self._depth_sum += depth
+        if depth > self._stats["queue_depth_max"]:
+            self._stats["queue_depth_max"] = depth
+
+    # -- consumption -----------------------------------------------------
+
+    def _get(self, q, worker: int):
+        """Blocking dequeue that accounts starvation and watches THIS
+        queue's producer (a crashed worker must surface as FeederError,
+        not a hang — even while sibling workers are alive and blocked
+        on their own full queues)."""
+        t_enter = time.perf_counter()
+        blocked = 0.0  # time spent in Empty waits only — a successful
+        # get's own duration (pipe read + unpickling of a multi-MB
+        # batch in process mode) is transfer, not starvation.
+        while True:
+            t0 = time.perf_counter()
+            try:
+                # Short poll: blocked time is only observable in whole
+                # Empty windows, so the window is the accounting grain.
+                msg = q.get(timeout=0.05)
+                break
+            except _queue.Empty:
+                blocked += time.perf_counter() - t0
+                if not self._procs[worker].is_alive():
+                    # Producer gone with its queue empty: it died before
+                    # reporting (e.g. SIGKILL).  One grace re-read in
+                    # case its final messages were still in flight.
+                    try:
+                        msg = q.get(timeout=0.5)
+                        break
+                    except _queue.Empty:
+                        raise FeederError(
+                            f"feeder worker {worker} exited without "
+                            "completing its shards"
+                        ) from None
+        if not self._primed:
+            # Pipeline fill — worker start, first read/frame AND the
+            # first item's queue transfer — is startup latency, not
+            # starvation: the chip wasn't waiting on a fabric that had
+            # ever been ahead of it.  Post-prime gets only ever count
+            # their Empty windows (the transfer itself is throughput).
+            self._primed = True
+            self._stats["startup_s"] = time.perf_counter() - t_enter
+        elif blocked > 0:
+            self._stats["starvation_s"] += blocked
+            metrics().increment("feeder_starvation_seconds_total", blocked)
+        self._sample_depth()
+        return msg
+
+    def batches(self) -> Iterator[EncodedBatch]:
+        """The ordered batch stream (single use).  Yields every framed
+        batch of every shard, in global shard order, then joins the
+        workers and closes the pool."""
+        self._start()
+        reg = metrics()
+        t_start = time.perf_counter()
+        try:
+            for shard in self.shards:
+                worker = shard.index % self.workers
+                q = self._queues[worker]
+                while True:
+                    msg = self._get(q, worker)
+                    kind = msg[0]
+                    if kind == MSG_BATCH:
+                        eb: EncodedBatch = msg[1]
+                        assert eb.shard == shard.index, (
+                            f"feeder ordering violated: got shard "
+                            f"{eb.shard}, expected {shard.index}"
+                        )
+                        self._stats["batches"] += 1
+                        self._stats["lines"] += eb.n_lines
+                        self._stats["payload_bytes"] += eb.source_bytes
+                        self._stats["read_s"] += eb.read_s
+                        self._stats["encode_s"] += eb.encode_s
+                        reg.increment("feeder_bytes_read_total",
+                                      eb.source_bytes)
+                        reg.increment("feeder_lines_total", eb.n_lines)
+                        reg.increment("feeder_batches_total")
+                        observe_stage("feeder_read", eb.read_s,
+                                      items=eb.n_lines)
+                        observe_stage("feeder_encode", eb.encode_s,
+                                      items=eb.n_lines)
+                        yield eb
+                    elif kind == MSG_SHARD_DONE:
+                        _, sidx, wall_s, n_lines, _nbytes = msg
+                        assert sidx == shard.index
+                        reg.increment("feeder_shards_total")
+                        observe_stage("feeder_shard", wall_s, items=n_lines)
+                        break
+                    elif kind == MSG_ERROR:
+                        raise FeederError(
+                            f"feeder worker {msg[1]} failed:\n{msg[2]}"
+                        )
+                    else:  # MSG_DONE out of order: worker finished early
+                        raise FeederError(
+                            f"feeder protocol violation: {kind!r} before "
+                            f"shard {shard.index} completed"
+                        )
+        finally:
+            self._stats["wall_s"] = time.perf_counter() - t_start
+            if self._depth_samples:
+                self._stats["queue_depth_mean"] = round(
+                    self._depth_sum / self._depth_samples, 3
+                )
+            self.close()
+
+    def feed(self, parser, emit_views: Optional[bool] = None, depth: int = 1):
+        """Drive ``parser`` (a TpuBatchParser) over the batch stream:
+        yields one BatchResult per batch, in corpus order, with the
+        host-side stages of batch k overlapping the device work of batch
+        k+1 (``parse_batch_stream`` semantics)."""
+        return parser.parse_batch_stream(
+            self.batches(), depth=depth, emit_views=emit_views
+        )
+
+    def stats(self) -> Dict[str, Any]:
+        """Post-run (or mid-run) feed accounting.  Rates and the
+        starvation fraction are computed over the STEADY window (wall
+        minus pipeline-fill startup): the one-time worker start + first
+        read/frame latency is reported as ``startup_s`` instead of
+        polluting the sustained numbers."""
+        out = dict(self._stats)
+        out["mode"] = self.mode
+        steady = out["wall_s"] - out["startup_s"]
+        if steady > 0:
+            out["bytes_per_sec"] = round(out["payload_bytes"] / steady, 1)
+            out["starvation_fraction"] = round(
+                out["starvation_s"] / steady, 4
+            )
+        return out

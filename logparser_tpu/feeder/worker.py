@@ -1,0 +1,176 @@
+"""Feeder worker loop: shard payloads -> framed, device-ready batches.
+
+Each worker owns a deterministic subset of the shard plan (shard i goes
+to worker ``i % N``) and pushes :class:`EncodedBatch` items into its own
+BOUNDED queue — a full queue blocks the worker, which is the whole
+backpressure story (the device consumer's drain rate caps host read
+rate; nothing buffers unboundedly).
+
+Framing is exactly ``TpuBatchParser.parse_blob``'s: the same
+:func:`logparser_tpu.native.encode_blob` packs each batch's line bytes
+into the padded ``[B, L]`` uint8 buffer (trailing-newline empty segment
+dropped, one trailing ``\\r`` per line stripped), so feeder output is
+byte-identical to single-process ``parse_blob`` over the same corpus.
+The module is jax-free and picklable — it runs inside ``spawn``ed
+worker processes that must never acquire the device.
+"""
+from __future__ import annotations
+
+import time
+import traceback
+from dataclasses import dataclass, field
+from queue import Full
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .shards import Shard, _Source, read_shard_payload
+
+# Queue message kinds (worker -> consumer).
+MSG_BATCH = "batch"
+MSG_SHARD_DONE = "shard_done"
+MSG_DONE = "done"
+MSG_ERROR = "error"
+
+
+@dataclass
+class EncodedBatch:
+    """One framed batch: the raw line bytes (kept for lazy oracle rescue
+    and byte-parity checks) plus the device-ready encoded buffers.
+
+    ``TpuBatchParser.parse_encoded`` / ``parse_batch_stream`` adopt this
+    directly — the consumer process never re-scans the payload."""
+
+    shard: int                  # global shard index
+    index: int                  # batch index within the shard
+    payload: bytes              # the batch's raw line bytes (with '\n's)
+    buf: np.ndarray             # [B, L] uint8 (unpadded batch dim)
+    lengths: np.ndarray         # [B] int32
+    overflow: List[int] = field(default_factory=list)
+    n_lines: int = 0
+    read_s: float = 0.0         # this batch's share of the shard read
+    encode_s: float = 0.0       # framing wall time (worker-side)
+
+    @property
+    def source_bytes(self) -> int:
+        return len(self.payload)
+
+    @property
+    def order_key(self) -> Tuple[int, int]:
+        return (self.shard, self.index)
+
+
+def split_batches(payload: bytes, batch_lines: int) -> List[Tuple[int, int]]:
+    """Line-aligned (start, end) byte ranges of successive
+    ``batch_lines``-line groups of ``payload`` (last group takes the
+    remainder; a trailing newline ends the last line, it never starts an
+    empty one — encode_blob's framing)."""
+    if not payload:
+        return []
+    arr = np.frombuffer(payload, dtype=np.uint8)
+    nl = np.flatnonzero(arr == 0x0A)
+    # Line starts: 0 plus every newline+1 that still begins a line.
+    starts = np.concatenate(([0], nl + 1))
+    if payload.endswith(b"\n"):
+        starts = starts[:-1]
+    n = len(starts)
+    out: List[Tuple[int, int]] = []
+    for b0 in range(0, n, max(1, batch_lines)):
+        b1 = b0 + max(1, batch_lines)
+        end = int(starts[b1]) if b1 < n else len(payload)
+        out.append((int(starts[b0]), end))
+    return out
+
+
+def run_worker(
+    worker_id: int,
+    sources: Sequence[_Source],
+    shards: Sequence[Shard],
+    out_q,
+    batch_lines: int,
+    line_len: int,
+    stop_event,
+    delay_s: float = 0.0,
+) -> None:
+    """Read + frame this worker's shards, in shard order, into ``out_q``.
+
+    ``stop_event`` aborts blocked puts so an abandoned pool never leaks
+    a worker wedged on a full queue.  ``delay_s`` sleeps after each
+    batch — a shaping/test hook (slow-source simulation)."""
+    from ..native import encode_blob
+
+    def put(item) -> bool:
+        while True:
+            if stop_event.is_set():
+                return False
+            try:
+                out_q.put(item, timeout=0.1)
+                return True
+            except Full:  # same class for both queue flavors
+                continue
+
+    try:
+        for shard in shards:
+            t_shard = time.perf_counter()
+            t0 = time.perf_counter()
+            payload = read_shard_payload(sources[shard.source], shard)
+            read_s = time.perf_counter() - t0
+            ranges = split_batches(payload, batch_lines)
+            shard_lines = 0
+            for bi, (p0, p1) in enumerate(ranges):
+                chunk = payload[p0:p1]
+                t0 = time.perf_counter()
+                buf, lengths, overflow = encode_blob(chunk, line_len=line_len)
+                encode_s = time.perf_counter() - t0
+                n = int(buf.shape[0]) if len(chunk) else 0
+                shard_lines += n
+                eb = EncodedBatch(
+                    shard=shard.index,
+                    index=bi,
+                    payload=chunk,
+                    buf=buf,
+                    lengths=lengths,
+                    overflow=list(overflow),
+                    n_lines=n,
+                    read_s=read_s / max(1, len(ranges)),
+                    encode_s=encode_s,
+                )
+                if not put((MSG_BATCH, eb)):
+                    return
+                if delay_s:
+                    time.sleep(delay_s)
+            if not put((
+                MSG_SHARD_DONE,
+                shard.index,
+                time.perf_counter() - t_shard,
+                shard_lines,
+                len(payload),
+            )):
+                return
+        put((MSG_DONE, worker_id))
+    except Exception:  # noqa: BLE001 — relay to the consumer, never die silent
+        try:
+            put((MSG_ERROR, worker_id, traceback.format_exc()))
+        except Exception:  # noqa: BLE001 — queue already torn down
+            pass
+
+
+# Threads-mode producers can update the shared queue-depth gauge on every
+# put (the consumer only sees depth at get time); process-mode workers
+# live in another registry, so the parent samples qsize() instead.
+def make_instrumented_queue(q, depth_cb: Optional[Callable[[], None]]):
+    if depth_cb is None:
+        return q
+
+    class _Wrapped:
+        def put(self, item, timeout=None):
+            q.put(item, timeout=timeout)
+            depth_cb()
+
+        def get(self, timeout=None):
+            return q.get(timeout=timeout)
+
+        def qsize(self) -> int:
+            return q.qsize()
+
+    return _Wrapped()

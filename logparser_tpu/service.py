@@ -16,6 +16,11 @@ Wire protocol (deliberately trivial to implement from any language):
                        "timestamp_format": str|null,
                        "assembly_workers": int|null (optional; host-side
                        Arrow assembly parallelism, default auto),
+                       "feeder_workers": int|null (optional; >= 2 = frame
+                       large LINES payloads through the sharded feeder
+                       fabric — N threads frame disjoint byte-range shards
+                       in parallel; the ARROW frame is unchanged in shape
+                       and content, docs/FEEDER.md),
                        "stats": bool (optional; true = one STATS JSON frame
                        after each ARROW frame — v1 sessions that omit the
                        key get byte-identical v1 behavior)}
@@ -66,6 +71,10 @@ LOG = logging.getLogger(__name__)
 
 _ERROR_MARKER = 0xFFFFFFFF
 _MAX_FRAME = 1 << 30  # 1 GiB sanity cap
+# Sharded-feeder engagement floor: below this many lines a LINES frame is
+# parsed inline — splitting pays for itself only when the framing work
+# dwarfs the per-shard setup (docs/FEEDER.md "worker sizing").
+_FEEDER_MIN_LINES = 4096
 
 
 # ---------------------------------------------------------------------------
@@ -191,6 +200,7 @@ class _SessionHandler(socketserver.BaseRequestHandler):
         if config_frame is None:
             return
         send_stats = False
+        feeder_workers = 0
         try:
             config = json.loads(config_frame)
             # Optional telemetry opt-in (PROTOCOL.md "stats" CONFIG key):
@@ -198,6 +208,12 @@ class _SessionHandler(socketserver.BaseRequestHandler):
             # parser cache key — it changes framing, not parsing.
             send_stats = bool(config.get("stats")) if isinstance(
                 config, dict) else False
+            # Optional sharded-feeder framing (docs/FEEDER.md): >= 2 =
+            # big LINES payloads are framed by that many feeder threads
+            # over byte-range shards.  Session behavior, not parser
+            # state — not part of the cache key either.
+            if isinstance(config, dict) and config.get("feeder_workers"):
+                feeder_workers = int(config["feeder_workers"])
             parser = self.server.parser_cache.get(config)  # type: ignore[attr-defined]
             metrics().increment("service_sessions_total")
         except Exception as e:  # noqa: BLE001 — relay config errors to client
@@ -238,26 +254,42 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                         f"LINES frame declared {count} lines, payload has "
                         f"{n_lines}"
                     )
-                if count and blob and not blob.endswith(b"\n") \
-                        and b"\r" not in blob:
-                    # (an empty blob is one empty LINE per the protocol,
-                    # which blob framing would drop — split path below)
-                    # Common case: the payload IS the framer's input shape
-                    # (no trailing newline, no carriage returns), so the
-                    # blob ingest path applies — no Python line list.
-                    # emit_views=False: the wire ships copy-mode Arrow,
-                    # so device view rows would be wasted kernel + D2H.
-                    result = parser.parse_blob(blob, emit_views=False)
-                else:
-                    result = parser.parse_batch(
-                        blob.split(b"\n") if count else [],
-                        emit_views=False,
+                blob_shape = count and blob and not blob.endswith(b"\n") \
+                    and b"\r" not in blob
+                if blob_shape and feeder_workers >= 2 \
+                        and count >= _FEEDER_MIN_LINES:
+                    # Sharded-feeder framing: the blob splits into
+                    # byte-range shards framed by N threads in parallel;
+                    # result tables concatenate back in corpus order
+                    # (byte-identical to the inline blob path).
+                    table, oracle_rows, bad_lines = _feeder_parse(
+                        parser, blob, count, feeder_workers
                     )
-                # Copy mode for the wire: IPC does not dedupe shared
-                # buffers, so string_view columns would each ship a full
-                # copy of the batch buffer.
-                table = result.to_arrow(include_validity=True,
-                                        strings="copy")
+                    metrics().increment("service_feeder_requests_total")
+                else:
+                    if blob_shape:
+                        # (an empty blob is one empty LINE per the
+                        # protocol, which blob framing would drop —
+                        # split path below)
+                        # Common case: the payload IS the framer's input
+                        # shape (no trailing newline, no carriage
+                        # returns), so the blob ingest path applies — no
+                        # Python line list.  emit_views=False: the wire
+                        # ships copy-mode Arrow, so device view rows
+                        # would be wasted kernel + D2H.
+                        result = parser.parse_blob(blob, emit_views=False)
+                    else:
+                        result = parser.parse_batch(
+                            blob.split(b"\n") if count else [],
+                            emit_views=False,
+                        )
+                    # Copy mode for the wire: IPC does not dedupe shared
+                    # buffers, so string_view columns would each ship a
+                    # full copy of the batch buffer.
+                    table = result.to_arrow(include_validity=True,
+                                            strings="copy")
+                    oracle_rows = result.oracle_rows
+                    bad_lines = result.bad_lines
                 from .tpu.arrow_bridge import table_to_ipc_bytes
 
                 payload = table_to_ipc_bytes(table)
@@ -277,8 +309,8 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                             "lines": count,
                             "seconds": round(dt, 6),
                             "arrow_bytes": len(payload),
-                            "oracle_lines": result.oracle_rows,
-                            "bad_lines": result.bad_lines,
+                            "oracle_lines": oracle_rows,
+                            "bad_lines": bad_lines,
                         },
                         "stages": reg.stage_breakdown(),
                         # as_dict(): counters only — snapshot() would build
@@ -297,6 +329,40 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                     write_error(sock, f"parse failed: {e}")
                 except OSError:
                     return
+
+
+def _feeder_parse(parser, blob: bytes, count: int, workers: int):
+    """Parse one LINES blob through the sharded feeder fabric
+    (docs/FEEDER.md): the payload splits into ``workers`` byte-range
+    shards framed by feeder THREADS (a serving process must not fork),
+    the parser consumes the encoded stream via ``parse_batch_stream``,
+    and the per-batch tables concatenate back — in corpus order — into
+    the single combined record batch the protocol promises.  Returns
+    ``(table, oracle_rows, bad_lines)``."""
+    import pyarrow as pa
+
+    from .feeder import FeederPool, default_feeder_workers
+
+    # The key is client-supplied: clamp to the host's own worker ceiling
+    # so one CONFIG frame cannot spawn an arbitrary thread count.
+    workers = max(2, min(workers, default_feeder_workers()))
+    tables = []
+    oracle_rows = 0
+    bad_lines = 0
+    with FeederPool(
+        [blob],
+        workers=workers,
+        shard_bytes=max(1, -(-len(blob) // workers)),
+        batch_lines=max(1024, -(-count // workers)),
+        use_processes=False,
+    ) as pool:
+        for result in pool.feed(parser, emit_views=False):
+            tables.append(
+                result.to_arrow(include_validity=True, strings="copy")
+            )
+            oracle_rows += result.oracle_rows
+            bad_lines += result.bad_lines
+    return pa.concat_tables(tables).combine_chunks(), oracle_rows, bad_lines
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
@@ -500,6 +566,7 @@ class ParseServiceClient:
         fields: Sequence[str],
         timestamp_format: Optional[str] = None,
         stats: bool = False,
+        feeder_workers: Optional[int] = None,
     ):
         self._sock = socket.create_connection((host, port))
         self._stats = bool(stats)
@@ -510,6 +577,10 @@ class ParseServiceClient:
             "fields": list(fields),
             "timestamp_format": timestamp_format,
         }
+        if feeder_workers:
+            # Optional sharded-feeder framing for big batches
+            # (docs/FEEDER.md); a v1 server ignores unknown keys.
+            config["feeder_workers"] = int(feeder_workers)
         if stats:
             # Only stats sessions carry the key: a v1 server ignores it,
             # but omitting it keeps this client byte-exact v1 by default.

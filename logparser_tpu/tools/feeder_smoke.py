@@ -1,0 +1,115 @@
+"""CI feeder smoke: sharded multi-worker framing == single-process parse_blob.
+
+Runs the real ingest fabric (2 feeder workers, process mode with the
+thread fallback, across 2 shard sizes) over a small demolog corpus and
+fails (exit 1) unless:
+
+- framing byte-parity holds: the concatenated batch payloads equal the
+  corpus, and the concatenated encoded buffers equal one-shot
+  ``encode_blob`` over the whole corpus;
+- parse parity holds: ``FeederPool.feed(parser)`` tables concatenate to
+  exactly ``parser.parse_blob``'s table (values, validity, counters);
+- the ``feeder_*`` metric families land in the registry and the
+  rendered Prometheus exposition stays structurally valid
+  (:func:`logparser_tpu.tools.metrics_smoke.validate_exposition`).
+
+Usage::
+
+    make feeder-smoke
+    python -m logparser_tpu.tools.feeder_smoke
+"""
+from __future__ import annotations
+
+import sys
+
+N_LINES = 4096
+BATCH_LINES = 1024
+WORKERS = 2
+LINE_LEN = 256
+FIELDS = [
+    "IP:connection.client.host",
+    "STRING:request.status.last",
+    "BYTES:response.body.bytes",
+]
+
+
+def main() -> int:
+    import numpy as np
+    import pyarrow as pa
+
+    from logparser_tpu.feeder import FeederPool
+    from logparser_tpu.native import encode_blob
+    from logparser_tpu.observability import metrics
+    from logparser_tpu.tools.demolog import generate_combined_lines
+    from logparser_tpu.tools.metrics_smoke import validate_exposition
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    lines = generate_combined_lines(N_LINES, seed=11, garbage_fraction=0.01)
+    blob = "\n".join(lines).encode()
+    ref_buf, ref_lengths, _ = encode_blob(blob, line_len=LINE_LEN)
+
+    parser = TpuBatchParser("combined", FIELDS)
+    ref = parser.parse_blob(blob)
+    ref_table = ref.to_arrow(include_validity=True, strings="copy")
+
+    failures = []
+    shard_sizes = (max(1, -(-len(blob) // WORKERS)), 64 << 10)
+    for shard_bytes in shard_sizes:
+        # Pass 1: framing byte-parity on the raw batch stream.
+        pool = FeederPool(
+            [blob], workers=WORKERS, shard_bytes=shard_bytes,
+            batch_lines=BATCH_LINES, line_len=LINE_LEN,
+        )
+        ebs = list(pool.batches())
+        mode = pool.stats()["mode"]
+        if b"".join(e.payload for e in ebs) != blob:
+            failures.append(f"shard_bytes={shard_bytes}: payload bytes "
+                            "diverge from the corpus")
+        buf = np.concatenate([e.buf for e in ebs])
+        lengths = np.concatenate([e.lengths for e in ebs])
+        if not (np.array_equal(buf, ref_buf)
+                and np.array_equal(lengths, ref_lengths)):
+            failures.append(f"shard_bytes={shard_bytes}: encoded buffers "
+                            "diverge from one-shot encode_blob")
+
+        # Pass 2: parse parity through the device consumer.
+        pool = FeederPool(
+            [blob], workers=WORKERS, shard_bytes=shard_bytes,
+            batch_lines=BATCH_LINES, line_len=LINE_LEN,
+        )
+        tables = [
+            r.to_arrow(include_validity=True, strings="copy")
+            for r in pool.feed(parser)
+        ]
+        table = pa.concat_tables(tables).combine_chunks()
+        if not table.equals(ref_table.combine_chunks()):
+            failures.append(f"shard_bytes={shard_bytes}: feeder-fed Arrow "
+                            "table diverges from parse_blob's")
+        print(f"feeder-smoke: shard_bytes={shard_bytes} mode={mode} "
+              f"batches={len(ebs)} rows={table.num_rows} OK")
+
+    reg = metrics()
+    for family in ("feeder_bytes_read_total", "feeder_lines_total",
+                   "feeder_batches_total", "feeder_shards_total"):
+        if reg.get(family) <= 0:
+            failures.append(f"metric family missing/zero: {family}")
+    text = reg.prometheus_text()
+    for needle in ('logparser_tpu_stage_seconds_bucket{stage="feeder_encode"',
+                   'logparser_tpu_stage_seconds_bucket{stage="feeder_read"',
+                   "logparser_tpu_feeder_bytes_read_total"):
+        if needle not in text:
+            failures.append(f"/metrics exposition missing: {needle}")
+    failures.extend(validate_exposition(text))
+
+    if failures:
+        print("FEEDER SMOKE FAILURES:")
+        for f in failures:
+            print(" -", f)
+        return 1
+    print(f"feeder-smoke OK: {N_LINES} lines x {WORKERS} workers x "
+          f"{len(shard_sizes)} shard sizes, byte- and parse-parity held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

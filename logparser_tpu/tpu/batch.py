@@ -1388,6 +1388,43 @@ class TpuBatchParser:
         enc = (lines, buf, lengths, overflow, B, padded_b)
         return self._finish_batch(self._dispatch_batch(enc, emit_views))
 
+    def parse_encoded(
+        self, batch, emit_views: Optional[bool] = None,
+    ) -> BatchResult:
+        """One feeder-framed batch (:class:`logparser_tpu.feeder.worker.
+        EncodedBatch`) -> BatchResult, without re-scanning the payload:
+        the feeder worker already ran the ``parse_blob`` framing
+        (``encode_blob``) in its own process, so this path only pads the
+        batch dimension to its bucket and dispatches.  Framing semantics
+        and results are byte-identical to :meth:`parse_blob` over the
+        same bytes — the feeder parity suite pins it."""
+        return self._finish_batch(
+            self._dispatch_batch(self._adopt_encoded(batch), emit_views)
+        )
+
+    def _adopt_encoded(self, batch):
+        """EncodedBatch -> the in-flight enc tuple ``_dispatch_batch``
+        consumes.  Lines stay lazy (``_BlobLines`` over the shipped
+        payload — only oracle-rescued rows ever materialize).  A
+        framer/count disagreement falls back to the authoritative
+        per-line path, mirroring :meth:`parse_blob`."""
+        from ..observability import pipeline_stage, record_batch_shape
+
+        lines = _BlobLines(bytes(batch.payload))
+        B = len(lines)
+        buf, lengths = batch.buf, batch.lengths
+        if B != batch.n_lines or buf.shape[0] != B:
+            return self._encode_batch(list(lines))
+        with pipeline_stage("encode", items=0):
+            # Adoption cost only (row padding): the real encode ran in
+            # the feeder worker and is accounted under feeder_encode.
+            padded_b = _bucket_batch(B)
+            if padded_b != B:
+                buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
+                lengths = np.pad(lengths, (0, padded_b - B))
+        record_batch_shape(B, padded_b, buf.shape[1], int(lengths.sum()))
+        return (lines, buf, lengths, list(batch.overflow), B, padded_b)
+
     def parse_batch_stream(
         self,
         batches,
@@ -1413,13 +1450,24 @@ class TpuBatchParser:
         Adaptive-CSR interplay: growing the slot count rebuilds the
         executor, which invalidates in-flight dispatches — each pending
         batch snapshots the slot count at dispatch and transparently
-        re-dispatches on mismatch (bounded, slots only ever double)."""
+        re-dispatches on mismatch (bounded, slots only ever double).
+
+        Items may also be feeder-framed batches
+        (:class:`logparser_tpu.feeder.worker.EncodedBatch`, e.g. from
+        ``FeederPool.batches()``): those skip the host encode entirely —
+        the framing already happened in the feeder worker."""
         from collections import deque
+
+        from ..feeder.worker import EncodedBatch
 
         depth = max(1, depth)
         pending = deque()
         for lines in batches:
-            enc = self._encode_batch(lines)
+            enc = (
+                self._adopt_encoded(lines)
+                if isinstance(lines, EncodedBatch)
+                else self._encode_batch(lines)
+            )
             if len(pending) >= depth:
                 # Drain the oldest D2H BEFORE enqueueing the next H2D
                 # (link order), then materialize it while the new batch

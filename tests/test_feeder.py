@@ -1,0 +1,358 @@
+"""Sharded feeder subsystem (round 8): planner contract, shard-boundary
+framing edge cases, golden byte-/parse-parity with single-process
+``parse_blob``, worker modes, and the service ``feeder_workers`` key.
+
+The planner's contract is the reference InputFormat's split semantics:
+a line belongs to the shard where its FIRST byte lies, healed payloads
+of consecutive shards tile the corpus exactly, and per-shard framing is
+byte-identical to one-shot framing of the whole corpus.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from _shared_parsers import shared_parser
+from logparser_tpu.feeder import (
+    EncodedBatch,
+    FeederError,
+    FeederPool,
+    healed_payload,
+    line_start_at_or_after,
+    normalize_sources,
+    plan_shards,
+    split_batches,
+)
+from logparser_tpu.native import encode_blob
+
+FIELDS = ["IP:connection.client.host", "STRING:request.status.last",
+          "BYTES:response.body.bytes"]
+
+
+def _demolog(n, seed=5):
+    from logparser_tpu.tools.demolog import generate_combined_lines
+
+    return generate_combined_lines(n, seed=seed, garbage_fraction=0.02)
+
+
+# ---------------------------------------------------------------------------
+# shard planner contract
+# ---------------------------------------------------------------------------
+
+
+EDGE_BLOBS = {
+    "plain": b"alpha\nbeta\ngamma\ndelta",
+    "trailing_newline": b"alpha\nbeta\ngamma\n",
+    "crlf": b"aaaa\r\nbbbb\r\ncccc\r\n",
+    "empty_lines": b"\n\na\n\nb\n\n",
+    "long_line": b"start\n" + b"X" * 300 + b"\nend",
+    "single_no_newline": b"just-one-line-no-terminator",
+}
+
+
+@pytest.mark.parametrize("name", sorted(EDGE_BLOBS))
+def test_healed_shards_tile_the_blob_exactly(name):
+    """Every byte owned exactly once, for EVERY boundary position: the
+    sweep drags the shard boundary through every offset, so it crosses
+    lines mid-byte, lands exactly on '\\n', between '\\r' and '\\n', and
+    leaves whole shards inside one long line (empty payloads)."""
+    blob = EDGE_BLOBS[name]
+    for shard_bytes in range(1, len(blob) + 2):
+        srcs = normalize_sources([blob])
+        shards = plan_shards(srcs, shard_bytes)
+        payloads = [healed_payload(blob, s.start, s.end) for s in shards]
+        assert b"".join(payloads) == blob, shard_bytes
+        # Ownership: each payload is whole lines (it never starts
+        # mid-line: its first byte is 0 or preceded by '\n').
+        off = 0
+        for p in payloads:
+            if p:
+                assert off == 0 or blob[off - 1 : off] == b"\n"
+            off += len(p)
+
+
+def test_line_start_at_or_after_semantics():
+    blob = b"ab\ncd\nef"
+    assert line_start_at_or_after(blob, 0) == 0
+    assert line_start_at_or_after(blob, 1) == 3   # mid-line -> next line
+    assert line_start_at_or_after(blob, 2) == 3   # ON the newline
+    assert line_start_at_or_after(blob, 3) == 3   # already a line start
+    assert line_start_at_or_after(blob, 7) == 8   # inside last line -> end
+    assert line_start_at_or_after(blob, 8) == 8
+    # A shard fully inside one long line owns nothing.
+    long = b"Y" * 50
+    assert healed_payload(long, 10, 20) == b""
+    # ... and the line's owner reads it whole, past its own end.
+    assert healed_payload(long, 0, 5) == long
+
+
+def test_empty_shard_and_exact_newline_boundary():
+    blob = b"aaaa\nbbbb\ncccc"
+    # Boundary exactly ON a newline (index 4): the '\n' byte belongs to
+    # the first shard's line; the next shard starts at 'bbbb'.
+    assert healed_payload(blob, 0, 4) == b"aaaa\n"
+    assert healed_payload(blob, 4, 14) == b"bbbb\ncccc"
+    # Boundary exactly AFTER a newline (index 5 = a line start): the
+    # line starting at the boundary belongs to the later shard.
+    assert healed_payload(blob, 0, 5) == b"aaaa\n"
+    assert healed_payload(blob, 5, 14) == b"bbbb\ncccc"
+
+
+def test_file_and_blob_healing_agree(tmp_path):
+    blob = EDGE_BLOBS["crlf"] + EDGE_BLOBS["long_line"] + b"\ntail"
+    path = tmp_path / "corpus.log"
+    path.write_bytes(blob)
+    for shard_bytes in (1, 3, 7, 64, 1024):
+        fsrcs = normalize_sources([str(path)])
+        bsrcs = normalize_sources([blob])
+        from logparser_tpu.feeder.shards import read_shard_payload
+
+        fshards = plan_shards(fsrcs, shard_bytes)
+        bshards = plan_shards(bsrcs, shard_bytes)
+        assert [(s.start, s.end) for s in fshards] == [
+            (s.start, s.end) for s in bshards
+        ]
+        for fs, bs in zip(fshards, bshards):
+            assert read_shard_payload(fsrcs[0], fs) == read_shard_payload(
+                bsrcs[0], bs
+            )
+
+
+def test_split_batches_line_aligned():
+    payload = b"a\nbb\nccc\ndddd\neeeee"
+    ranges = split_batches(payload, 2)
+    chunks = [payload[a:b] for a, b in ranges]
+    assert chunks == [b"a\nbb\n", b"ccc\ndddd\n", b"eeeee"]
+    assert split_batches(b"", 4) == []
+    # Trailing newline ends the last line, it never starts an empty one.
+    tail = b"x\ny\n"
+    assert [tail[a:b] for a, b in split_batches(tail, 10)] == [tail]
+
+
+# ---------------------------------------------------------------------------
+# shard-boundary framing edge cases (the parse_blob framing contract)
+# ---------------------------------------------------------------------------
+
+
+def _assert_framing_parity(blob, shard_bytes, batch_lines=3, line_len=64):
+    """Sharded multi-worker framing must be byte-identical to one-shot
+    encode_blob (parse_blob's framer) over the same corpus."""
+    ref_buf, ref_lengths, ref_overflow = encode_blob(blob, line_len=line_len)
+    pool = FeederPool([blob], workers=2, shard_bytes=shard_bytes,
+                      batch_lines=batch_lines, line_len=line_len,
+                      use_processes=False)
+    ebs = list(pool.batches())
+    assert [e.order_key for e in ebs] == sorted(e.order_key for e in ebs)
+    assert b"".join(e.payload for e in ebs) == blob
+    if not blob:
+        assert ebs == []
+        return
+    buf = np.concatenate([e.buf for e in ebs])
+    lengths = np.concatenate([e.lengths for e in ebs])
+    np.testing.assert_array_equal(buf, ref_buf)
+    np.testing.assert_array_equal(lengths, ref_lengths)
+    # Batch-local overflow indices re-based to corpus rows.
+    got_overflow = []
+    row = 0
+    for e in ebs:
+        got_overflow.extend(row + i for i in e.overflow)
+        row += e.n_lines
+    assert got_overflow == list(ref_overflow)
+
+
+def test_framing_empty_corpus():
+    _assert_framing_parity(b"", shard_bytes=8)
+
+
+def test_framing_shard_ends_exactly_on_newline():
+    blob = b"aaaa\nbbbb\ncccc\ndddd"
+    # 5 drags every shard edge onto a '\n'+1 boundary; 4 onto the '\n'.
+    _assert_framing_parity(blob, shard_bytes=5)
+    _assert_framing_parity(blob, shard_bytes=4)
+
+
+def test_framing_line_longer_than_a_shard():
+    blob = b"short\n" + b"L" * 200 + b"\nshort2\n" + b"M" * 90
+    for shard_bytes in (16, 32, 64):
+        # line_len=64 also forces overflow rows (200 > 64): truncation +
+        # overflow-index parity across the sharded path.
+        _assert_framing_parity(blob, shard_bytes=shard_bytes)
+
+
+def test_framing_crlf_at_the_boundary():
+    blob = b"aaa\r\nbbb\r\nccc\r\nddd\r"
+    for shard_bytes in range(1, len(blob) + 1):
+        _assert_framing_parity(blob, shard_bytes=shard_bytes)
+
+
+def test_framing_empty_lines_and_trailing_newline():
+    _assert_framing_parity(b"\n\nx\n\n", shard_bytes=2)
+    _assert_framing_parity(b"x\ny\n", shard_bytes=3)
+
+
+# ---------------------------------------------------------------------------
+# FeederPool: golden parity with single-process parse_blob
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("workers", [1, 3])
+@pytest.mark.parametrize("shard_bytes", [30_000, 1 << 20])
+def test_feed_parity_with_parse_blob(workers, shard_bytes):
+    """Acceptance bar: feeder output byte-identical to single-process
+    parse_blob over the same corpus, >= 2 worker counts x >= 2 shard
+    sizes — spans, typed columns, validity and counters."""
+    import pyarrow as pa
+
+    parser = shared_parser("combined", FIELDS)
+    blob = "\n".join(_demolog(512)).encode()
+    ref = parser.parse_blob(blob)
+    ref_table = ref.to_arrow(include_validity=True, strings="copy")
+
+    pool = FeederPool([blob], workers=workers, shard_bytes=shard_bytes,
+                      batch_lines=512, use_processes=False)
+    tables = []
+    oracle_rows = bad_lines = lines_read = 0
+    for result in pool.feed(parser):
+        tables.append(result.to_arrow(include_validity=True, strings="copy"))
+        oracle_rows += result.oracle_rows
+        bad_lines += result.bad_lines
+        lines_read += result.lines_read
+    table = pa.concat_tables(tables).combine_chunks()
+    assert table.equals(ref_table.combine_chunks())
+    assert (lines_read, oracle_rows, bad_lines) == (
+        ref.lines_read, ref.oracle_rows, ref.bad_lines
+    )
+
+
+def test_parse_encoded_single_batch_equals_parse_blob():
+    parser = shared_parser("combined", FIELDS)
+    blob = "\n".join(_demolog(64, seed=8)).encode()
+    pool = FeederPool([blob], workers=1, shard_bytes=1 << 20,
+                      batch_lines=1024, use_processes=False)
+    (eb,) = list(pool.batches())
+    assert isinstance(eb, EncodedBatch)
+    got = parser.parse_encoded(eb)
+    ref = parser.parse_blob(blob)
+    assert got.to_arrow(strings="copy").equals(ref.to_arrow(strings="copy"))
+    assert (got.good_lines, got.bad_lines) == (ref.good_lines, ref.bad_lines)
+
+
+@pytest.mark.slow
+def test_process_mode_parity(tmp_path):
+    """The default (multi-process) worker flavor over a file source:
+    same byte parity; slow tier — process start costs seconds."""
+    blob = b"\n".join(b"line %d payload" % i for i in range(2000))
+    path = tmp_path / "corpus.log"
+    path.write_bytes(blob)
+    ref_buf, ref_lengths, _ = encode_blob(blob, line_len=64)
+    pool = FeederPool([str(path)], workers=2, shard_bytes=7_001,
+                      batch_lines=256, line_len=64, use_processes=True)
+    ebs = list(pool.batches())
+    assert pool.stats()["mode"] == "process"
+    assert b"".join(e.payload for e in ebs) == blob
+    np.testing.assert_array_equal(
+        np.concatenate([e.buf for e in ebs]), ref_buf
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([e.lengths for e in ebs]), ref_lengths
+    )
+
+
+def test_multiple_sources_concatenate_in_order(tmp_path):
+    a = b"a1\na2\na3"
+    b = b"b1\nb2"
+    path = tmp_path / "b.log"
+    path.write_bytes(b)
+    pool = FeederPool([a, str(path)], workers=2, shard_bytes=4,
+                      batch_lines=2, line_len=64, use_processes=False)
+    ebs = list(pool.batches())
+    assert b"".join(e.payload for e in ebs) == a + b
+    assert pool.stats()["lines"] == 5
+
+
+def test_empty_source_yields_no_batches():
+    pool = FeederPool([b""], workers=2, use_processes=False)
+    assert list(pool.batches()) == []
+    assert pool.stats()["batches"] == 0
+
+
+def test_worker_failure_surfaces_as_feeder_error(tmp_path):
+    path = tmp_path / "gone.log"
+    path.write_bytes(b"x\n" * 100)
+    pool = FeederPool([str(path)], workers=1, shard_bytes=50,
+                      use_processes=False)
+    os.unlink(path)  # worker's open() will fail
+    with pytest.raises(FeederError, match="worker 0 failed"):
+        list(pool.batches())
+
+
+def test_batches_is_single_use():
+    pool = FeederPool([b"x\ny"], workers=1, use_processes=False)
+    list(pool.batches())
+    with pytest.raises(RuntimeError, match="only run once"):
+        list(pool.batches())
+
+
+def test_parse_batch_stream_accepts_mixed_items():
+    """EncodedBatch items and plain line lists interleave in one
+    stream — adapters can mix feeder output with ad-hoc batches."""
+    parser = shared_parser("combined", FIELDS)
+    blob = "\n".join(_demolog(64, seed=8)).encode()
+    pool = FeederPool([blob], workers=1, shard_bytes=1 << 20,
+                      batch_lines=1024, use_processes=False)
+    (eb,) = list(pool.batches())
+    lines = _demolog(64, seed=8)
+    results = list(parser.parse_batch_stream([eb, lines]))
+    assert len(results) == 2
+    assert results[0].lines_read == results[1].lines_read == 64
+    assert results[0].good_lines == results[1].good_lines
+
+
+# ---------------------------------------------------------------------------
+# service: the optional feeder_workers CONFIG key
+# ---------------------------------------------------------------------------
+
+
+def test_service_feeder_workers_session_parity(monkeypatch):
+    """A feeder_workers session returns the SAME single-record-batch
+    ARROW frame as a plain session over the same lines."""
+    from logparser_tpu import service as service_mod
+    from logparser_tpu.service import ParseService, ParseServiceClient
+
+    monkeypatch.setattr(service_mod, "_FEEDER_MIN_LINES", 64)
+    lines = _demolog(200, seed=13)
+    from logparser_tpu.observability import metrics
+
+    before = metrics().get("service_feeder_requests_total")
+    with ParseService() as svc:
+        with ParseServiceClient(
+            "127.0.0.1", svc.port, "combined", FIELDS
+        ) as client:
+            ref = client.parse(lines)
+        with ParseServiceClient(
+            "127.0.0.1", svc.port, "combined", FIELDS,
+            feeder_workers=2, stats=True,
+        ) as client:
+            got = client.parse(lines)
+            stats = client.last_stats
+    assert got.equals(ref)
+    # Protocol shape unchanged: one combined record batch.
+    assert len(got.column(0).chunks) == 1
+    assert metrics().get("service_feeder_requests_total") == before + 1
+    assert stats["request"]["lines"] == 200
+
+
+def test_service_small_batches_skip_the_feeder():
+    """Below the engagement floor the inline path runs (no feeder
+    counters move) even when the session asks for feeder_workers."""
+    from logparser_tpu.observability import metrics
+    from logparser_tpu.service import ParseService, ParseServiceClient
+
+    before = metrics().get("service_feeder_requests_total")
+    with ParseService() as svc:
+        with ParseServiceClient(
+            "127.0.0.1", svc.port, "combined", FIELDS, feeder_workers=2,
+        ) as client:
+            table = client.parse(_demolog(16, seed=13))
+    assert table.num_rows == 16
+    assert metrics().get("service_feeder_requests_total") == before
