@@ -1,7 +1,7 @@
 # Dev entry points (the reference's Maven/devtools tier, L0).
 PY ?= python
 
-.PHONY: test test-fast metrics-smoke feeder-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke rescue-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -31,6 +31,14 @@ metrics-smoke:
 # (docs/FEEDER.md).  CI runs this after metrics-smoke.
 feeder-smoke:
 	$(PY) -m logparser_tpu.tools.feeder_smoke
+
+# Rescue smoke: dirty corpus with forced ~5% device rejects — the former
+# overflow class must stay on device (full-int64 decoder), the forced
+# rejects must rescue bit-identically through the batched pipeline above
+# a throughput floor, and /metrics must expose the per-reason
+# oracle_routed_lines_total counters.  CI runs this after feeder-smoke.
+rescue-smoke:
+	$(PY) -m logparser_tpu.tools.rescue_smoke
 
 lint:
 	$(PY) -m ruff check logparser_tpu tests

@@ -60,6 +60,12 @@ ARROW_SPREAD_GATE_PCT = 15.0
 # (the acceptance bar that replaced BASELINE.md's 83 GB/s prose).
 FEEDER_REGRESSION_FRACTION = 0.85
 FEEDER_STARVATION_GATE = 0.05
+# Rescue gate (round 9): the combined_rescue config's MEASURED effective
+# rate (real mixed stream with ~5% former-overflow lines; the rescue term
+# is the traced oracle_fallback wall) must stay above this floor — the
+# rescue cliff (ROADMAP item 2: 35.9M device -> ~0.9M effective at 5%
+# routed) must never reopen.
+RESCUE_EFFECTIVE_FLOOR = 5e6
 FEEDER_CORPUS_REPEATS = 2
 FEEDER_SHARD_BYTES = 4 << 20
 
@@ -604,14 +610,71 @@ def roofline_fields(scanned_bytes: int, kernel_ms: float) -> dict:
     }
 
 
-def bench_rescue_config():
-    """Round-4 verdict weak #6: a corpus with ~5% plausible-but-device-
-    rejected lines (>18-digit %b counters — the device limb decoder is
-    18-digit, the reference's Long path is the oracle's job), so the
-    effective-rate model's oracle term is validated against WALL-CLOCK:
-    the tracer's oracle_fallback stage measures the real rescue seconds,
-    compared with the modeled frac/oracle_rate."""
+def force_reject_lines(base, pct):
+    """Copy of ``base`` with ``pct``% of lines rewritten into a
+    plausible-but-device-rejected class the host RESCUES: a
+    backslash-escaped quote inside the user-agent (the host regex accepts
+    it, the optimistic device split does not).  Rewritten lines grow by
+    only a few bytes (no >8k truncation, no tunnel blowup); if the
+    corpus max length crosses an L bucket the one recompile is absorbed
+    by each fraction's warm parse."""
+    step = max(1, round(100 / pct))
+    out = list(base)
+    for i in range(0, len(out), step):
+        out[i] = _re.sub(
+            r'"([^"]*)"$', r'"esc \\" quote \1"', out[i], count=1
+        )
+    return out
+
+
+def measure_rescue(parser, lines, runs=3):
+    """Best-of-N measured rescue term on the REAL mixed stream: parse the
+    batch under tracing, read the oracle_fallback stage (the wall seconds
+    rescue added to the batch — host-side only, tunnel noise excluded)
+    plus the batch's per-reason rescue composition."""
     from logparser_tpu.observability import disable_tracing, enable_tracing
+
+    tr = enable_tracing()
+    best_rescue_s = float("inf")
+    reasons = {}
+    wall_share = None
+    try:
+        for _ in range(runs):
+            tr.reset()
+            t0 = time.perf_counter()
+            result = parser.parse_batch(lines)
+            batch_wall = time.perf_counter() - t0
+            stats = tr.stages.get("oracle_fallback")
+            rescue_s = stats.total_s if stats is not None else 0.0
+            if rescue_s < best_rescue_s:
+                best_rescue_s = rescue_s
+                reasons = dict(result.rescue_reasons)
+                wall_share = (
+                    result.rescue_wall_s / batch_wall if batch_wall else 0.0
+                )
+    finally:
+        disable_tracing()
+    if best_rescue_s == float("inf"):
+        best_rescue_s = 0.0
+    return best_rescue_s / len(lines), reasons, wall_share
+
+
+def bench_rescue_config():
+    """The rescue-cliff config (round-4 verdict weak #6, closed round 9).
+
+    Two loads, both measured under the clock (tracer oracle_fallback
+    stage — wall seconds the rescue ADDS to a real parse_batch):
+
+    - the classic ~5% >19-digit %b corpus: after the full-int64 decoder
+      widening these lines STAY ON DEVICE (the former largest
+      self-imposed reject class), so its oracle_fraction is the
+      regression guard for the widening and the measured effective rate
+      is gated >= 5M lines/s (RESCUE_EFFECTIVE_FLOOR);
+    - a forced-reject sweep (1%/5%/10% device-rejected, host-rescued
+      lines at unchanged line length) exercising the batched rescue
+      pipeline itself — per-fraction measured rescue terms recorded in
+      bench_last.json, effective rates filled in by finish_config.
+    """
     from logparser_tpu.tools.demolog import generate_combined_lines
     from logparser_tpu.tpu.batch import TpuBatchParser
     from logparser_tpu.tpu.runtime import encode_batch
@@ -626,31 +689,35 @@ def bench_rescue_config():
     ]
     result = parser.parse_batch(lines)  # warm (compile + caches)
     frac = result.oracle_rows / len(lines)
+    overflow_lines = sum(1 for i in range(len(lines)) if i % 20 == 0)
     oracle_lps, oracle_med, oracle_spread = oracle_rate(
         parser, lines, sample=min(1000, len(lines))
     )
 
-    # Measured rescue wall-clock: the oracle_fallback stage inside
-    # parse_batch (host-side only — tunnel transfer noise excluded).
-    tr = enable_tracing()
-    best_rescue_s = float("inf")
-    try:
-        for _ in range(3):
-            tr.reset()
-            parser.parse_batch(lines)
-            stats = tr.stages.get("oracle_fallback")
-            if stats is not None:
-                best_rescue_s = min(best_rescue_s, stats.total_s)
-    finally:
-        disable_tracing()
-    measured_per_line = (
-        best_rescue_s / len(lines) if best_rescue_s < float("inf") else None
-    )
+    measured_per_line, reasons, wall_share = measure_rescue(parser, lines)
     modeled_per_line = frac / oracle_lps if oracle_lps else None
+
+    # Forced-reject sweep: the batched rescue under 1%/5%/10% routed
+    # fractions (same (B, L) bucket — no recompile, no tunnel blowup).
+    sweep = {}
+    for pct in (1, 5, 10):
+        swept = force_reject_lines(base, pct)
+        swept_result = parser.parse_batch(swept)  # warm caches
+        s_frac = swept_result.oracle_rows / len(swept)
+        s_per_line, s_reasons, s_share = measure_rescue(parser, swept)
+        sweep[str(pct)] = {
+            "oracle_fraction": round(s_frac, 5),
+            "rescue_measured_s_per_line": s_per_line,
+            "rescue_reasons": s_reasons,
+            **({"rescue_wall_share": round(s_share, 4)}
+               if s_share is not None else {}),
+        }
 
     buf, lengths, _ = encode_batch(lines)
     cfg = {
+        # The widening guard: the 20-digit %b class must stay on device.
         "oracle_fraction": round(frac, 5),
+        "overflow_lines_in_corpus": overflow_lines,
         "host_oracle_lines_per_sec": round(oracle_lps, 1),
         "host_oracle_median_lines_per_sec": round(oracle_med, 1),
         "host_oracle_spread_pct": round(oracle_spread, 1),
@@ -658,12 +725,19 @@ def bench_rescue_config():
         "batch": CONFIG_BATCH,
         # Model-vs-measurement of the rescue term (s/line): `modeled` is
         # frac/oracle_rate (what effective_lines_per_sec assumes),
-        # `measured` is the oracle_fallback stage wall-clock per line.
+        # `measured` is the oracle_fallback stage wall-clock per line on
+        # the real mixed stream.
         "rescue_modeled_s_per_line": modeled_per_line,
         "rescue_measured_s_per_line": measured_per_line,
+        "rescue_reasons": reasons,
+        **({"rescue_wall_share": round(wall_share, 4)}
+           if wall_share is not None else {}),
         **({"rescue_model_agreement": round(
             modeled_per_line / measured_per_line, 3)}
            if modeled_per_line and measured_per_line else {}),
+        # Per-fraction forced-reject measurements; effective rates are
+        # filled by finish_config once the device kernel rate is known.
+        "rescue_sweep": sweep,
     }
     return cfg, (parser, lines, buf, lengths, frac, oracle_lps)
 
@@ -750,11 +824,19 @@ def finish_config(cfg, state):
     if cfg.get("rescue_measured_s_per_line") is not None:
         # Round-4 verdict weak #6: effective rate under the MEASURED
         # rescue cost vs the modeled one — the two must agree for the
-        # effective_lines_per_sec model to be trustworthy.
+        # effective_lines_per_sec model to be trustworthy.  Round 9:
+        # this is the GATED number (RESCUE_EFFECTIVE_FLOOR) — measured
+        # on the real mixed stream, not modeled from component rates.
         measured_eff = 1.0 / (
             1.0 / device + cfg["rescue_measured_s_per_line"]
         )
         cfg["measured_effective_lines_per_sec"] = round(measured_eff, 1)
+    for entry in cfg.get("rescue_sweep", {}).values():
+        s = entry.get("rescue_measured_s_per_line")
+        if s is not None:
+            entry["measured_effective_lines_per_sec"] = round(
+                1.0 / (1.0 / device + s), 1
+            )
     return cfg
 
 
@@ -1051,9 +1133,38 @@ def main():
                 f"B/s (below {FEEDER_REGRESSION_FRACTION:.0%} of "
                 f"{prev_feeder_name})"
             )
+    # (f) Rescue gate (round 9): combined_rescue's MEASURED effective rate
+    #     (real mixed stream; rescue term = traced oracle_fallback wall)
+    #     must stay at/above the floor — the rescue cliff must not reopen.
+    rescue_cfg = configs.get("combined_rescue")
+    if isinstance(rescue_cfg, dict) and "error" not in rescue_cfg:
+        rescue_eff = rescue_cfg.get("measured_effective_lines_per_sec")
+        if rescue_eff is None:
+            gate_failures.append(
+                "combined_rescue: measured_effective_lines_per_sec missing"
+            )
+        elif rescue_eff < RESCUE_EFFECTIVE_FLOOR:
+            gate_failures.append(
+                f"combined_rescue: measured effective {rescue_eff:.3g} "
+                f"lines/s below the {RESCUE_EFFECTIVE_FLOOR:.0e} floor"
+            )
 
     headline = round(headline_kern[1], 1) if headline_kern else round(
         device_resident, 1)
+    # Round-9 satellite: the single-core oracle's movement vs the previous
+    # committed round (the store-program codegen delta), recorded durably.
+    cur_combined = configs.get("combined") or {}
+    prev_combined = prev_configs.get("combined") or {}
+    _cur_or = cur_combined.get("host_oracle_lines_per_sec")
+    _prev_or = (prev_combined.get("host_oracle_lines_per_sec")
+                or prev_combined.get("oracle"))
+    oracle_delta = {
+        "previous_round": prev_name,
+        "previous_lines_per_sec": _prev_or,
+        "current_lines_per_sec": _cur_or,
+        **({"delta_pct": round((_cur_or - _prev_or) / _prev_or * 100.0, 1)}
+           if _cur_or and _prev_or else {}),
+    }
     full = {
         "metric": "device kernel loglines/sec/chip (Apache combined)",
         "value": headline,
@@ -1120,6 +1231,7 @@ def main():
         "host_oracle_lines_per_sec": round(oracle_lps, 1),
         "host_oracle_median_lines_per_sec": round(oracle_med, 1),
         "host_oracle_spread_pct": round(oracle_spread, 1),
+        "oracle_delta_vs_previous_round": oracle_delta,
         "device_stage_profile_lines_per_sec": stage_profile,
         # Regression guard: the worst per-config oracle share.  Device
         # coverage work keeps this at 0.0 — any rise means lines fell off
@@ -1186,6 +1298,29 @@ def main():
                 "gbps": feeder_section["feed_gb_per_sec"],
                 "starv_pct": round(
                     feeder_section["starvation_fraction"] * 100.0, 2),
+            }
+        ),
+        # Rescue composition (round 9): the gated measured effective rate,
+        # the per-reason routed counts on the rescue corpus, and the share
+        # of batch wall the rescue consumed — a future regression names
+        # its reject class right here in the compact record.
+        "rescue": (
+            {"error": True}
+            if not isinstance(rescue_cfg, dict) or "error" in rescue_cfg
+            else {
+                "eff": rescue_cfg.get("measured_effective_lines_per_sec"),
+                "frac": rescue_cfg.get("oracle_fraction"),
+                "reasons": {
+                    k: v
+                    for k, v in (
+                        rescue_cfg.get("rescue_reasons") or {}
+                    ).items()
+                    if v
+                },
+                **({"wall_pct": round(
+                    rescue_cfg["rescue_wall_share"] * 100.0, 2)}
+                   if rescue_cfg.get("rescue_wall_share") is not None
+                   else {}),
             }
         ),
         "oracle_fraction_max": full["oracle_fraction_max"],

@@ -27,6 +27,8 @@ keeps the generic path.
 """
 from __future__ import annotations
 
+import os
+
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .exceptions import DissectionFailure, FatalErrorDuringCallOfSetterMethod
@@ -36,6 +38,12 @@ from .value import Value, _java_double_to_string, _parse_java_double, _parse_jav
 _IN_PROGRESS = object()
 
 Route = Callable[["_Ctx", Any], None]
+
+# Escape hatch: LOGPARSER_TPU_FASTLINE_INTERP=1 keeps the interpreted
+# route closures (no source generation) — the bit-exactness referee the
+# codegen differential suite compares against, and the fallback if a
+# construct ever trips the generator in production.
+_INTERP_ENV = "LOGPARSER_TPU_FASTLINE_INTERP"
 
 
 class _Ctx:
@@ -97,39 +105,140 @@ def _compile_store(parser, key: str, name: str) -> Optional[Route]:
         for m, a, vtype, skip, ne in resolved
     )
 
-    def store(ctx: _Ctx, v) -> None:
-        record = ctx.record
-        called = False
-        for method_name, arg_count, vtype, conv, skip_null, not_empty in bound:
-            out = conv(v)
-            if out is None and skip_null:
-                called = True
-                continue
-            if not_empty and vtype == "STRING" and out == "":
-                called = True
-                continue
-            method = getattr(record, method_name, None)
-            if method is None:
-                raise FatalErrorDuringCallOfSetterMethod(
-                    f"Record {type(record).__name__} has no method {method_name!r}"
-                )
-            try:
-                if arg_count == 2:
-                    method(name, out)
-                else:
-                    method(out)
-            except Exception as e:  # noqa: BLE001 — mirror the generic wrap
-                raise FatalErrorDuringCallOfSetterMethod(
-                    f'{e} when calling "{method_name}" for key="{key}" '
-                    f'name="{name}" value="{v}" casts_to="{casts_to}"'
-                ) from e
-            called = True
-        if not called:
-            raise FatalErrorDuringCallOfSetterMethod(
-                f'No setter called for key="{key}" name="{name}" value="{v}"'
-            )
+    generated: Optional[Route] = None
+    if os.environ.get(_INTERP_ENV, "") != "1":
+        try:
+            generated = _generate_store(bound, key, name, casts_to)
+        except Exception:  # noqa: BLE001 — codegen must never break compile
+            generated = None
 
+    def _interp_store(ctx: _Ctx, v) -> None:
+        _run_store(ctx, v, bound, key, name, casts_to)
+
+    store: Route = generated if generated is not None else _interp_store
+    store._fl = ("store", key, name, bound, casts_to)  # type: ignore[attr-defined]
     return store
+
+
+def _emit_store_entry(w: "_CodeWriter", lvl: int, mv: str, entry,
+                      key: str, name: str, val: str, casts_var: str) -> None:
+    """Emit ONE store entry's guard + setter call + error wrapping — the
+    single source of the generated store semantics, shared by the
+    standalone store generator and the driver's inline token-stage
+    emission (the two must stay byte-identical in guard order and
+    failure messages; the differential suite locks both)."""
+    method_name, arg_count, vtype, _conv, skip_null, not_empty = entry
+    if not_empty and vtype == "STRING":
+        w.emit(lvl, 'if out is not None and out != "":')
+        lvl += 1
+    elif skip_null:
+        w.emit(lvl, "if out is not None:")
+        lvl += 1
+    w.emit(lvl, f"if {mv} is None:")
+    w.emit(lvl + 1, f"_rnm(_rec, {method_name!r})")
+    w.emit(lvl, "try:")
+    if arg_count == 2:
+        w.emit(lvl + 1, f"{mv}({name!r}, out)")
+    else:
+        w.emit(lvl + 1, f"{mv}(out)")
+    w.emit(lvl, "except Exception as e:")
+    w.emit(
+        lvl + 1,
+        f"_rse(e, {method_name!r}, {key!r}, {name!r}, {val}, {casts_var})",
+    )
+
+
+def _generate_store(bound, key: str, name: str, casts_to) -> Optional[Route]:
+    """Source-generate one store plan: the entry loop unrolled, conv
+    dispatch inlined, the setter looked up once.  Same records and same
+    failure messages as _run_store (the differential suite compares both);
+    emitter-fed values are Any, so convs stay the bound functions."""
+    w = _CodeWriter()
+    w.emit(0, "def _store(ctx, v):")
+    if not bound:
+        w.emit(1, f"_rns({key!r}, {name!r}, v)")
+    else:
+        w.emit(1, "_rec = ctx.record")
+        methods = []
+        for m, _a, _t, _c, _s, _ne in bound:
+            if m not in methods:
+                methods.append(m)
+        mv = {m: f"_m{j}" for j, m in enumerate(methods)}
+        for m in methods:
+            w.emit(1, f"{mv[m]} = getattr(_rec, {m!r}, None)")
+        cvar = w.bind(casts_to, "ct")
+        for entry in bound:
+            w.emit(1, f"out = {w.bind(entry[3], 'cv')}(v)")
+            _emit_store_entry(w, 1, mv[entry[0]], entry, key, name, "v", cvar)
+    exec(compile(w.source(), "<fastline-store>", "exec"), w.ns)  # noqa: S102
+    return w.ns["_store"]
+
+
+def _run_store(ctx: _Ctx, v, bound, key, name, casts_to) -> None:
+    record = ctx.record
+    called = False
+    for method_name, arg_count, vtype, conv, skip_null, not_empty in bound:
+        out = conv(v)
+        if out is None and skip_null:
+            called = True
+            continue
+        if not_empty and vtype == "STRING" and out == "":
+            called = True
+            continue
+        method = getattr(record, method_name, None)
+        if method is None:
+            _raise_no_method(record, method_name)
+        try:
+            if arg_count == 2:
+                method(name, out)
+            else:
+                method(out)
+        except Exception as e:  # noqa: BLE001 — mirror the generic wrap
+            _raise_setter_error(e, method_name, key, name, v, casts_to)
+        called = True
+    if not called:
+        _raise_no_setter(key, name, v)
+
+
+def _raise_no_method(record, method_name: str) -> None:
+    raise FatalErrorDuringCallOfSetterMethod(
+        f"Record {type(record).__name__} has no method {method_name!r}"
+    )
+
+
+def _raise_setter_error(e, method_name, key, name, v, casts_to) -> None:
+    raise FatalErrorDuringCallOfSetterMethod(
+        f'{e} when calling "{method_name}" for key="{key}" '
+        f'name="{name}" value="{v}" casts_to="{casts_to}"'
+    ) from e
+
+
+def _raise_no_setter(key, name, v) -> None:
+    raise FatalErrorDuringCallOfSetterMethod(
+        f'No setter called for key="{key}" name="{name}" value="{v}"'
+    )
+
+
+def _cache_parsed_field(ctx: _Ctx, ftype: str, complete_name: str, v) -> None:
+    """Cache one intermediate on the real Parsable — the read path of the
+    generic consumers and the last-chance converter pass."""
+    val = v if isinstance(v, Value) else Value(v)
+    pf = ParsedField(ftype, complete_name, val)
+    ctx.parsable._cache[pf.id] = pf
+
+
+def _drain_generic(parser, parsable) -> None:
+    """Drain intermediates a generic phase enqueued through the real
+    Parsable with the generic wave loop (without _run's trailing
+    last-chance pass; that runs exactly once per line, like the generic
+    engine)."""
+    to_be = set(parsable.to_be_parsed)
+    while to_be:
+        for pf in to_be:
+            parsable.set_as_parsed(pf)
+            for phase in parser._compiled.get(pf.id, ()):
+                phase.instance.dissect(parsable, pf.name)
+        to_be = set(parsable.to_be_parsed)
 
 
 class _Compiler:
@@ -171,6 +280,7 @@ class _Compiler:
     def _generic_route(self, base: str, ftype: str, name: str) -> Route:
         def generic(ctx: _Ctx, v) -> None:
             ctx.parsable.add_dissection(base, ftype, name, v)
+        generic._fl = ("generic", base, ftype, name)  # type: ignore[attr-defined]
         return generic
 
     def _compile_route(self, base: str, ftype: str, name: str) -> Route:
@@ -204,6 +314,7 @@ class _Compiler:
             for r in remap_routes:
                 r(ctx, v)
             tail(ctx, v)
+        route._fl = ("seq", tuple(remap_routes) + (tail,))  # type: ignore[attr-defined]
         return route
 
     def _compile_tail(
@@ -248,13 +359,15 @@ class _Compiler:
                     # The generic consumers (and the last-chance pass) read
                     # the field from the Parsable cache, exactly like the
                     # generic engine caches useful intermediates.
-                    val = v if isinstance(v, Value) else Value(v)
-                    pf = ParsedField(ftype, complete_name, val)
-                    ctx.parsable._cache[pf.id] = pf
+                    _cache_parsed_field(ctx, ftype, complete_name, v)
                 for r in fast_phases:
                     ctx.queue.append((r, v))
                 for g in generic_runs:
                     ctx.queue.append((g, v))
+            intermediate._fl = (  # type: ignore[attr-defined]
+                "intermediate", must_cache, ftype, complete_name,
+                tuple(fast_phases), tuple(generic_runs),
+            )
             sinks.append(intermediate)
 
         if needed_name in needed:
@@ -263,6 +376,7 @@ class _Compiler:
                 def needed_sink(ctx: _Ctx, v, _s=store) -> None:
                     ctx.delivered.add(needed_name)
                     _s(ctx, v)
+                needed_sink._fl = ("needed", needed_name, store)  # type: ignore[attr-defined]
                 sinks.append(needed_sink)
         if needed_wildcard in needed:
             store = _compile_store(parser, needed_wildcard, needed_name)
@@ -272,6 +386,7 @@ class _Compiler:
         if not sinks:
             def noop(ctx: _Ctx, v) -> None:
                 return
+            noop._fl = ("noop",)  # type: ignore[attr-defined]
             return noop
         if len(sinks) == 1:
             return sinks[0]
@@ -279,6 +394,7 @@ class _Compiler:
         def multi(ctx: _Ctx, v) -> None:
             for s in sinks:
                 s(ctx, v)
+        multi._fl = ("seq", tuple(sinks))  # type: ignore[attr-defined]
         return multi
 
     # -- value-level emitters for the hot sub-dissectors -----------------
@@ -631,6 +747,16 @@ class _FormatProgram:
 class FastLineEngine:
     """Compiled replay of Parser.parse for HttpdLogFormat-rooted parsers."""
 
+    # Set by generate_fastline_code when the exec'd driver is attached
+    # (the instance attribute `parse` then shadows the interpreted method).
+    codegen_active = False
+    generated_source: Optional[str] = None
+
+    def interpreted_parse(self, line: str, record: Any) -> Any:
+        """The interpreted driver, reachable even with codegen attached —
+        the referee the codegen differential suite compares against."""
+        return FastLineEngine.parse(self, line, record)
+
     def __init__(self, parser, programs: List[_FormatProgram],
                  needs_parsable: bool, cache_root: bool = False):
         self.parser = parser
@@ -689,20 +815,386 @@ class FastLineEngine:
             fn(ctx, v)
             if parsable is not None and parsable.to_be_parsed:
                 # A generic phase enqueued new intermediates through the
-                # real Parsable — drain them with the generic wave loop
-                # (without _run's trailing last-chance pass; that runs
-                # exactly once below, like the generic engine).
-                to_be = set(parsable.to_be_parsed)
-                while to_be:
-                    for pf in to_be:
-                        parsable.set_as_parsed(pf)
-                        for phase in parser._compiled.get(pf.id, ()):
-                            phase.instance.dissect(parsable, pf.name)
-                    to_be = set(parsable.to_be_parsed)
+                # real Parsable — drain them with the generic wave loop.
+                _drain_generic(parser, parsable)
         if parsable is not None:
             parser._last_chance_converters(parsable)
         tally["parsed"] += 1
         return record
+
+    def parse_many(self, lines, record_factory) -> List[Optional[Any]]:
+        """Batched parse with amortized per-call setup: one engine fetch,
+        hoisted locals, one record per line.  Returns the record for each
+        parsed line and None for each DissectionFailure — the shape the
+        batch runtime's rescue path consumes."""
+        parse = self.parse
+        out: List[Optional[Any]] = []
+        append = out.append
+        for line in lines:
+            rec = record_factory()
+            try:
+                parse(line, rec)
+                append(rec)
+            except DissectionFailure:
+                append(None)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Store-program source generation.
+#
+# The interpreted engine above dispatches each token capture through nested
+# route closures: a per-token list walk, a per-sink loop, a per-setter-entry
+# loop with conv dispatch, and explicit noop calls for unrequested outputs.
+# Per line that interpretation overhead is ~35-40% of the oracle's wall time
+# (profiled: store loop + needed_sink + noop + _FormatProgram.run dispatch).
+# This backend compiles the SAME route structure (walked via the ``_fl``
+# metadata each closure carries) into one exec'd straight-line function per
+# format — noop routes vanish, sink/entry loops unroll, value conversions
+# inline (token captures are str|None by construction on the Apache dialect),
+# and record setters are looked up once per line instead of once per value —
+# plus a flat per-line driver replacing FastLineEngine.parse.
+#
+# Semantics contract: byte-identical records and failure messages vs the
+# interpreted engine (locked by the differential suite in
+# tests/test_fastline_codegen.py).  Sub-dissector emitters stay the compiled
+# closures they already were; only the routing/storing interpretation is
+# generated away.  LOGPARSER_TPU_FASTLINE_INTERP=1 disables generation.
+# ---------------------------------------------------------------------------
+
+
+def _raise_unusable() -> None:
+    raise DissectionFailure("Dissector in unusable state")
+
+
+def _make_format_miss(tf):
+    def miss(line):
+        raise DissectionFailure(
+            "The input line does not match the specified log format."
+            f"Line     : {line}\n"
+            f"LogFormat: {tf.log_format}\n"
+            f"RegEx    : {tf._regex}"
+        )
+    return miss
+
+
+class _CodeWriter:
+    """Source accumulator + exec namespace for one generated engine."""
+
+    def __init__(self):
+        self.lines: List[str] = []
+        self.ns: Dict[str, Any] = {
+            "_DF": DissectionFailure,
+            "_Ctx": _Ctx,
+            "_rnm": _raise_no_method,
+            "_rse": _raise_setter_error,
+            "_rns": _raise_no_setter,
+            "_cpf": _cache_parsed_field,
+            "_pjl": _parse_java_long,
+            "_pjd": _parse_java_double,
+        }
+        self._n = 0
+        self._bound: Dict[int, str] = {}
+
+    def bind(self, obj, prefix: str = "o") -> str:
+        got = self._bound.get(id(obj))
+        if got is not None:
+            return got
+        name = f"_{prefix}{self._n}"
+        self._n += 1
+        self.ns[name] = obj
+        self._bound[id(obj)] = name
+        return name
+
+    def emit(self, indent: int, line: str) -> None:
+        self.lines.append("    " * indent + line)
+
+    def source(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def _walk_routes(route, visit) -> None:
+    """Depth-first walk over a route's ``_fl`` structure."""
+    meta = getattr(route, "_fl", None)
+    visit(route, meta)
+    if meta is None:
+        return
+    if meta[0] == "seq":
+        for part in meta[1]:
+            _walk_routes(part, visit)
+    elif meta[0] == "needed":
+        _walk_routes(meta[2], visit)
+
+
+class _EngineCodegen:
+    def __init__(self, engine: FastLineEngine):
+        self.engine = engine
+        self.w = _CodeWriter()
+
+    # -- structure scan --------------------------------------------------
+
+    def _scan(self):
+        """Which hoists the generated run functions need: store method
+        names, queue use, delivered tracking, generic add_dissection."""
+        methods: List[str] = []
+        flags = {"queue": False, "delivered": False}
+
+        def visit(route, meta):
+            if meta is None:
+                return
+            kind = meta[0]
+            if kind == "store":
+                for m, _a, _t, _c, _s, _ne in meta[3]:
+                    if m not in methods:
+                        methods.append(m)
+            elif kind == "needed":
+                flags["delivered"] = True
+            elif kind == "intermediate":
+                flags["queue"] = True
+
+        for prog in self.engine.programs:
+            for fields in prog.token_routes:
+                for _fname, route in fields:
+                    _walk_routes(route, visit)
+        return methods, flags
+
+    # -- store emission --------------------------------------------------
+
+    def _emit_store(self, indent: int, meta, val: str, val_is_str: bool,
+                    method_vars: Dict[str, str]) -> None:
+        _kind, key, name, bound, casts_to = meta
+        w = self.w
+        if not bound:
+            w.emit(indent, f"_rns({key!r}, {name!r}, {val})")
+            return
+        casts_var = w.bind(casts_to, "ct")
+        for entry in bound:
+            method_name, _arg_count, vtype, conv, _skip, _ne = entry
+            if val_is_str and vtype == "STRING":
+                # Token captures are str|None: _to_string is identity.
+                out = val
+            elif val_is_str and vtype == "LONG":
+                out = f"(_pjl({val}) if {val} is not None else None)"
+            elif val_is_str and vtype == "DOUBLE":
+                out = f"(_pjd({val}) if {val} is not None else None)"
+            else:
+                out = f"{w.bind(conv, 'cv')}({val})"
+            w.emit(indent, f"out = {out}")
+            _emit_store_entry(w, indent, method_vars[method_name], entry,
+                              key, name, val, casts_var)
+
+    # -- route emission --------------------------------------------------
+
+    def _route_is_noop(self, route) -> bool:
+        meta = getattr(route, "_fl", None)
+        if meta is None:
+            return False
+        if meta[0] == "noop":
+            return True
+        if meta[0] == "seq":
+            return all(self._route_is_noop(p) for p in meta[1])
+        return False
+
+    def _emit_route(self, indent: int, route, val: str, val_is_str: bool,
+                    method_vars: Dict[str, str],
+                    track_delivered: bool) -> None:
+        w = self.w
+        meta = getattr(route, "_fl", None)
+        if meta is None:
+            w.emit(indent, f"{w.bind(route, 'r')}(ctx, {val})")
+            return
+        kind = meta[0]
+        if kind == "noop":
+            return
+        if kind == "seq":
+            for part in meta[1]:
+                self._emit_route(indent, part, val, val_is_str,
+                                 method_vars, track_delivered)
+            return
+        if kind == "needed":
+            if track_delivered:
+                w.emit(indent, f"_dlv.add({meta[1]!r})")
+            self._emit_route(indent, meta[2], val, val_is_str,
+                             method_vars, track_delivered)
+            return
+        if kind == "store":
+            self._emit_store(indent, meta, val, val_is_str, method_vars)
+            return
+        if kind == "intermediate":
+            _k, must_cache, ftype, cname, fast_phases, generic_runs = meta
+            if must_cache:
+                w.emit(indent, f"_cpf(ctx, {ftype!r}, {cname!r}, {val})")
+            for p in fast_phases:
+                w.emit(indent, f"_q.append(({w.bind(p, 'em')}, {val}))")
+            for g in generic_runs:
+                w.emit(indent, f"_q.append(({w.bind(g, 'gn')}, {val}))")
+            return
+        if kind == "generic":
+            _k, base, ftype, name = meta
+            w.emit(
+                indent,
+                f"ctx.parsable.add_dissection({base!r}, {ftype!r}, "
+                f"{name!r}, {val})",
+            )
+            return
+        # Unknown future kind: call the closure (never wrong, just slower).
+        w.emit(indent, f"{w.bind(route, 'r')}(ctx, {val})")
+
+    # -- per-format run function ----------------------------------------
+
+    def _emit_program(self, k: int, prog: _FormatProgram,
+                      methods: List[str], flags) -> str:
+        from ..dissectors.utils import decode_apache_httpd_log_value
+
+        w = self.w
+        track_delivered = self.engine.needs_parsable
+        fn = f"_fmt_run_{k}"
+        tf_var = w.bind(prog.tf, "tf")
+        pat_var = w.bind(prog.tf._pattern.search, "pat")
+        miss_var = w.bind(_make_format_miss(prog.tf), "miss")
+        method_vars = {m: f"_m{j}" for j, m in enumerate(methods)}
+
+        w.emit(0, f"def {fn}(ctx, line):")
+        w.emit(1, f"if not {tf_var}._usable:")
+        w.emit(2, "_raise_unusable()")
+        w.emit(1, f"m = {pat_var}(line) if line is not None else None")
+        w.emit(1, "if m is None:")
+        w.emit(2, f"{miss_var}(line)")
+        w.emit(1, "g = m.groups()")
+        w.emit(1, "_rec = ctx.record")
+        if flags["queue"]:
+            w.emit(1, "_q = ctx.queue")
+        if track_delivered and flags["delivered"]:
+            w.emit(1, "_dlv = ctx.delivered")
+        for m in methods:
+            w.emit(1, f"{method_vars[m]} = getattr(_rec, {m!r}, None)")
+        w.ns["_raise_unusable"] = _raise_unusable
+
+        emitted_any = False
+        if prog.apache_decode:
+            dec_var = w.bind(decode_apache_httpd_log_value, "apdec")
+            hdrs = ("request.header.", "response.header.")
+            hdrs_var = w.bind(hdrs, "hdr")
+            for i, fields in enumerate(prog.token_routes):
+                live = [
+                    (fname, r) for fname, r in fields
+                    if not self._route_is_noop(r)
+                ]
+                if not live:
+                    continue
+                emitted_any = True
+                w.emit(1, f"v = g[{i}]")
+                w.emit(1, 'if v == "-":')
+                w.emit(2, "v = None")
+                # Faithful upstream quirk: the reference compares the
+                # VALUE against these names (utils_apache.py).
+                w.emit(1, 'elif v and (v == "request.firstline" '
+                          f"or v.startswith({hdrs_var})):")
+                w.emit(2, f"v = {dec_var}(v)")
+                for _fname, route in live:
+                    self._emit_route(1, route, "v", True,
+                                     method_vars, track_delivered)
+        else:
+            dec_var = w.bind(prog.tf.decode_extracted_value, "dec")
+            for i, fields in enumerate(prog.token_routes):
+                live = [
+                    (fname, r) for fname, r in fields
+                    if not self._route_is_noop(r)
+                ]
+                if not live:
+                    continue
+                emitted_any = True
+                w.emit(1, f"v = g[{i}]")
+                for j, (fname, route) in enumerate(live):
+                    # Dialect decode runs per (name, capture) pair, like
+                    # the interpreted loop; its output type is dialect-
+                    # defined, so conversions stay the bound convs.
+                    w.emit(1, f"d{j} = {dec_var}({fname!r}, v)")
+                    self._emit_route(1, route, f"d{j}", False,
+                                     method_vars, track_delivered)
+        if not emitted_any:
+            w.emit(1, "pass")
+        w.emit(0, "")
+        return fn
+
+    # -- the per-line driver ---------------------------------------------
+
+    def generate(self):
+        engine = self.engine
+        w = self.w
+        methods, flags = self._scan()
+        run_fns = [
+            self._emit_program(k, prog, methods, flags)
+            for k, prog in enumerate(engine.programs)
+        ]
+
+        parser = engine.parser
+        w.ns["_tally"] = engine.tally
+        w.emit(0, "def _parse(line, record):")
+        if engine.needs_parsable:
+            mk = w.bind(parser.create_parsable, "mkp")
+            w.emit(1, f"parsable = {mk}(record)")
+            if engine.cache_root:
+                rt = w.bind(parser.root_type, "rt")
+                w.emit(1, f"parsable.set_root_dissection({rt}, line)")
+                w.emit(1, "parsable.to_be_parsed.clear()")
+            w.emit(1, "ctx = _Ctx(record, parsable)")
+        else:
+            w.emit(1, "ctx = _Ctx(record, None)")
+        w.emit(1, "try:")
+        w.emit(2, f"{run_fns[0]}(ctx, line)")
+        w.emit(1, "except _DF:")
+        if len(run_fns) <= 1:
+            w.emit(2, "_tally['rejected'] += 1")
+            w.emit(2, "raise")
+        else:
+            # Multi-format fallback: on failure retry EVERY format in
+            # registration order (stateless mode); partial deliveries
+            # before the failure stay, like the interpreted path.
+            w.emit(2, f"for _run in ({', '.join(run_fns)},):")
+            w.emit(3, "try:")
+            w.emit(4, "_run(ctx, line)")
+            w.emit(4, "_tally['format_fallback'] += 1")
+            w.emit(4, "break")
+            w.emit(3, "except _DF:")
+            w.emit(4, "continue")
+            w.emit(2, "else:")
+            w.emit(3, "_tally['rejected'] += 1")
+            w.emit(3, "raise")
+        w.emit(1, "q = ctx.queue")
+        w.emit(1, "i = 0")
+        w.emit(1, "while i < len(q):")
+        w.emit(2, "fn, v = q[i]")
+        w.emit(2, "i += 1")
+        w.emit(2, "fn(ctx, v)")
+        if engine.needs_parsable:
+            drain = w.bind(_drain_generic, "drain")
+            pvar = w.bind(parser, "parser")
+            w.emit(2, "if parsable.to_be_parsed:")
+            w.emit(3, f"{drain}({pvar}, parsable)")
+            lc = w.bind(parser._last_chance_converters, "lc")
+            w.emit(1, f"{lc}(parsable)")
+        w.emit(1, "_tally['parsed'] += 1")
+        w.emit(1, "return record")
+        w.emit(0, "")
+
+        source = w.source()
+        code = compile(source, "<fastline-codegen>", "exec")
+        exec(code, w.ns)  # noqa: S102 — our own generated source
+        return w.ns["_parse"], source
+
+
+def generate_fastline_code(engine: FastLineEngine) -> bool:
+    """Attach a generated per-line driver to ``engine`` (see the section
+    comment above).  Returns True when generation succeeded and
+    ``engine.parse`` now runs generated code; on any failure the
+    interpreted engine is left untouched."""
+    gen = _EngineCodegen(engine)
+    parse, source = gen.generate()
+    engine.parse = parse  # type: ignore[method-assign] — instance attr wins
+    engine.generated_source = source
+    engine.codegen_active = True
+    return True
 
 
 def compile_fastline(parser) -> Optional[FastLineEngine]:
@@ -741,8 +1233,19 @@ def compile_fastline(parser) -> Optional[FastLineEngine]:
 
     # Generic phases, last-chance probes and routing cycles need a real
     # Parsable per line; the compiler recorded whether any route does.
-    return FastLineEngine(
+    engine = FastLineEngine(
         parser, programs,
         needs_parsable=compiler.any_generic,
         cache_root=root_id in compiler.probe_ids,
     )
+    if os.environ.get(_INTERP_ENV, "") != "1":
+        try:
+            generate_fastline_code(engine)
+        except Exception:  # noqa: BLE001 — codegen must never break parsing
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fastline codegen failed; keeping the interpreted engine",
+                exc_info=True,
+            )
+    return engine

@@ -120,11 +120,14 @@ def main() -> int:
     lines = [
         '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] '
         '"GET /i.html?x=1 HTTP/1.1" 200 512 "-" "smoke/1.0"',
-        # Plausible-but-device-rejected (20-digit byte count beyond the
-        # 18-digit device limb decoder): routes to the oracle, so the
-        # oracle_routed_lines_total counter must move.
+        # Plausible-but-device-rejected (backslash-escaped quote in the
+        # user-agent — the host regex accepts it, the optimistic device
+        # split does not): routes to the oracle, so the
+        # oracle_routed_lines_total counter must move.  (A 20-digit %b no
+        # longer qualifies: the round-9 full-int64 decoder keeps that
+        # class on device.)
         '5.6.7.8 - - [31/Dec/2012:23:49:41 +0100] '
-        '"GET /big HTTP/1.1" 200 99999999999999999999 "-" "smoke/1.0"',
+        '"GET /big HTTP/1.1" 200 17 "-" "smoke \\" esc/1.0"',
     ]
     with ParseService(metrics_port=0) as svc:
         with ParseServiceClient(

@@ -1,0 +1,168 @@
+"""CI rescue smoke: the batched host-rescue pipeline on a dirty corpus.
+
+Builds a small mixed stream with FORCED ~5% device-rejected lines (a
+backslash-escaped quote inside the user-agent: the host regex accepts
+it, the optimistic device split does not) plus the former overflow
+class (20-digit ``%b`` counters), then asserts the round-9 rescue
+contract end to end:
+
+- the overflow class stays ON DEVICE (full-int64 decoder: zero routed
+  lines, exact values delivered) — the widening guard;
+- the forced rejects are rescued with values identical to the per-line
+  oracle, through the BATCHED rescue path;
+- the rescue pipeline clears a throughput floor (rescued lines per
+  second of rescue wall — load-independent of the device, so the smoke
+  means the same thing on a CI CPU and a TPU host), and the batch's
+  effective rate clears a conservative floor;
+- a live ``/metrics`` scrape exposes the per-reason
+  ``oracle_routed_lines_total`` counters and stays well-formed
+  exposition (validated by metrics_smoke's strict grammar checker).
+
+Usage::
+
+    make rescue-smoke
+    python -m logparser_tpu.tools.rescue_smoke
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+
+# Rescue-pipeline throughput floor (rescued lines per rescue-wall
+# second).  The compiled+codegen oracle clears ~25k even on a weak CI
+# core; the pre-round-4 generic engine (~10k) or a rescue path that
+# re-serializes per line would trip it.
+RESCUE_RATE_FLOOR = float(os.environ.get(
+    "LOGPARSER_TPU_RESCUE_SMOKE_RATE_FLOOR", "15000"))
+# Whole-batch effective floor — deliberately conservative: the smoke
+# runs on CI CPUs; the real >=5M gate is bench.py's RESCUE_EFFECTIVE
+# floor on the TPU host.
+EFFECTIVE_FLOOR = float(os.environ.get(
+    "LOGPARSER_TPU_RESCUE_SMOKE_EFFECTIVE_FLOOR", "10000"))
+
+N_LINES = 2048
+FIELDS = ["IP:connection.client.host", "BYTES:response.body.bytes",
+          "HTTP.USERAGENT:request.user-agent"]
+
+
+def build_corpus():
+    from logparser_tpu.tools.demolog import generate_combined_lines
+
+    base = generate_combined_lines(N_LINES, seed=90)
+    forced, overflow = [], []
+    for i, ln in enumerate(base):
+        if i % 20 == 0:  # 5%: forced device-reject, host-rescued
+            base[i] = re.sub(r'"([^"]*)"$', r'"esc \\" quote \1"', ln,
+                             count=1)
+            forced.append(i)
+        elif i % 20 == 10:  # 5%: the FORMER overflow reject class
+            base[i] = re.sub(r'" (\d{3}) (\d+|-) ',
+                             f'" \\1 {10**19 + i} ', ln, count=1)
+            overflow.append(i)
+    return base, forced, overflow
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import urllib.request
+
+    from logparser_tpu.core.exceptions import DissectionFailure
+    from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+    lines, forced, overflow = build_corpus()
+    parser = TpuBatchParser("combined", FIELDS)
+    parser.parse_batch(lines)  # warm: compile + caches
+
+    t0 = time.perf_counter()
+    result = parser.parse_batch(lines)
+    wall = time.perf_counter() - t0
+
+    errors = []
+    reasons = result.rescue_reasons
+    # (a) widening guard: the overflow class must NOT route.
+    routed = result.oracle_rows
+    if reasons.get("overflow", 0) or routed > len(forced):
+        errors.append(
+            f"former overflow class routed to the oracle: rows={routed} "
+            f"reasons={reasons} (expected only the {len(forced)} forced "
+            "rejects)"
+        )
+    vals = result.to_pylist("BYTES:response.body.bytes")
+    for i in overflow:
+        if vals[i] != 10 ** 19 + i:
+            errors.append(f"overflow row {i}: device value {vals[i]!r} != "
+                          f"{10**19 + i}")
+            break
+    # (b) forced rejects rescued, bit-identical to the per-line oracle.
+    if reasons.get("device_reject", 0) < len(forced):
+        errors.append(
+            f"forced rejects not routed: {reasons} (expected >= "
+            f"{len(forced)} device_reject)"
+        )
+    ua = result.to_pylist("HTTP.USERAGENT:request.user-agent")
+    for i in forced[: 8]:
+        try:
+            rec = parser.oracle.parse(lines[i], _CollectingRecord())
+            want = rec.values.get("HTTP.USERAGENT:request.user-agent")
+        except DissectionFailure:
+            errors.append(f"forced line {i} not host-parseable")
+            break
+        if not result.valid[i] or ua[i] != want:
+            errors.append(
+                f"forced row {i} not rescued bit-identically: "
+                f"{ua[i]!r} != {want!r}"
+            )
+            break
+    # (c) throughput floors.
+    rescue_rate = (routed / result.rescue_wall_s
+                   if result.rescue_wall_s else float("inf"))
+    if rescue_rate < RESCUE_RATE_FLOOR:
+        errors.append(
+            f"rescue pipeline {rescue_rate:.0f} rescued-lines/s below "
+            f"the {RESCUE_RATE_FLOOR:.0f} floor"
+        )
+    effective = len(lines) / wall if wall else float("inf")
+    if effective < EFFECTIVE_FLOOR:
+        errors.append(
+            f"effective rate {effective:.0f} lines/s below the "
+            f"{EFFECTIVE_FLOOR:.0f} smoke floor"
+        )
+
+    # (d) /metrics exposes the per-reason rescue counters (live scrape,
+    # strict exposition grammar — reuses metrics_smoke's validator).
+    from logparser_tpu.service import ParseService, ParseServiceClient
+    from logparser_tpu.tools.metrics_smoke import validate_exposition
+
+    with ParseService(metrics_port=0) as svc:
+        with ParseServiceClient(svc.host, svc.port, "combined",
+                                FIELDS) as client:
+            client.parse(lines[: 256])
+        url = f"http://{svc.host}:{svc.metrics_port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode("utf-8")
+    errors += validate_exposition(text)
+    if ('logparser_tpu_oracle_routed_lines_total{reason="device_reject"}'
+            not in text):
+        errors.append(
+            "/metrics missing per-reason rescue counter "
+            "oracle_routed_lines_total{reason=\"device_reject\"}"
+        )
+
+    if errors:
+        print(f"rescue smoke FAILED ({len(errors)} problems):")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(
+        "rescue smoke OK: "
+        f"{routed}/{len(lines)} routed ({reasons}), "
+        f"rescue {rescue_rate:.0f} lines/s, "
+        f"effective {effective:.0f} lines/s, /metrics well-formed"
+    )
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — CLI
+    sys.exit(main())

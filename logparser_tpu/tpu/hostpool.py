@@ -134,6 +134,24 @@ class AssemblyPool:
                 "hostpool_wall_seconds_total", time.perf_counter() - t_run
             )
 
+    def submit(self, fn: Callable[[], Any]):
+        """Submit ONE thunk for background execution; returns a Future,
+        or None when the pool is serial (1-wide) or closed — callers
+        then run the thunk inline.  Used by the batch runtime's rescue
+        path to overlap the host oracle parse with the CSR/column
+        materialization; run_all's per-task metrics stay per-column, so
+        this path only counts the run."""
+        if self.workers == 1:
+            return None
+        ex = self._get_executor()
+        if ex is None:
+            return None
+
+        from ..observability import metrics
+
+        metrics().increment("hostpool_runs_total")
+        return ex.submit(fn)
+
     def close(self) -> None:
         """Terminal: later run_all calls execute serially instead of
         respawning threads (a retained BatchResult may outlive its

@@ -596,7 +596,11 @@ class PackedLayout:
                 aux_needs += [
                     (plan.field_id, "ok", 1),
                     (plan.field_id, "null", 1),
-                    (plan.field_id, "lo_digits", 5),  # digit count <= 18
+                    (plan.field_id, "lo_digits", 5),  # digit count <= 19
+                    (plan.field_id, "d18", 4),        # the 19th frame digit
+                    # >19-digit run, device-valid: the hi row carries
+                    # start|len<<_SPAN_BITS for the host byte-patch.
+                    (plan.field_id, "big", 1),
                 ]
                 if kind == "secmillis":
                     aux_needs.append((plan.field_id, "milli", 10))
@@ -907,26 +911,58 @@ def compute_rows(
                 null = clf_dash(s, e)  # direct token capture: CLF null
             put_span(plan.field_id, s, e, chain_ok, null, amp, fix)
         elif plan.kind in ("long", "secmillis"):
+            big = None
             if plan.kind == "secmillis":
-                (hi, lo, lo_digits), milli, is_null, ok = (
+                (hi, lo, d18, lo_digits), milli, is_null, ok = (
                     postproc.parse_secmillis_spans(b32, s, e, extract=extract)
                 )
                 put(plan.field_id, "milli", milli)
             else:
-                (hi, lo, lo_digits), is_null, ok = postproc.parse_long_spans(
-                    b32, s, e,
-                    clf=plan.null_mode in ("dash_null", "dash_zero"),
-                    extract=extract,
+                (hi, lo, d18, lo_digits), is_null, ok, big = (
+                    postproc.parse_long_spans(
+                        b32, s, e,
+                        clf=plan.null_mode in ("dash_null", "dash_zero"),
+                        extract=extract,
+                    )
                 )
+            # Full-int64 overflow handling is only wired for the PLAIN
+            # direct-token long (the %b/%D FORMAT_NUMBER class): scaled
+            # values, zero_null (string-compared) conversions and chained
+            # sub-spans keep their pre-widening behavior — decode failure
+            # routes the line to the oracle, whose semantics are exact.
+            allow_big = (
+                plan.kind == "long"
+                and not plan.steps
+                and plan.scale == 1
+                and plan.null_mode != "zero_null"
+            )
+            if big is not None and allow_big:
+                # Device-valid >19-digit runs: the frame cannot carry the
+                # value, so the hi row carries the span instead and the
+                # host patches the exact value from the byte buffer
+                # (reference Long-overflow semantics; only the first 19
+                # bytes were digit-checked — the patch checks the rest).
+                blen = jnp.minimum(e - s, (1 << _SPAN_BITS) - 1)
+                hi = jnp.where(big, s | (blen << _SPAN_BITS), hi)
+                lo = jnp.where(big, 0, lo)
+                d18 = jnp.where(big, 0, d18)
+                put(plan.field_id, "big", jnp.where(big, 1, 0))
+            elif big is not None:
+                ok = ok & ~big
+                put(plan.field_id, "big", jnp.zeros_like(hi))
+            else:
+                put(plan.field_id, "big", jnp.zeros_like(hi))
             put(plan.field_id, "hi", hi)
             put(plan.field_id, "lo", lo)
+            put(plan.field_id, "d18", d18)
             put(plan.field_id, "lo_digits", lo_digits)
             put(plan.field_id, "ok", jnp.where(ok, 1, 0))
             put(plan.field_id, "null", jnp.where(is_null, 1, 0))
             if not plan.steps:
                 # Direct token numerics: the split charset admitted the
-                # span, so a decode failure (>18 digits, malformed
-                # sec.millis) is exactly a case the host path types
+                # span, so a decode failure (non-digit window bytes,
+                # malformed sec.millis, >19-digit runs outside the
+                # allow_big class) is exactly a case the host path types
                 # differently or rejects — route the line to the oracle.
                 valid = valid & (ok | ~chain_ok)
             if plan.null_mode == "zero_null":

@@ -23,7 +23,18 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-MAX_LONG_DIGITS = 18
+# Full int64 frame: every value of <= 19 digits decodes exactly on device
+# (the widest int64, Long.MAX_VALUE = 9223372036854775807, has 19).  Runs
+# LONGER than 19 digits stay device-valid too: parse_long_spans flags them
+# ``big`` and the batch runtime patches their exact value from the byte
+# buffer host-side (reference semantics: TokenParser FORMAT_NUMBER has no
+# width bound; values beyond Long range deliver through the STRING cast).
+MAX_LONG_DIGITS = 19
+LONG_MAX = (1 << 63) - 1
+# uint64 powers of ten for the host-side frame combine (10^19 overflows
+# int64 but not uint64; mixed-dtype np.power would promote to float64).
+_POW10_U64 = np.array([10 ** k for k in range(MAX_LONG_DIGITS + 1)],
+                      dtype=np.uint64)
 
 
 def pow10_weights(w: int) -> jnp.ndarray:
@@ -83,12 +94,18 @@ def parse_long_spans(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Spans of ASCII digits -> int64 limbs, fully vectorized.
 
-    Returns ((hi, lo, ndig), is_null, ok).  The limbs use a FIXED 18-wide
-    left-aligned frame: ``hi`` is the dot product of window columns 0..8
-    with 10^(8-i), ``lo`` of columns 9..17 with 10^(17-i) (bytes past the
-    span masked to digit 0), and ``ndig`` the span's digit count — so the
-    host combine is one exact integer division (combine_long_limbs), and
-    the device needs no per-column scalar rounds.  With ``clf`` a lone '-'
+    Returns ((hi, lo, d18, ndig), is_null, ok, big).  The limbs use a
+    FIXED 19-wide left-aligned frame: ``hi`` is the dot product of window
+    columns 0..8 with 10^(8-i), ``lo`` of columns 9..17 with 10^(17-i),
+    ``d18`` the raw 19th digit (bytes past the span masked to digit 0),
+    and ``ndig`` the span's digit count — so the host combine is one
+    exact uint64 integer division (combine_long_limbs), and the device
+    needs no per-column scalar rounds.  ``big`` marks runs longer than 19
+    digits: the frame cannot carry them, so the caller either packs span
+    coordinates for a host byte-patch (direct token numerics, reference
+    Long-overflow semantics) or clears ok to route the line to the
+    oracle.  For big rows only the first 19 bytes are digit-checked on
+    device; the host patch validates the tail.  With ``clf`` a lone '-'
     yields is_null=True (the reference maps '-' to null,
     ApacheHttpdLogFormatDissector decodeExtractedValue :176-178).
     """
@@ -103,29 +120,43 @@ def parse_long_spans(
 
     p9 = pow10_weights(9)
     hi = jnp.sum(d[:, :9] * p9, axis=1).astype(jnp.int32)
-    lo = jnp.sum(d[:, 9:] * p9, axis=1).astype(jnp.int32)
+    lo = jnp.sum(d[:, 9:18] * p9, axis=1).astype(jnp.int32)
+    d18 = d[:, 18].astype(jnp.int32)
 
     is_dash = (n == 1) & (bytes_[:, 0] == np.uint8(ord("-")))
-    all_digits = jnp.all(digit_ok | ~in_span, axis=1)
-    ok = (
-        ((n > 0) & (n <= MAX_LONG_DIGITS) & all_digits)
-        | (is_dash if clf else False)
-    )
+    window_digits = jnp.all(digit_ok | ~in_span, axis=1)
+    big = n > MAX_LONG_DIGITS
+    ok = ((n > 0) & window_digits) | (is_dash if clf else False)
     is_null = is_dash & clf
-    return (hi, lo, jnp.clip(n, 0, MAX_LONG_DIGITS)), is_null, ok
+    return (
+        (hi, lo, d18, jnp.clip(n, 0, MAX_LONG_DIGITS)),
+        is_null, ok, big,
+    )
 
 
-def combine_long_limbs(hi, lo, ndig, is_null) -> np.ndarray:
-    """Host-side limb combine -> int64 numpy column (null slots -1).
+def combine_long_limbs(hi, lo, d18, ndig, is_null):
+    """Host-side frame combine -> (int64 values, overflow mask, uint64
+    frame values).
 
     The limbs are the fixed-frame dot products of parse_long_spans: the
-    18-digit left-aligned value is hi*10^9 + lo with (18 - ndig) trailing
-    zero digits, so dividing by 10^(18-ndig) is exact."""
-    wide = np.asarray(hi, dtype=np.int64) * 10**9 + np.asarray(lo, dtype=np.int64)
+    19-digit left-aligned value is hi*10^10 + lo*10 + d18 with
+    (19 - ndig) trailing zero digits, so dividing by 10^(19-ndig) is
+    exact.  The combine runs in uint64 (10^19-1 overflows int64);
+    ``overflow`` marks rows whose exact value exceeds Long.MAX_VALUE —
+    the caller delivers those through the reference's STRING-cast
+    overflow path (the int64 column entry is clamped, never read).
+    Null slots -1.  Rows the device flagged ``big`` carry span
+    coordinates in ``hi`` and must be masked out by the caller."""
+    hi_u = np.asarray(hi).astype(np.uint64)
+    lo_u = np.asarray(lo).astype(np.uint64)
+    d_u = np.asarray(d18).astype(np.uint64)
+    frame = hi_u * np.uint64(10 ** 10) + lo_u * np.uint64(10) + d_u
     shift = MAX_LONG_DIGITS - np.asarray(ndig, dtype=np.int64)
-    value = wide // np.power(10, shift)
+    wide = frame // _POW10_U64[np.clip(shift, 0, MAX_LONG_DIGITS)]
+    overflow = wide > np.uint64(LONG_MAX)
+    value = np.where(overflow, np.uint64(LONG_MAX), wide).astype(np.int64)
     value[np.asarray(is_null)] = -1
-    return value
+    return value, overflow, wide
 
 
 def parse_secmillis_spans(
@@ -149,7 +180,7 @@ def parse_secmillis_spans(
     """
     extract = extract or gather_span_bytes
     w = end - start
-    sec_limbs, _, sec_ok = parse_long_spans(
+    sec_limbs, _, sec_ok, sec_big = parse_long_spans(
         buf, start, jnp.maximum(end - 4, start), extract=extract
     )
     # One width-4 window serves both the dot and the three millis digits.
@@ -159,9 +190,14 @@ def parse_secmillis_spans(
     m_ok = jnp.all((md >= 0) & (md <= 9), axis=1)
     millis = md[:, 0] * 100 + md[:, 1] * 10 + md[:, 2]
     ok = (
+        # Total width cap unchanged from the 18-digit era (nd = w-1 <= 18):
+        # seconds spans stay <= 15 digits, so seconds*1000+millis can
+        # never overflow int64 and the big/overflow machinery of the
+        # plain long path is unreachable here.
         (w >= 5)
-        & (w <= MAX_LONG_DIGITS + 1)   # nd = w-1 <= 18, as before
+        & (w <= 19)
         & sec_ok
+        & ~sec_big
         & m_ok
         & (dot == np.uint8(ord(".")))
     )
@@ -200,7 +236,7 @@ def split_uri_fast(
       bytes inside the span),
     - a scheme that fails ``[A-Za-z][A-Za-z0-9+.-]*`` (raises on the
       host — the oracle rejects the line identically),
-    - an absolute URL with an actual digits-only port longer than 18
+    - an absolute URL with an actual digits-only port longer than 19
       digits (the host parses it with arbitrary precision).
 
     Absolute URLs (JavaUri semantics, dissectors/uri.py:105-168): scheme =
@@ -390,9 +426,11 @@ def split_uri_fast(
             is_pct & (pos >= auth_start[:, None]) & (pos < at[:, None]),
             axis=1,
         )
-        # Only an actual >18-digit DIGITS port needs the oracle (the host
+        # Only an actual >19-digit DIGITS port needs the oracle (the host
         # parses it with arbitrary precision); a non-digit port region of
-        # any length is just registry-based.
+        # any length is just registry-based.  A 19-digit port beyond
+        # Long.MAX decodes on device and is demoted host-side by the
+        # batch combine's overflow mask.
         abs_ok = (
             has_scheme & scheme_ok & dslash
             & ~(
@@ -405,7 +443,7 @@ def split_uri_fast(
         # their reductions.  Correct for path/query/protocol/ref because
         # the repair chain's %-insertions in the authority cannot change
         # the path/query SPAN CONTENTS (only shift the repaired copy), a
-        # >18-digit port affects only the port parse, and registry-vs-
+        # >19-digit port affects only the port parse, and registry-vs-
         # server validation affects only the authority outputs.
         false_v = jnp.zeros(B, dtype=bool)
         zero_v = jnp.zeros(B, dtype=jnp.int32)

@@ -76,10 +76,13 @@ def test_view_matches_copy_oracle_override_rows():
     """Host-override (oracle) rows patch in as side-buffer strings."""
     parser = shared_parser("combined", HEADLINE_FIELDS)
     lines = generate_combined_lines(64, seed=12)
-    # A >18-digit byte count forces the oracle for the line; other
-    # columns of that row become overrides.
+    # A backslash-escaped quote in the user-agent forces the oracle for
+    # the line (device split rejects, host regex accepts); other columns
+    # of that row become overrides.  (>19-digit byte counts stay on
+    # device since the round-9 full-int64 decoder.)
     lines[7] = ('9.9.9.9 - frank [10/Oct/2023:13:55:36 -0700] '
-                '"GET /ov HTTP/1.0" 200 123456789012345678901 "-" "zz"')
+                '"GET /ov HTTP/1.0" 200 123456789012345678901 "-" '
+                '"z \\" z"')
     res = parser.parse_batch(lines)
     assert res.oracle_rows >= 1
     tv = _assert_tables_match(res)

@@ -1,0 +1,184 @@
+"""int64 boundary parity: the widened device decoder vs the oracle.
+
+Round 9 (rescue cliff): the device numeric decoder covers the FULL
+int64 range — every value of up to 19 digits decodes exactly in the
+19-wide limb frame, and longer runs are byte-patched host-side — with
+reference-exact overflow semantics (TokenParser FORMAT_NUMBER has no
+width bound; a value beyond Long range fails Long.parseLong, so the
+LONG cast delivers null and the STRING cast the raw digits, which the
+numeric delivery plan types with int()).  Device output is asserted
+bit-identical to the (codegen) oracle for 18/19/20-digit values, the
+exact Long.MAX/MIN boundary, overflow lines, leading-zero runs and
+negative values, and none of the in-range classes may visit the
+oracle.
+"""
+import pytest
+
+from logparser_tpu.tools.demolog import HEADLINE_FIELDS
+
+from _shared_parsers import shared_parser
+
+LONG_MAX = 2 ** 63 - 1
+LONG_MIN = -(2 ** 63)
+
+BYTES_FID = "BYTES:response.body.bytes"
+
+
+def _line(value: str) -> str:
+    return (
+        '1.2.3.4 - - [10/Oct/2023:13:55:36 -0700] '
+        f'"GET /x HTTP/1.1" 200 {value} "-" "ua"'
+    )
+
+
+def _oracle_value(parser, line):
+    from logparser_tpu.core.exceptions import DissectionFailure
+    from logparser_tpu.tpu.batch import _CollectingRecord
+
+    try:
+        rec = parser.oracle.parse(line, _CollectingRecord())
+    except DissectionFailure:
+        return ("rejected",)
+    v = rec.values.get(BYTES_FID)
+    # The collecting record stores the STRING-cast raw digits; the
+    # batch delivery types numeric-group fields with int() — replay it.
+    return ("ok", int(v) if v is not None else None)
+
+
+BOUNDARY_VALUES = [
+    "0",
+    "1",
+    "999999999999999999",            # 18 digits (the old frame bound)
+    "1000000000000000000",           # 19 digits, smallest
+    "1234567890123456789",
+    str(LONG_MAX - 1),
+    str(LONG_MAX),                   # exactly Long.MAX_VALUE
+    str(LONG_MAX + 1),               # first overflow
+    "9999999999999999999",           # 19 digits, largest (> Long.MAX)
+    "10000000000000000000",          # 20 digits
+    str(10 ** 19 + 12345),
+    "00000000000000000001",          # 20 digits, value 1 (leading zeros)
+    "0" + str(LONG_MAX),             # 20 digits, value == Long.MAX
+    "000000000000000000009999999999999999999",  # long zero-pad, overflow
+    "9" * 40,                        # 40-digit run
+    "-",                             # CLF null
+]
+
+
+class TestInt64BoundaryParity:
+    def test_device_bit_identical_to_oracle(self):
+        parser = shared_parser("combined", HEADLINE_FIELDS)
+        lines = [_line(v) for v in BOUNDARY_VALUES]
+        result = parser.parse_batch(lines)
+        got = result.to_pylist(BYTES_FID)
+        for value, line, g in zip(BOUNDARY_VALUES, lines, got):
+            o = _oracle_value(parser, line)
+            assert o[0] == "ok", f"oracle rejected {value!r}"
+            assert g == o[1], (
+                f"device {g!r} != oracle {o[1]!r} for %b={value!r}"
+            )
+
+    def test_in_range_values_never_visit_oracle(self):
+        parser = shared_parser("combined", HEADLINE_FIELDS)
+        in_range = [v for v in BOUNDARY_VALUES if v != "-"]
+        result = parser.parse_batch([_line(v) for v in in_range])
+        assert result.oracle_rows == 0
+        assert result.rescue_reasons.get("overflow", 0) == 0
+        assert result.rescue_reasons.get("device_reject", 0) == 0
+
+    def test_documented_reference_semantics(self):
+        # The documented contract (see the module docstring): in-range ->
+        # the exact int64; beyond Long.MAX -> int(raw digits) via the
+        # STRING cast (arbitrary precision), never a wrapped/clamped
+        # int64.  Leading zeros follow Long.parseLong (value, not width).
+        parser = shared_parser("combined", HEADLINE_FIELDS)
+        cases = {
+            str(LONG_MAX): LONG_MAX,
+            str(LONG_MAX + 1): LONG_MAX + 1,
+            "00000000000000000001": 1,
+            "9" * 40: int("9" * 40),
+        }
+        result = parser.parse_batch([_line(v) for v in cases])
+        assert result.to_pylist(BYTES_FID) == list(cases.values())
+
+    def test_negative_and_signed_values_match_oracle(self):
+        # The %b token charset is digits-only, so signed values are NOT
+        # regex-matched: the device must reject the line exactly like
+        # the oracle does (no silent sign handling on either side).
+        parser = shared_parser("combined", HEADLINE_FIELDS)
+        for v in ("-5", "-9223372036854775808", "+7"):
+            line = _line(v)
+            result = parser.parse_batch([line])
+            o = _oracle_value(parser, line)
+            if o[0] == "rejected":
+                assert not result.valid[0]
+            else:
+                assert result.to_pylist(BYTES_FID)[0] == o[1]
+
+    def test_long_parse_boundary_semantics(self):
+        # Long.parseLong(): the exact 64-bit window, signs included —
+        # the single source the host LONG cast uses everywhere.
+        from logparser_tpu.core.value import _parse_java_long
+
+        assert _parse_java_long(str(LONG_MAX)) == LONG_MAX
+        assert _parse_java_long(str(LONG_MAX + 1)) is None
+        assert _parse_java_long(str(LONG_MIN)) == LONG_MIN
+        assert _parse_java_long(str(LONG_MIN - 1)) is None
+        assert _parse_java_long("-0") == 0
+
+    def test_nondigit_tail_demotes_to_oracle(self):
+        # >19-digit run whose tail (past the device digit window) is not
+        # numeric: no byte-patch — the line demotes to the oracle and is
+        # rejected there, exactly like the reference regex.
+        parser = shared_parser("combined", HEADLINE_FIELDS)
+        line = _line("1111111111111111111x1")
+        result = parser.parse_batch([line])
+        assert not result.valid[0]
+        assert _oracle_value(parser, line)[0] == "rejected"
+
+    def test_overflow_mixed_batch_parity(self):
+        # The combined_rescue shape: every 20th line carries a 20-digit
+        # %b — full-batch dict parity against the per-line oracle, and
+        # the overflow class stays on device.
+        from logparser_tpu.tools.demolog import generate_combined_lines
+
+        parser = shared_parser("combined", HEADLINE_FIELDS)
+        base = generate_combined_lines(200, seed=47)
+        import re
+
+        lines = [
+            re.sub(r'" (\d{3}) (\d+|-) ', f'" \\1 {10**19 + i} ', ln,
+                   count=1)
+            if i % 20 == 0 else ln
+            for i, ln in enumerate(base)
+        ]
+        result = parser.parse_batch(lines)
+        assert result.oracle_rows == 0
+        got = result.to_pylist(BYTES_FID)
+        for i in range(0, len(lines), 20):
+            assert got[i] == 10 ** 19 + i
+
+
+@pytest.mark.slow
+class TestInt64FormatSweep:
+    def test_nginx_body_bytes_boundary(self):
+        # nginx $body_bytes_sent is strictly numeric; same boundary sweep
+        # through the second dialect's decoder.
+        fmt = (
+            '$remote_addr - $remote_user [$time_local] "$request" '
+            '$status $body_bytes_sent'
+        )
+        parser = shared_parser(
+            fmt, ["IP:connection.client.host", BYTES_FID]
+        )
+        values = [v for v in BOUNDARY_VALUES if v != "-"]
+        lines = [
+            '1.2.3.4 - - [10/Oct/2023:13:55:36 -0700] '
+            f'"GET /x HTTP/1.1" 200 {v}'
+            for v in values
+        ]
+        result = parser.parse_batch(lines)
+        got = result.to_pylist(BYTES_FID)
+        for v, line, g in zip(values, lines, got):
+            o = _oracle_value(parser, line)
+            assert o[0] == "ok" and g == o[1], (v, g, o)

@@ -47,13 +47,15 @@ def test_parse_batch_traces_pipeline_stages():
             ["IP:connection.client.host", "BYTES:response.body.bytes"],
         )
         lines = generate_combined_lines(32, seed=23, garbage_fraction=0.1)
-        # A PLAUSIBLE-but-device-rejected line (20-digit byte count: the
-        # device limb parser caps at 18 digits), so it must visit the
-        # oracle.  (Pure garbage no longer does — the implausible-for-
-        # all-formats filter counts it bad without a per-line re-parse.)
+        # A PLAUSIBLE-but-device-rejected line (backslash-escaped quote
+        # in the user-agent: host regex accepts, device split does not),
+        # so it must visit the oracle.  (Pure garbage no longer does —
+        # the implausible-for-all-formats filter counts it bad without a
+        # per-line re-parse; 20-digit %b counts stay on device since the
+        # round-9 full-int64 decoder.)
         lines[3] = (
             '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] '
-            '"GET /x HTTP/1.1" 200 99999999999999999999 "-" "-"'
+            '"GET /x HTTP/1.1" 200 17 "-" "esc \\" quote"'
         )
         parser.parse_batch(lines)
     finally:

@@ -416,16 +416,34 @@ class TestDefinitelyBadFilter:
         assert result.bad_lines == 3
         assert result.oracle_rows == 0
 
-    def test_plausible_reject_still_visits_oracle(self):
+    def test_long_overflow_decodes_without_oracle(self):
+        # Round 9: the full-int64 decoder keeps >19-digit runs on the
+        # device path (reference FORMAT_NUMBER has no width bound); the
+        # exact value is byte-patched host-side — NO oracle visit.
         batch = shared_parser("combined", FIELDS)
         lines = [
-            # 20-digit bytes: device limb cap rejects, oracle accepts.
             '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /x HTTP/1.1" '
             '200 99999999999999999999 "-" "-"',
         ]
         result = batch.parse_batch(lines)
-        assert result.oracle_rows == 1
+        assert result.oracle_rows == 0
         assert result.valid[0]
+        assert result.to_pylist("BYTES:response.body.bytes") == [
+            99999999999999999999
+        ]
+
+    def test_nondigit_overflow_tail_still_visits_oracle(self):
+        # A >19-digit run whose tail (beyond the device's 19-byte digit
+        # window) is NOT all digits cannot be byte-patched: the line is
+        # demoted to the oracle, which rejects it like the reference.
+        batch = shared_parser("combined", FIELDS)
+        lines = [
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET /x HTTP/1.1" '
+            '200 9999999999999999999x9 "-" "-"',
+        ]
+        result = batch.parse_batch(lines)
+        assert result.oracle_rows == 1
+        assert not result.valid[0]
 
     def test_overflow_lines_always_oracle(self):
         # Truncated lines: the device's plausibility verdict covers only
@@ -657,10 +675,14 @@ class TestParseBlob:
 
         parser = self._parser()
         lines = generate_combined_lines(32, seed=32)
-        # >18-digit %b: plausible but device-rejected -> oracle rescue
-        # must materialize THAT line from the blob.
+        # >19-digit %b: decoded on the device path (round 9), with the
+        # exact value byte-patched from the LAZY blob row — the patch
+        # must materialize THAT line's span from the blob buffer.
         lines[9] = ('9.9.9.9 - x [10/Oct/2023:13:55:36 -0700] '
                     '"GET /r HTTP/1.0" 200 123456789012345678901 "-" "u"')
+        # A garbage-but-plausible row keeps the lazy-rescue path covered.
+        lines[11] = ('8.8.8.8 - - [10/Oct/2023:13:55:36 -0700] '
+                     '"GET /broken HTTP/1.1" 200 oops "-" "u"')
         blob = "\n".join(lines).encode("utf-8")
         res = parser.parse_blob(blob)
         assert res.oracle_rows >= 1
