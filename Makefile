@@ -25,10 +25,13 @@ test-fast:
 metrics-smoke:
 	$(PY) -m logparser_tpu.tools.metrics_smoke
 
-# Feeder smoke: the sharded ingest fabric (2 workers x 2 shard sizes over
-# a demolog corpus) must be byte- and parse-parity-identical to
-# single-process parse_blob, with the feeder_* metric families exposed
-# (docs/FEEDER.md).  CI runs this after metrics-smoke.
+# Feeder smoke: the sharded ingest fabric (2 workers x 2 shard sizes x
+# 2 transports — zero-copy shared-memory ring AND the pickled escape
+# hatch — over a demolog corpus) must be byte- and parse-parity-
+# identical to single-process parse_blob, with the feeder_* metric
+# families (ring counters included) exposed and zero leaked /dev/shm
+# segments after pool teardown (docs/FEEDER.md).  CI runs this after
+# metrics-smoke.
 feeder-smoke:
 	$(PY) -m logparser_tpu.tools.feeder_smoke
 

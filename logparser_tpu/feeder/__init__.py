@@ -9,15 +9,30 @@ device-ready batches so the device tier is never input-starved
   semantics: a line belongs to the shard where it starts);
 - :mod:`~logparser_tpu.feeder.worker` — the jax-free worker loop that
   reads + frames shards with the ``parse_blob`` framing;
+- :mod:`~logparser_tpu.feeder.ring` — the zero-copy shared-memory slot
+  transport (per-worker arenas, descriptor queues, slot-exhaustion
+  backpressure);
 - :mod:`~logparser_tpu.feeder.pool` — :class:`FeederPool`, the consumer
   API: ``batches()`` (ordered EncodedBatch stream with backpressure)
   and ``feed(parser)`` (BatchResults via ``parse_batch_stream``).
 """
 from .pool import (  # noqa: F401
     DEFAULT_BATCH_LINES,
+    PICKLE_ENV,
     FeederError,
     FeederPool,
     default_feeder_workers,
+    resolve_transport,
+)
+from .ring import (  # noqa: F401
+    RING_NAME_PREFIX,
+    RingBatch,
+    SlotFrame,
+    SlotOverflow,
+    SlotRing,
+    SlotWriter,
+    ring_available,
+    slot_layout,
 )
 from .shards import (  # noqa: F401
     DEFAULT_SHARD_BYTES,

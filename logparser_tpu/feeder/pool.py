@@ -8,25 +8,48 @@ in-memory blobs) into a steady, ORDERED stream of framed
   newline-boundary healing (``feeder/shards.py`` — the reference's
   InputFormat split semantics);
 - N workers (processes by default, threads as fallback or on request)
-  read + frame their shards with the ``parse_blob`` framing and push
-  into per-worker BOUNDED queues — a full queue blocks its worker, so
-  the consumer's drain rate backpressures the whole fabric;
+  read + frame their shards with the ``parse_blob`` framing and ship
+  them over one of two TRANSPORTS:
+
+  * ``"ring"`` (process default): each worker frames directly into a
+    per-worker shared-memory slot arena (``feeder/ring.py``) and the
+    queue carries only small slot descriptors — zero-copy bodies, with
+    slot exhaustion as the backpressure signal;
+  * ``"pickle"`` (escape hatch ``LOGPARSER_TPU_FEEDER_PICKLE=1``, or
+    the fallback when shared memory is unavailable): whole batches
+    pickle through BOUNDED per-worker queues — a full queue blocks its
+    worker.  Thread workers default to the direct in-process hand-off
+    (``"inline"``; nothing to serialize), but accept ``transport=
+    "ring"`` explicitly (the ring mechanics are address-space agnostic
+    — tests exercise wraparound/exhaustion without process spawns);
+
 - the consumer drains shards in global order (shard i lives in worker
   ``i % N``'s queue), so delivery order equals single-process
   ``parse_blob`` order with no reorder buffer and no deadlock: each
   queue has exactly one producer and one consumer.
 
-``feed(parser)`` pipes the stream through
-``TpuBatchParser.parse_batch_stream`` (which adopts pre-encoded batches
-without re-framing), yielding one BatchResult per batch in corpus order.
+``batches()`` DETACHES ring batches by default (owned copies, slot
+released immediately) so callers may hold arbitrarily many; pass
+``detach=False`` to receive zero-copy :class:`~logparser_tpu.feeder.
+ring.RingBatch` views and call ``release()`` yourself.  ``feed(parser)``
+pipes the zero-copy stream through ``TpuBatchParser.parse_batch_stream``
+(which adopts pre-encoded batches without re-framing, stages the next
+batch's H2D upload while the current one computes, and releases each
+slot after the batch materializes), yielding one BatchResult per batch
+in corpus order.
 
 Telemetry (the PR-2 metrics registry, docs/OBSERVABILITY.md):
 ``feeder_bytes_read_total``, ``feeder_lines_total``,
 ``feeder_batches_total``, ``feeder_shards_total`` counters; the
-``feeder_queue_depth`` gauge (producer-updated in threads mode, sampled
-at every dequeue otherwise); ``feeder_starvation_seconds_total`` (wall
+``feeder_queue_depth`` gauge (producer-updated in threads mode, shared
+put-counters minus consumer gets in process mode — live on every
+platform, qsize-less or not); ``feeder_starvation_seconds_total`` (wall
 time the consumer spent blocked on an empty queue — the "is the chip
-starving" number); per-shard/per-batch stage timings via
+starving" number); ring counters ``feeder_ring_slot_wait_seconds_total``
+(worker backpressure wait, shipped in descriptors),
+``feeder_ring_bytes_inplace_total`` (bytes that crossed via the arena
+instead of a pipe) and ``feeder_ring_pickle_fallback_total`` (slot-
+overflow batches); per-shard/per-batch stage timings via
 ``observe_stage`` (``feeder_read``, ``feeder_encode``,
 ``feeder_shard``).
 """
@@ -48,9 +71,9 @@ from .shards import (
 )
 from .worker import (
     MSG_BATCH,
-    MSG_DONE,
     MSG_ERROR,
     MSG_SHARD_DONE,
+    MSG_SLOT,
     EncodedBatch,
     make_instrumented_queue,
     run_worker,
@@ -61,6 +84,10 @@ import logging
 LOG = logging.getLogger(__name__)
 
 DEFAULT_BATCH_LINES = 16384
+
+#: Escape hatch: force the pickled transport everywhere (parity suite
+#: asserts both transports byte-identical; this is the rollback lever).
+PICKLE_ENV = "LOGPARSER_TPU_FEEDER_PICKLE"
 
 
 class FeederError(RuntimeError):
@@ -73,6 +100,29 @@ def default_feeder_workers() -> int:
     return max(1, min(8, os.cpu_count() or 1))
 
 
+def resolve_transport(requested: Optional[str], mode: str) -> str:
+    """The transport a (request, worker-mode) pair actually runs:
+    ``LOGPARSER_TPU_FEEDER_PICKLE=1`` wins over everything (the
+    emergency rollback must not be overridable per call site); explicit
+    requests are honored next; process pools default to ``ring``
+    (falling back to ``pickle`` when shared memory is unavailable) and
+    thread pools to the direct ``inline`` hand-off."""
+    from ..observability import _env_truthy
+    from .ring import ring_available
+
+    if _env_truthy(PICKLE_ENV):
+        return "pickle" if mode == "process" else "inline"
+    if requested:
+        if requested not in ("ring", "pickle", "inline"):
+            raise ValueError(f"unknown feeder transport {requested!r}")
+        if requested == "ring" and not ring_available():
+            return "pickle" if mode == "process" else "inline"
+        return requested
+    if mode == "process":
+        return "ring" if ring_available() else "pickle"
+    return "inline"
+
+
 class FeederPool:
     """See module docstring.  Parameters:
 
@@ -83,8 +133,15 @@ class FeederPool:
     - ``batch_lines``: lines per emitted batch (the device batch size).
     - ``line_len``: pin the framed ``L`` (0 = per-batch length bucket,
       exactly ``parse_blob``'s default).
-    - ``queue_batches``: per-worker queue bound — the backpressure
-      window, in batches.
+    - ``queue_batches``: the backpressure window, in batches — the
+      per-worker queue bound (pickle/inline) and the default ring slot
+      count basis (``queue_batches + 2`` slots: the extra two cover the
+      batch on device and the one materializing).
+    - ``transport``: ``"ring"`` / ``"pickle"`` / ``"inline"`` / None
+      (auto — see :func:`resolve_transport`).
+    - ``ring_slots`` / ``slot_bytes``: ring geometry overrides (slots
+      per worker arena; bytes per slot, default sized for
+      ``batch_lines`` lines of generous length).
     - ``use_processes``: True/False forces the worker flavor; None
       prefers processes and falls back to threads when multiprocessing
       is unavailable.  Processes default to the ``forkserver`` context
@@ -103,6 +160,9 @@ class FeederPool:
         batch_lines: int = DEFAULT_BATCH_LINES,
         line_len: int = 0,
         queue_batches: int = 4,
+        transport: Optional[str] = None,
+        ring_slots: Optional[int] = None,
+        slot_bytes: Optional[int] = None,
         use_processes: Optional[bool] = None,
         mp_context: Optional[str] = None,
         worker_delay_s: float = 0.0,
@@ -116,12 +176,29 @@ class FeederPool:
         self.batch_lines = int(batch_lines)
         self.line_len = int(line_len)
         self.queue_batches = max(1, int(queue_batches))
+        self._requested_transport = transport
+        self.ring_slots = (
+            max(2, int(ring_slots)) if ring_slots
+            else self.queue_batches + 2
+        )
+        # Default slot: room for batch_lines lines at a generous L plus
+        # the raw payload — a batch that still doesn't fit (pathological
+        # line bucket) ships pickled, so this is a fast path size, not a
+        # correctness bound.
+        self.slot_bytes = (
+            int(slot_bytes) if slot_bytes
+            else max(1 << 20, self.batch_lines * 768)
+        )
         self._use_processes = use_processes
         self._mp_context = mp_context
         self._worker_delay_s = float(worker_delay_s)
         self.mode: Optional[str] = None  # "process" | "thread" once started
+        self.transport: Optional[str] = None  # resolved at start
         self._queues: List[Any] = []
         self._procs: List[Any] = []
+        self._rings: List[Any] = []
+        self._puts: List[Any] = []      # shared put-counters (process mode)
+        self._gets: List[int] = []      # local get-counters (process mode)
         self._stop: Any = None
         self._started = False
         self._closed = False
@@ -138,6 +215,9 @@ class FeederPool:
             "wall_s": 0.0,
             "queue_depth_max": 0,
             "queue_depth_mean": 0.0,
+            "slot_wait_s": 0.0,
+            "bytes_inplace": 0,
+            "pickle_fallback_batches": 0,
         }
         self._depth_samples = 0
         self._depth_sum = 0
@@ -158,12 +238,28 @@ class FeederPool:
             except Exception as e:  # noqa: BLE001 — environment-dependent
                 if self._use_processes:
                     raise
+                self._abort_process_start()
                 log_warning_once(
                     LOG,
                     "feeder: multiprocessing unavailable "
                     f"({type(e).__name__}); falling back to threads",
                 )
         self._start_threads(shards_of)
+
+    def _abort_process_start(self) -> None:
+        """Roll back a half-built process start before the thread
+        fallback: unlink any arenas already created (they would
+        otherwise sit in /dev/shm until interpreter exit) and clear the
+        process-mode depth counters (stale ``_puts`` would make
+        ``_queue_depth`` read 0 for the whole thread-mode run)."""
+        for r in self._rings:
+            r.close()
+        self._rings = []
+        self._puts = []
+        self._gets = []
+        self._queues = []
+        self._procs = []
+        self.transport = None
 
     def _worker_plan(self, shards: List[Shard]):
         """(sources, shards) restricted to what ONE worker touches: its
@@ -180,6 +276,24 @@ class FeederPool:
             [replace(s, source=remap[s.source]) for s in shards],
         )
 
+    def _build_rings(self, queue_factory) -> List[Any]:
+        """One arena per worker, free queues seeded; ``queue_factory``
+        makes the free queues (ctx.Queue or queue.Queue)."""
+        from .ring import SlotRing
+
+        rings = []
+        try:
+            for w in range(self.workers):
+                rings.append(SlotRing(
+                    self.slot_bytes, self.ring_slots, queue_factory(),
+                    name_hint=f"{os.getpid()}_{w}",
+                ))
+        except Exception:
+            for r in rings:
+                r.close()
+            raise
+        return rings
+
     def _start_processes(self, shards_of) -> None:
         import multiprocessing as mp
 
@@ -189,9 +303,30 @@ class FeederPool:
                       if "forkserver" in mp.get_all_start_methods()
                       else "spawn")
         ctx = mp.get_context(method)
+        self.transport = resolve_transport(self._requested_transport,
+                                           "process")
         self._stop = ctx.Event()
-        self._queues = [ctx.Queue(maxsize=self.queue_batches)
+        if self.transport == "ring":
+            try:
+                self._rings = self._build_rings(ctx.Queue)
+            except Exception as e:  # noqa: BLE001 — no /dev/shm etc.
+                log_warning_once(
+                    LOG,
+                    "feeder: shared-memory ring unavailable "
+                    f"({type(e).__name__}); falling back to pickle",
+                )
+                self.transport = "pickle"
+        # Queue bound by transport: for pickle it IS the backpressure —
+        # exactly the documented queue_batches window.  For the ring,
+        # slot exhaustion backpressures and the queue only carries small
+        # descriptors (at most one per leased slot) plus control
+        # messages — sized to never stall a slot-holding worker.
+        q_bound = (self.ring_slots + 2 if self.transport == "ring"
+                   else self.queue_batches)
+        self._queues = [ctx.Queue(maxsize=q_bound)
                         for _ in range(self.workers)]
+        self._puts = [ctx.Value("l", 0) for _ in range(self.workers)]
+        self._gets = [0] * self.workers
         procs = []
         try:
             for w in range(self.workers):
@@ -200,7 +335,9 @@ class FeederPool:
                     target=run_worker,
                     args=(w, w_sources, w_shards, self._queues[w],
                           self.batch_lines, self.line_len, self._stop,
-                          self._worker_delay_s),
+                          self._worker_delay_s,
+                          self._rings[w].spec() if self._rings else None,
+                          self._puts[w], True),
                     name=f"logparser-tpu-feeder-{w}",
                     daemon=True,
                 )
@@ -215,7 +352,29 @@ class FeederPool:
 
     def _start_threads(self, shards_of) -> None:
         self._stop = threading.Event()
-        raw = [_queue.Queue(maxsize=self.queue_batches)
+        self.transport = resolve_transport(self._requested_transport,
+                                           "thread")
+        writers: List[Any] = [None] * self.workers
+        if self.transport == "ring":
+            try:
+                self._rings = self._build_rings(_queue.Queue)
+                from .ring import SlotWriter
+
+                writers = [SlotWriter(r.spec(), shm=r.shm)
+                           for r in self._rings]
+            except Exception as e:  # noqa: BLE001
+                log_warning_once(
+                    LOG,
+                    "feeder: shared-memory ring unavailable "
+                    f"({type(e).__name__}); falling back to inline",
+                )
+                self.transport = "inline"
+        # Same bound rule as process mode: a thread-ring worker must
+        # never stall on the descriptor queue while holding a slot
+        # (slot exhaustion is the backpressure there, not the queue).
+        q_bound = (self.ring_slots + 2 if self.transport == "ring"
+                   else self.queue_batches)
+        raw = [_queue.Queue(maxsize=q_bound)
                for _ in range(self.workers)]
         # Producer-side gauge updates: only possible in-process.
         self._queues = raw
@@ -229,7 +388,7 @@ class FeederPool:
                 target=run_worker,
                 args=(w, w_sources, w_shards, instrumented[w],
                       self.batch_lines, self.line_len, self._stop,
-                      self._worker_delay_s),
+                      self._worker_delay_s, writers[w], None),
                 name=f"logparser-tpu-feeder-{w}",
                 daemon=True,
             )
@@ -238,8 +397,8 @@ class FeederPool:
         self.mode = "thread"
 
     def close(self) -> None:
-        """Stop workers and drop queues.  Idempotent; also runs on
-        normal exhaustion of :meth:`batches`."""
+        """Stop workers, drop queues, unlink ring arenas.  Idempotent;
+        also runs on normal exhaustion of :meth:`batches`."""
         if self._closed:
             return
         self._closed = True
@@ -264,6 +423,8 @@ class FeederPool:
             # cancelled; plain queue.Queue has no such method.
             if hasattr(q, "cancel_join_thread"):
                 q.cancel_join_thread()
+        for r in self._rings:
+            r.close()
         metrics().gauge_set("feeder_queue_depth", 0)
 
     def __enter__(self) -> "FeederPool":
@@ -275,6 +436,14 @@ class FeederPool:
     # -- metrics helpers -------------------------------------------------
 
     def _queue_depth(self) -> int:
+        if self._puts:
+            # Process mode: shared put-counters minus this consumer's get
+            # counts — live on every platform (macOS mp queues have no
+            # qsize) and unaffected by pipe buffering.
+            total = 0
+            for w in range(self.workers):
+                total += max(0, self._puts[w].value - self._gets[w])
+            return total
         total = 0
         for q in self._queues:
             try:
@@ -308,7 +477,7 @@ class FeederPool:
         t_enter = time.perf_counter()
         blocked = 0.0  # time spent in Empty waits only — a successful
         # get's own duration (pipe read + unpickling of a multi-MB
-        # batch in process mode) is transfer, not starvation.
+        # batch in pickle mode) is transfer, not starvation.
         while True:
             t0 = time.perf_counter()
             try:
@@ -330,6 +499,8 @@ class FeederPool:
                             f"feeder worker {worker} exited without "
                             "completing its shards"
                         ) from None
+        if self._gets:
+            self._gets[worker] += 1
         if not self._primed:
             # Pipeline fill — worker start, first read/frame AND the
             # first item's queue transfer — is startup latency, not
@@ -344,10 +515,18 @@ class FeederPool:
         self._sample_depth()
         return msg
 
-    def batches(self) -> Iterator[EncodedBatch]:
+    def batches(self, detach: bool = True) -> Iterator[EncodedBatch]:
         """The ordered batch stream (single use).  Yields every framed
         batch of every shard, in global shard order, then joins the
-        workers and closes the pool."""
+        workers and closes the pool.
+
+        ``detach=True`` (default): ring batches are converted to owned
+        copies and their slots released immediately — hold as many as
+        you like.  ``detach=False``: ring batches arrive as ZERO-COPY
+        slot views; the caller must ``release()`` each one (or the ring
+        exhausts and the producers block) and must not touch a batch
+        after releasing it.  ``feed()`` uses the zero-copy flavor with
+        ``parse_batch_stream`` handling the releases."""
         self._start()
         reg = metrics()
         t_start = time.perf_counter()
@@ -358,26 +537,31 @@ class FeederPool:
                 while True:
                     msg = self._get(q, worker)
                     kind = msg[0]
-                    if kind == MSG_BATCH:
-                        eb: EncodedBatch = msg[1]
-                        assert eb.shard == shard.index, (
-                            f"feeder ordering violated: got shard "
-                            f"{eb.shard}, expected {shard.index}"
-                        )
-                        self._stats["batches"] += 1
-                        self._stats["lines"] += eb.n_lines
-                        self._stats["payload_bytes"] += eb.source_bytes
-                        self._stats["read_s"] += eb.read_s
-                        self._stats["encode_s"] += eb.encode_s
-                        reg.increment("feeder_bytes_read_total",
-                                      eb.source_bytes)
-                        reg.increment("feeder_lines_total", eb.n_lines)
-                        reg.increment("feeder_batches_total")
-                        observe_stage("feeder_read", eb.read_s,
-                                      items=eb.n_lines)
-                        observe_stage("feeder_encode", eb.encode_s,
-                                      items=eb.n_lines)
-                        yield eb
+                    if kind == MSG_SLOT:
+                        desc = msg[1]
+                        ring = self._rings[worker]
+                        reg.increment("feeder_ring_slot_wait_seconds_total",
+                                      desc.slot_wait_s)
+                        inplace = ring.inplace_bytes(desc)
+                        reg.increment("feeder_ring_bytes_inplace_total",
+                                      inplace)
+                        self._stats["slot_wait_s"] += desc.slot_wait_s
+                        self._stats["bytes_inplace"] += inplace
+                        eb: EncodedBatch = ring.map(desc)
+                    elif kind == MSG_BATCH:
+                        eb = msg[1]
+                        if self.transport == "ring":
+                            # Slot-overflow fallback batch (counted, not
+                            # fatal: the ring degrades per batch).  Its
+                            # slot-acquire wait still happened — keep the
+                            # backpressure signal honest under overflow.
+                            self._stats["pickle_fallback_batches"] += 1
+                            reg.increment("feeder_ring_pickle_fallback_total")
+                            self._stats["slot_wait_s"] += eb.slot_wait_s
+                            reg.increment(
+                                "feeder_ring_slot_wait_seconds_total",
+                                eb.slot_wait_s,
+                            )
                     elif kind == MSG_SHARD_DONE:
                         _, sidx, wall_s, n_lines, _nbytes = msg
                         assert sidx == shard.index
@@ -393,6 +577,24 @@ class FeederPool:
                             f"feeder protocol violation: {kind!r} before "
                             f"shard {shard.index} completed"
                         )
+                    assert eb.shard == shard.index, (
+                        f"feeder ordering violated: got shard "
+                        f"{eb.shard}, expected {shard.index}"
+                    )
+                    self._stats["batches"] += 1
+                    self._stats["lines"] += eb.n_lines
+                    self._stats["payload_bytes"] += eb.source_bytes
+                    self._stats["read_s"] += eb.read_s
+                    self._stats["encode_s"] += eb.encode_s
+                    reg.increment("feeder_bytes_read_total",
+                                  eb.source_bytes)
+                    reg.increment("feeder_lines_total", eb.n_lines)
+                    reg.increment("feeder_batches_total")
+                    observe_stage("feeder_read", eb.read_s,
+                                  items=eb.n_lines)
+                    observe_stage("feeder_encode", eb.encode_s,
+                                  items=eb.n_lines)
+                    yield eb.detach() if detach else eb
         finally:
             self._stats["wall_s"] = time.perf_counter() - t_start
             if self._depth_samples:
@@ -405,9 +607,13 @@ class FeederPool:
         """Drive ``parser`` (a TpuBatchParser) over the batch stream:
         yields one BatchResult per batch, in corpus order, with the
         host-side stages of batch k overlapping the device work of batch
-        k+1 (``parse_batch_stream`` semantics)."""
+        k+1 (``parse_batch_stream`` semantics).  Ring batches flow
+        through ZERO-COPY: the stream stages each batch's H2D upload
+        straight from (a bucket-padded adoption of) the slot frame and
+        releases the slot once the batch materializes — after device
+        upload and rescue-payload use."""
         return parser.parse_batch_stream(
-            self.batches(), depth=depth, emit_views=emit_views
+            self.batches(detach=False), depth=depth, emit_views=emit_views
         )
 
     def stats(self) -> Dict[str, Any]:
@@ -415,13 +621,21 @@ class FeederPool:
         starvation fraction are computed over the STEADY window (wall
         minus pipeline-fill startup): the one-time worker start + first
         read/frame latency is reported as ``startup_s`` instead of
-        polluting the sustained numbers."""
+        polluting the sustained numbers.  ``slot_wait_fraction`` is the
+        ring backpressure share: total worker slot-wait over the steady
+        window summed across workers (1.0 = every worker blocked the
+        whole time = the consumer is the bottleneck)."""
         out = dict(self._stats)
         out["mode"] = self.mode
+        out["transport"] = self.transport
+        out["ring_slots"] = self.ring_slots
         steady = out["wall_s"] - out["startup_s"]
         if steady > 0:
             out["bytes_per_sec"] = round(out["payload_bytes"] / steady, 1)
             out["starvation_fraction"] = round(
                 out["starvation_s"] / steady, 4
+            )
+            out["slot_wait_fraction"] = round(
+                out["slot_wait_s"] / (steady * max(1, self.workers)), 4
             )
         return out

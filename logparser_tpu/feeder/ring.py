@@ -1,0 +1,372 @@
+"""Shared-memory ring transport: the zero-copy worker->consumer lane.
+
+The pickled transport (PR 3) serializes every ``EncodedBatch`` crossing
+a worker->consumer queue: the frame arrays and the retained rescue
+payload are pickled, copied through the pipe in 64 KiB chunks, and
+unpickled again — two-plus full memcpy passes (one of them with the
+consumer's GIL held) per batch before the device sees a byte.  This
+module replaces the payload lane with a per-worker
+``multiprocessing.shared_memory`` arena carved into fixed slots:
+
+- the worker ACQUIRES a free slot id from its ``free_q`` (an empty free
+  queue blocks the worker — slot exhaustion IS the backpressure signal
+  the bounded queues provide in pickle mode);
+- it frames the batch DIRECTLY into slot-backed numpy views (the exact
+  ``parse_blob`` framing via :func:`logparser_tpu.native.encode_blob`'s
+  ``alloc`` hook) and memcpys the raw payload bytes beside it (kept for
+  lazy oracle rescue, same contract as the pickled transport);
+- the descriptor queue carries only a tiny :class:`SlotFrame` (slot id,
+  shapes, sequence, timings) — the multi-MB batch body never touches a
+  pipe;
+- the consumer MAPS the slot zero-copy (``np.frombuffer`` views over
+  the arena) into a :class:`RingBatch` and RELEASES the slot id back to
+  ``free_q`` once the batch is done with it (after device upload and
+  rescue-payload use — ``parse_batch_stream`` releases post-
+  materialization; ``FeederPool.batches()`` detaches by default).
+
+Slot layout (``slot_bytes``-aligned offsets, 8-byte slot alignment so
+the int32 lengths view is aligned)::
+
+    [0 .. 4*B)                lengths  int32[B]
+    [align8(4*B) .. +B*L)     buf      uint8[B, L]
+    [.. +payload_len)         payload  raw line bytes (with '\\n's)
+
+A batch whose framed size exceeds ``slot_bytes`` (a pathological line
+bucket) falls back to the pickled lane for that one batch — the ring
+degrades per batch, never wholesale.
+
+Cleanup: the consumer process CREATES the arenas and the resource
+tracker holds their registrations, so a crashed consumer still gets
+its segments unlinked.  Workers only attach — pre-3.13 that registers
+with the tracker too, but forkserver/spawn children SHARE the parent's
+tracker process, so the attach-side registration dedupes into the one
+the consumer already holds (no premature unlink, no double-unregister;
+see ``SlotWriter.__init__``) and the single unlink on pool close — or
+on consumer crash, via the tracker — clears it.  Orphaned workers
+(consumer SIGKILLed) self-terminate via the parent-death watch in
+``run_worker``, so nothing pins the tracker pipe open.  The module is
+jax-free and import-cheap (worker processes load it).
+"""
+from __future__ import annotations
+
+import secrets
+import time
+from dataclasses import dataclass, field
+from queue import Empty
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from .worker import EncodedBatch
+
+#: Slot alignment: keeps every slot's int32 lengths view 4-byte aligned
+#: (and leaves room for wider frame dtypes later).
+SLOT_ALIGN = 8
+
+#: /dev/shm segment name prefix — the leak checks in feeder_smoke and
+#: tests key on it.
+RING_NAME_PREFIX = "lpring"
+
+
+def ring_available() -> bool:
+    """Can this platform back a shared-memory ring at all?"""
+    try:
+        from multiprocessing import shared_memory  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _shared_memory_cls():
+    """SharedMemory with a close() tolerant of live exported views.
+
+    The stream's tail batches materialize AFTER the pool (and its
+    arenas) close — their payload views legitimately outlive close(),
+    which makes ``mmap.close()`` raise BufferError both at close time
+    and again from ``SharedMemory.__del__`` at GC.  The segment is
+    still unlinked either way (names never leak); the mapping itself
+    dies with the last view, so swallowing the BufferError is correct,
+    not a leak."""
+    from multiprocessing import shared_memory
+
+    class _QuietSharedMemory(shared_memory.SharedMemory):
+        def close(self) -> None:
+            try:
+                super().close()
+            except BufferError:
+                pass
+
+    return _QuietSharedMemory
+
+
+class SlotOverflow(Exception):
+    """The framed batch does not fit one slot (fall back to pickle)."""
+
+
+def slot_layout(n: int, line_len: int, payload_len: int) -> Tuple[int, int, int]:
+    """(buf_offset, payload_offset, total_bytes) of one framed batch
+    inside its slot — the single layout definition writer and reader
+    share."""
+    lengths_bytes = 4 * max(n, 1)
+    buf_off = -(-lengths_bytes // SLOT_ALIGN) * SLOT_ALIGN
+    payload_off = buf_off + max(n, 1) * line_len
+    return buf_off, payload_off, payload_off + payload_len
+
+
+@dataclass
+class SlotFrame:
+    """The descriptor that crosses the queue instead of the batch body.
+    Everything here is a handful of ints/floats — pickling it is noise."""
+
+    shard: int                  # global shard index
+    index: int                  # batch index within the shard
+    slot: int                   # slot id inside the worker's arena
+    n_lines: int
+    line_len: int               # framed L (buf is [n_lines, line_len])
+    payload_len: int
+    overflow: List[int] = field(default_factory=list)
+    read_s: float = 0.0
+    encode_s: float = 0.0
+    slot_wait_s: float = 0.0    # time the worker blocked acquiring the slot
+
+
+@dataclass
+class RingSpec:
+    """Picklable handle a worker needs to attach one arena: segment
+    name, geometry, and the free-slot queue (ForkingPickler ships
+    mp.Queue through Process args)."""
+
+    name: str
+    slot_bytes: int
+    n_slots: int
+    free_q: Any
+
+
+@dataclass
+class RingBatch(EncodedBatch):
+    """An EncodedBatch whose payload/buf/lengths are zero-copy views
+    into a ring slot.  The slot stays leased to this batch until
+    :meth:`release` — ``parse_batch_stream`` releases after the batch's
+    materialization (device upload done, rescue payload consumed);
+    :meth:`detach` converts to an owned plain batch and releases
+    immediately (the ``FeederPool.batches()`` default)."""
+
+    ring: Any = None            # consumer-side SlotRing
+    slot: int = -1
+    released: bool = False
+
+    def release(self) -> None:
+        if self.ring is not None and not self.released:
+            self.released = True
+            self.ring.release(self.slot)
+
+    def detach(self) -> EncodedBatch:
+        eb = EncodedBatch(
+            shard=self.shard,
+            index=self.index,
+            payload=bytes(self.payload),
+            buf=np.array(self.buf, copy=True),
+            lengths=np.array(self.lengths, copy=True),
+            overflow=list(self.overflow),
+            n_lines=self.n_lines,
+            read_s=self.read_s,
+            encode_s=self.encode_s,
+        )
+        eb.slot_wait_s = self.slot_wait_s
+        self.release()
+        return eb
+
+
+class SlotWriter:
+    """Worker-side arena access: acquire a slot, frame into it.
+
+    In process mode the worker attaches by name from a :class:`RingSpec`
+    (and drops its attach-side resource_tracker registration, see module
+    docstring); in thread-ring mode the pool passes its own ``shm`` so
+    all threads share one mapping."""
+
+    def __init__(self, spec: RingSpec, shm: Any = None):
+        self.spec = spec
+        self._owns_attach = shm is None
+        if shm is None:
+            # Attaching registers with the resource tracker too (pre-3.13
+            # has no track=False) — harmless here: forkserver/spawn
+            # children share the PARENT's tracker process, so the
+            # registration dedupes into the one the creating consumer
+            # already holds, and the single unlink on pool close (or on
+            # consumer crash, via the tracker) clears it.
+            shm = _shared_memory_cls()(name=spec.name)
+        self.shm = shm
+
+    def acquire(self, stop_event) -> Optional[Tuple[int, float]]:
+        """Next free slot id, blocking until one is released (the
+        backpressure wait) — ``(slot, waited_seconds)``, or None when
+        ``stop_event`` fired first."""
+        t0 = time.perf_counter()
+        while True:
+            if stop_event.is_set():
+                return None
+            try:
+                slot = self.spec.free_q.get(timeout=0.1)
+                return int(slot), time.perf_counter() - t0
+            except Empty:
+                continue
+
+    def putback(self, slot: int) -> None:
+        """Return an acquired-but-unused slot (overflow/stop paths)."""
+        self.spec.free_q.put(slot)
+
+    def frame(self, chunk, line_len: int, slot: int):
+        """Frame ``chunk`` (one batch's raw line bytes) directly into
+        ``slot``: encode_blob packs the [B, L] buffer and lengths into
+        slot-backed views, the payload is memcpy'd beside them.  Returns
+        ``(n_lines, L, overflow)``; raises :class:`SlotOverflow` when
+        the framed batch cannot fit the slot."""
+        from ..native import encode_blob
+
+        base = slot * self.spec.slot_bytes
+        mv = self.shm.buf
+        carved: List[int] = []
+
+        def alloc(n: int, L: int):
+            buf_off, payload_off, total = slot_layout(n, L, len(chunk))
+            if total > self.spec.slot_bytes:
+                raise SlotOverflow(
+                    f"batch needs {total}B > slot_bytes={self.spec.slot_bytes}"
+                )
+            carved[:] = [payload_off]
+            lengths = np.frombuffer(mv, dtype=np.int32, count=n, offset=base)
+            buf = np.frombuffer(
+                mv, dtype=np.uint8, count=n * L, offset=base + buf_off
+            ).reshape(n, L)
+            return buf, lengths
+
+        buf, lengths, overflow = encode_blob(
+            chunk, line_len=line_len, alloc=alloc
+        )
+        (payload_off,) = carved
+        if len(chunk):
+            dst = np.frombuffer(
+                mv, dtype=np.uint8, count=len(chunk), offset=base + payload_off
+            )
+            dst[:] = np.frombuffer(chunk, dtype=np.uint8)
+        return int(buf.shape[0]), int(buf.shape[1]), list(overflow)
+
+    def close(self) -> None:
+        if self._owns_attach:
+            try:
+                self.shm.close()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+
+
+class SlotRing:
+    """Consumer-side owner of one worker's arena: creates the segment,
+    seeds the free queue, maps descriptors into :class:`RingBatch`
+    views, recycles released slots, and unlinks on close."""
+
+    def __init__(self, slot_bytes: int, n_slots: int, free_q: Any,
+                 name_hint: str = ""):
+        shm_cls = _shared_memory_cls()
+        if slot_bytes % SLOT_ALIGN:
+            slot_bytes += SLOT_ALIGN - slot_bytes % SLOT_ALIGN
+        self.slot_bytes = int(slot_bytes)
+        self.n_slots = int(n_slots)
+        self.free_q = free_q
+        shm = None
+        for _ in range(8):
+            name = (f"{RING_NAME_PREFIX}_{name_hint}_"
+                    f"{secrets.token_hex(4)}").strip("_")
+            try:
+                shm = shm_cls(
+                    name=name, create=True, size=self.slot_bytes * self.n_slots
+                )
+                break
+            except FileExistsError:  # pragma: no cover — 32-bit token race
+                continue
+        if shm is None:  # pragma: no cover
+            raise RuntimeError("could not allocate a uniquely-named arena")
+        self.shm = shm
+        # Pre-fault the whole arena once at create time: tmpfs pages are
+        # allocated HERE (startup, outside any measured steady window)
+        # instead of as major faults inside the workers' first framing
+        # passes — the difference between a warm ring and one that pays
+        # page-allocation latency for its first n_slots batches.
+        np.frombuffer(shm.buf, dtype=np.uint8)[:] = 0
+        self._closed = False
+        for slot in range(self.n_slots):
+            free_q.put(slot)
+
+    def spec(self) -> RingSpec:
+        return RingSpec(self.shm.name, self.slot_bytes, self.n_slots,
+                        self.free_q)
+
+    def map(self, f: SlotFrame) -> RingBatch:
+        """One descriptor -> zero-copy RingBatch over the slot's views."""
+        base = f.slot * self.slot_bytes
+        n = max(f.n_lines, 1)
+        buf_off, payload_off, _total = slot_layout(
+            n, f.line_len, f.payload_len
+        )
+        mv = self.shm.buf
+        lengths = np.frombuffer(
+            mv, dtype=np.int32, count=n, offset=base
+        )[: f.n_lines]
+        buf = np.frombuffer(
+            mv, dtype=np.uint8, count=n * f.line_len, offset=base + buf_off
+        ).reshape(n, f.line_len)[: f.n_lines]
+        payload = np.frombuffer(
+            mv, dtype=np.uint8, count=f.payload_len, offset=base + payload_off
+        )
+        return RingBatch(
+            shard=f.shard,
+            index=f.index,
+            payload=payload,
+            buf=buf,
+            lengths=lengths,
+            overflow=list(f.overflow),
+            n_lines=f.n_lines,
+            read_s=f.read_s,
+            encode_s=f.encode_s,
+            slot_wait_s=f.slot_wait_s,
+            ring=self,
+            slot=f.slot,
+        )
+
+    def release(self, slot: int) -> None:
+        if not self._closed:
+            try:
+                self.free_q.put(slot)
+            except Exception:  # noqa: BLE001 — queue torn down mid-release
+                pass
+
+    def inplace_bytes(self, f: SlotFrame) -> int:
+        """Bytes this descriptor delivered through the arena instead of
+        the pipe (the feeder_ring_bytes_inplace_total increment)."""
+        return 4 * f.n_lines + f.n_lines * f.line_len + f.payload_len
+
+    def close(self) -> None:
+        """Unmap and unlink the segment.  Idempotent; outstanding
+        RingBatch views die with the mapping — callers must detach
+        batches that outlive the pool."""
+        if self._closed:
+            return
+        self._closed = True
+        # mp.Queue's feeder thread would otherwise keep the process
+        # alive waiting to flush released slot ids nobody will read.
+        if hasattr(self.free_q, "cancel_join_thread"):
+            self.free_q.cancel_join_thread()
+        try:
+            self.shm.close()
+        except BufferError:
+            # Live RingBatch views pin the mapping: the segment still
+            # gets unlinked below (names never leak); the mapping itself
+            # goes when the last view does.
+            pass
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.shm.unlink()
+        except Exception:  # noqa: BLE001 — already unlinked (tracker)
+            pass

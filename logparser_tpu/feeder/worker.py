@@ -1,18 +1,29 @@
 """Feeder worker loop: shard payloads -> framed, device-ready batches.
 
 Each worker owns a deterministic subset of the shard plan (shard i goes
-to worker ``i % N``) and pushes :class:`EncodedBatch` items into its own
-BOUNDED queue — a full queue blocks the worker, which is the whole
-backpressure story (the device consumer's drain rate caps host read
-rate; nothing buffers unboundedly).
+to worker ``i % N``) and pushes batch messages into its own queue.
+Backpressure is transport-specific but always producer-blocking:
+
+- **ring** transport (the default for process pools): the batch body is
+  framed directly into a shared-memory slot and only a tiny
+  :class:`~logparser_tpu.feeder.ring.SlotFrame` descriptor crosses the
+  queue — an exhausted free-slot queue blocks the worker until the
+  consumer releases a slot;
+- **pickle** transport (escape hatch / fallback): the whole
+  :class:`EncodedBatch` is pickled through a BOUNDED queue — a full
+  queue blocks the worker.
+
+Either way the device consumer's drain rate caps host read rate;
+nothing buffers unboundedly and nothing is ever dropped.
 
 Framing is exactly ``TpuBatchParser.parse_blob``'s: the same
 :func:`logparser_tpu.native.encode_blob` packs each batch's line bytes
 into the padded ``[B, L]`` uint8 buffer (trailing-newline empty segment
 dropped, one trailing ``\\r`` per line stripped), so feeder output is
-byte-identical to single-process ``parse_blob`` over the same corpus.
-The module is jax-free and picklable — it runs inside ``spawn``ed
-worker processes that must never acquire the device.
+byte-identical to single-process ``parse_blob`` over the same corpus —
+on BOTH transports (the parity suite pins it).  The module is jax-free
+and picklable — it runs inside ``spawn``ed worker processes that must
+never acquire the device.
 """
 from __future__ import annotations
 
@@ -27,7 +38,8 @@ import numpy as np
 from .shards import Shard, _Source, read_shard_payload
 
 # Queue message kinds (worker -> consumer).
-MSG_BATCH = "batch"
+MSG_BATCH = "batch"          # pickled EncodedBatch body
+MSG_SLOT = "slot"            # ring SlotFrame descriptor (body in shm)
 MSG_SHARD_DONE = "shard_done"
 MSG_DONE = "done"
 MSG_ERROR = "error"
@@ -39,7 +51,9 @@ class EncodedBatch:
     and byte-parity checks) plus the device-ready encoded buffers.
 
     ``TpuBatchParser.parse_encoded`` / ``parse_batch_stream`` adopt this
-    directly — the consumer process never re-scans the payload."""
+    directly — the consumer process never re-scans the payload.  The
+    ring transport's :class:`~logparser_tpu.feeder.ring.RingBatch`
+    subclass backs the same fields with shared-memory slot views."""
 
     shard: int                  # global shard index
     index: int                  # batch index within the shard
@@ -50,6 +64,7 @@ class EncodedBatch:
     n_lines: int = 0
     read_s: float = 0.0         # this batch's share of the shard read
     encode_s: float = 0.0       # framing wall time (worker-side)
+    slot_wait_s: float = 0.0    # ring backpressure wait (0 for pickle)
 
     @property
     def source_bytes(self) -> int:
@@ -58,6 +73,13 @@ class EncodedBatch:
     @property
     def order_key(self) -> Tuple[int, int]:
         return (self.shard, self.index)
+
+    def release(self) -> None:
+        """Slot-lease hook: a plain (owned) batch holds no lease."""
+
+    def detach(self) -> "EncodedBatch":
+        """Owned-copy hook: a plain batch already owns its arrays."""
+        return self
 
 
 def split_batches(payload: bytes, batch_lines: int) -> List[Tuple[int, int]]:
@@ -91,23 +113,100 @@ def run_worker(
     line_len: int,
     stop_event,
     delay_s: float = 0.0,
+    ring=None,
+    puts=None,
+    watch_parent: bool = False,
 ) -> None:
     """Read + frame this worker's shards, in shard order, into ``out_q``.
 
-    ``stop_event`` aborts blocked puts so an abandoned pool never leaks
-    a worker wedged on a full queue.  ``delay_s`` sleeps after each
-    batch — a shaping/test hook (slow-source simulation)."""
+    ``stop_event`` aborts blocked puts AND blocked slot acquires so an
+    abandoned pool never leaks a worker wedged on a full queue or an
+    exhausted ring.  ``delay_s`` sleeps after each batch — a
+    shaping/test hook (slow-source simulation).  ``ring`` selects the
+    shared-memory transport: a :class:`~logparser_tpu.feeder.ring.
+    RingSpec` (process workers attach by name) or a ready
+    ``SlotWriter`` (thread workers share the pool's mapping).  ``puts``
+    is an optional shared put-counter (``multiprocessing.Value``) the
+    parent reads to keep the ``feeder_queue_depth`` gauge live for
+    process workers (a child process cannot touch the parent's metrics
+    registry).  ``watch_parent`` arms the orphan watch — process
+    workers only: there ``mp.parent_process()`` IS the consumer, while
+    a thread worker's is whatever spawned the consumer, and that dying
+    says nothing about the consumer's health."""
     from ..native import encode_blob
+
+    writer = None
+    if ring is not None:
+        from .ring import SlotWriter
+
+        writer = ring if isinstance(ring, SlotWriter) else SlotWriter(ring)
+
+    stop = _StopWatch(stop_event, watch_parent=watch_parent)
 
     def put(item) -> bool:
         while True:
-            if stop_event.is_set():
+            if stop.is_set():
                 return False
             try:
                 out_q.put(item, timeout=0.1)
+                if puts is not None:
+                    with puts.get_lock():
+                        puts.value += 1
                 return True
             except Full:  # same class for both queue flavors
                 continue
+
+    def emit_batch(shard, bi, chunk, read_share) -> bool:
+        """Frame + ship one batch over the active transport.  Returns
+        False when the stop event cut a blocked wait short."""
+        if writer is not None:
+            from .ring import SlotOverflow
+
+            got = writer.acquire(stop)
+            if got is None:
+                return False
+            slot, wait_s = got
+            t0 = time.perf_counter()
+            try:
+                n, L, overflow = writer.frame(chunk, line_len, slot)
+            except SlotOverflow:
+                # This one batch outgrew the slot (pathological line
+                # bucket): give the slot back and ship it pickled.
+                writer.putback(slot)
+            else:
+                from .ring import SlotFrame
+
+                desc = SlotFrame(
+                    shard=shard.index, index=bi, slot=slot,
+                    n_lines=n if len(chunk) else 0, line_len=L,
+                    payload_len=len(chunk), overflow=overflow,
+                    read_s=read_share,
+                    encode_s=time.perf_counter() - t0,
+                    slot_wait_s=wait_s,
+                )
+                if not put((MSG_SLOT, desc)):
+                    writer.putback(slot)
+                    return False
+                return True
+        else:
+            wait_s = 0.0
+        t0 = time.perf_counter()
+        buf, lengths, overflow = encode_blob(chunk, line_len=line_len)
+        encode_s = time.perf_counter() - t0
+        n = int(buf.shape[0]) if len(chunk) else 0
+        eb = EncodedBatch(
+            shard=shard.index,
+            index=bi,
+            payload=chunk,
+            buf=buf,
+            lengths=lengths,
+            overflow=list(overflow),
+            n_lines=n,
+            read_s=read_share,
+            encode_s=encode_s,
+            slot_wait_s=wait_s,
+        )
+        return put((MSG_BATCH, eb))
 
     try:
         for shard in shards:
@@ -117,26 +216,12 @@ def run_worker(
             read_s = time.perf_counter() - t0
             ranges = split_batches(payload, batch_lines)
             shard_lines = 0
+            read_share = read_s / max(1, len(ranges))
             for bi, (p0, p1) in enumerate(ranges):
                 chunk = payload[p0:p1]
-                t0 = time.perf_counter()
-                buf, lengths, overflow = encode_blob(chunk, line_len=line_len)
-                encode_s = time.perf_counter() - t0
-                n = int(buf.shape[0]) if len(chunk) else 0
-                shard_lines += n
-                eb = EncodedBatch(
-                    shard=shard.index,
-                    index=bi,
-                    payload=chunk,
-                    buf=buf,
-                    lengths=lengths,
-                    overflow=list(overflow),
-                    n_lines=n,
-                    read_s=read_s / max(1, len(ranges)),
-                    encode_s=encode_s,
-                )
-                if not put((MSG_BATCH, eb)):
+                if not emit_batch(shard, bi, chunk, read_share):
                     return
+                shard_lines += _count_lines(chunk)
                 if delay_s:
                     time.sleep(delay_s)
             if not put((
@@ -153,11 +238,66 @@ def run_worker(
             put((MSG_ERROR, worker_id, traceback.format_exc()))
         except Exception:  # noqa: BLE001 — queue already torn down
             pass
+    finally:
+        if writer is not None:
+            writer.close()
+
+
+class _StopWatch:
+    """``stop_event`` plus orphan detection: a worker whose logical
+    parent (the pool's consumer process) died without close() — SIGKILL,
+    test-harness timeout — must exit on its own.  Wedged orphans would
+    otherwise spin on their put/acquire loops forever, holding the
+    resource-tracker pipe open (so crashed-consumer arenas never get
+    unlinked) and any inherited stdout/stderr pipes (so a harness
+    waiting on the consumer's output hangs).  The parent sentinel is
+    polled at most once per second.  Armed ONLY for process workers
+    (``watch_parent=True``): for them ``mp.parent_process()`` is the
+    consumer itself; a thread worker runs INSIDE the consumer, whose
+    own parent dying is not the consumer dying."""
+
+    __slots__ = ("_event", "_parent", "_next_check")
+
+    def __init__(self, stop_event, watch_parent: bool = False):
+        self._event = stop_event
+        self._parent = None
+        if watch_parent:
+            try:
+                import multiprocessing as mp
+
+                self._parent = mp.parent_process()
+            except Exception:  # noqa: BLE001 — detection is best-effort
+                pass
+        self._next_check = 0.0
+
+    def is_set(self) -> bool:
+        if self._event.is_set():
+            return True
+        if self._parent is not None:
+            now = time.monotonic()
+            if now >= self._next_check:
+                self._next_check = now + 1.0
+                if not self._parent.is_alive():
+                    return True
+        return False
+
+
+def _count_lines(chunk: bytes) -> int:
+    """encode_blob's line count without framing: a trailing newline
+    ends the last line, it never starts a new one.  THE home of that
+    counting rule — shard_done accounting here and ``_BlobLines``'s
+    bytes branch (tpu/batch.py) both call it; keep any framing-rule
+    change in one place."""
+    if not chunk:
+        return 0
+    n = chunk.count(b"\n")
+    return n if chunk.endswith(b"\n") else n + 1
 
 
 # Threads-mode producers can update the shared queue-depth gauge on every
 # put (the consumer only sees depth at get time); process-mode workers
-# live in another registry, so the parent samples qsize() instead.
+# live in another registry, so the parent tracks depth with a shared
+# put-counter (the ``puts`` arg of run_worker) minus its own get count.
 def make_instrumented_queue(q, depth_cb: Optional[Callable[[], None]]):
     if depth_cb is None:
         return q

@@ -190,14 +190,25 @@ def encode_blob(
     min_bucket: int = 64,
     cap: int = 8191,  # tpu.runtime.DEFAULT_MAX_LINE_LEN (13-bit span slots)
     threads: int = 0,
+    alloc=None,
 ) -> Tuple[np.ndarray, np.ndarray, List[int]]:
     """Newline-delimited bytes -> (buf [B, L] uint8, lengths [B] int32,
     overflow row indices).  L is the length bucket of the longest line
-    (<= cap) unless ``line_len`` pins it."""
+    (<= cap) unless ``line_len`` pins it.
+
+    ``alloc(n, L) -> (buf [n, L] uint8, lengths [n] int32)`` supplies the
+    destination arrays (e.g. shared-memory slot views: the feeder ring
+    frames batches directly into the transport arena, no staging copy).
+    The packed result is byte-identical to the self-allocating path even
+    when the destination is a recycled slot: ``lp_pack`` writes EVERY
+    byte of rows [0, n) (line bytes + padding memset), so no pre-zeroing
+    is needed on the native path — only the empty-blob placeholder row
+    is cleared explicitly.  ``alloc`` may raise to reject the (n, L)
+    shape (slot capacity); the exception propagates to the caller."""
     blob = np.frombuffer(data, dtype=np.uint8)
     lib = get_lib()
     if lib is None:
-        return _encode_blob_numpy(data, line_len, min_bucket, cap)
+        return _encode_blob_numpy(data, line_len, min_bucket, cap, alloc)
 
     n_lines = ctypes.c_int64()
     max_len = ctypes.c_int64()
@@ -208,8 +219,14 @@ def encode_blob(
         L = _bucket(max_len.value, min_bucket, cap)
     else:
         L = line_len
-    buf = np.zeros((max(n, 1), L), dtype=np.uint8)
-    lengths = np.zeros(max(n, 1), dtype=np.int32)
+    if alloc is not None:
+        buf, lengths = alloc(max(n, 1), L)
+        if n == 0:  # placeholder row lp_pack never touches
+            buf[:] = 0
+            lengths[:] = 0
+    else:
+        buf = np.zeros((max(n, 1), L), dtype=np.uint8)
+        lengths = np.zeros(max(n, 1), dtype=np.int32)
     if n:
         lib.lp_frame_pack(
             _u8(blob), blob.size, _u8(buf),
@@ -217,7 +234,12 @@ def encode_blob(
             n, L, threads or _default_threads(),
         )
     overflow = np.nonzero(lengths & _OVERFLOW_BIT)[0]
-    lengths = (lengths & ~_OVERFLOW_BIT).astype(np.int32)
+    if alloc is not None:
+        # Caller-provided destination (slot view): strip the overflow
+        # bit IN PLACE so the transported lengths are the clean ones.
+        lengths &= ~_OVERFLOW_BIT
+    else:
+        lengths = (lengths & ~_OVERFLOW_BIT).astype(np.int32)
     return buf[:n], lengths[:n], [int(i) for i in overflow if i < n]
 
 
@@ -639,10 +661,10 @@ def assemble_special(
 
 
 def _encode_blob_numpy(
-    data: bytes, line_len: int, min_bucket: int, cap: int
+    data: bytes, line_len: int, min_bucket: int, cap: int, alloc=None
 ) -> Tuple[np.ndarray, np.ndarray, List[int]]:
     """Pure-numpy fallback with identical semantics."""
-    lines = data.split(b"\n")
+    lines = bytes(data).split(b"\n")
     if lines and lines[-1] == b"":
         lines.pop()
     lines = [ln[:-1] if ln.endswith(b"\r") else ln for ln in lines]
@@ -651,8 +673,13 @@ def _encode_blob_numpy(
         L = _bucket(max_len, min_bucket, cap)
     else:
         L = line_len
-    buf = np.zeros((max(len(lines), 1), L), dtype=np.uint8)
-    lengths = np.zeros(max(len(lines), 1), dtype=np.int32)
+    if alloc is not None:
+        buf, lengths = alloc(max(len(lines), 1), L)
+        buf[:] = 0
+        lengths[:] = 0
+    else:
+        buf = np.zeros((max(len(lines), 1), L), dtype=np.uint8)
+        lengths = np.zeros(max(len(lines), 1), dtype=np.int32)
     overflow: List[int] = []
     for i, r in enumerate(lines):
         if len(r) > L:

@@ -556,6 +556,7 @@ def metrics() -> MetricsRegistry:
 # breakdown and tools/profile_device.py host scopes reuse them verbatim.
 PIPELINE_STAGES = (
     "encode",            # [B, L] uint8 packing (native framer / per-line)
+    "h2d_stage",         # staged async upload enqueue (stream double-buffer)
     "device",            # fused-executor dispatch (kernel time when tracing)
     "fetch",             # packed D2H of the device verdict rows
     "columns",           # packed rows -> typed numpy columns

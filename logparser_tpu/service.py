@@ -334,11 +334,14 @@ class _SessionHandler(socketserver.BaseRequestHandler):
 def _feeder_parse(parser, blob: bytes, count: int, workers: int):
     """Parse one LINES blob through the sharded feeder fabric
     (docs/FEEDER.md): the payload splits into ``workers`` byte-range
-    shards framed by feeder THREADS (a serving process must not fork),
-    the parser consumes the encoded stream via ``parse_batch_stream``,
-    and the per-batch tables concatenate back — in corpus order — into
-    the single combined record batch the protocol promises.  Returns
-    ``(table, oracle_rows, bad_lines)``."""
+    shards framed by feeder THREADS (a serving process must not fork,
+    so the in-process ``inline`` hand-off applies — the shared-memory
+    ring transport is for process pools), the parser consumes the
+    encoded stream via ``parse_batch_stream`` (which also stages each
+    next batch's H2D upload while the current one computes — the
+    double-buffered device edge), and the per-batch tables concatenate
+    back — in corpus order — into the single combined record batch the
+    protocol promises.  Returns ``(table, oracle_rows, bad_lines)``."""
     import pyarrow as pa
 
     from .feeder import FeederPool, default_feeder_workers

@@ -1,17 +1,22 @@
 """CI feeder smoke: sharded multi-worker framing == single-process parse_blob.
 
 Runs the real ingest fabric (2 feeder workers, process mode with the
-thread fallback, across 2 shard sizes) over a small demolog corpus and
-fails (exit 1) unless:
+thread fallback, across 2 shard sizes, over BOTH transports — the
+zero-copy shared-memory ring and the pickled escape hatch) over a small
+demolog corpus and fails (exit 1) unless:
 
-- framing byte-parity holds: the concatenated batch payloads equal the
-  corpus, and the concatenated encoded buffers equal one-shot
-  ``encode_blob`` over the whole corpus;
+- framing byte-parity holds on each transport: the concatenated batch
+  payloads equal the corpus, and the concatenated encoded buffers equal
+  one-shot ``encode_blob`` over the whole corpus;
 - parse parity holds: ``FeederPool.feed(parser)`` tables concatenate to
   exactly ``parser.parse_blob``'s table (values, validity, counters);
-- the ``feeder_*`` metric families land in the registry and the
-  rendered Prometheus exposition stays structurally valid
-  (:func:`logparser_tpu.tools.metrics_smoke.validate_exposition`).
+- in process mode the ring transport actually engaged (descriptors over
+  shared-memory slots, not a silent pickle fallback) and NO shared-
+  memory segment leaks past pool teardown (``/dev/shm`` carries no
+  ``lpring_*`` entries afterwards);
+- the ``feeder_*`` metric families (ring counters included) land in the
+  registry and the rendered Prometheus exposition stays structurally
+  valid (:func:`logparser_tpu.tools.metrics_smoke.validate_exposition`).
 
 Usage::
 
@@ -20,6 +25,7 @@ Usage::
 """
 from __future__ import annotations
 
+import os
 import sys
 
 N_LINES = 4096
@@ -31,13 +37,24 @@ FIELDS = [
     "STRING:request.status.last",
     "BYTES:response.body.bytes",
 ]
+SHM_DIR = "/dev/shm"
+
+
+def _ring_segments():
+    from logparser_tpu.feeder import RING_NAME_PREFIX
+
+    if not os.path.isdir(SHM_DIR):
+        return None  # platform without a visible shm mount: skip the check
+    return sorted(
+        f for f in os.listdir(SHM_DIR) if f.startswith(RING_NAME_PREFIX)
+    )
 
 
 def main() -> int:
     import numpy as np
     import pyarrow as pa
 
-    from logparser_tpu.feeder import FeederPool
+    from logparser_tpu.feeder import FeederPool, ring_available
     from logparser_tpu.native import encode_blob
     from logparser_tpu.observability import metrics
     from logparser_tpu.tools.demolog import generate_combined_lines
@@ -53,50 +70,83 @@ def main() -> int:
     ref_table = ref.to_arrow(include_validity=True, strings="copy")
 
     failures = []
+    segments_before = _ring_segments()
     shard_sizes = (max(1, -(-len(blob) // WORKERS)), 64 << 10)
-    for shard_bytes in shard_sizes:
-        # Pass 1: framing byte-parity on the raw batch stream.
-        pool = FeederPool(
-            [blob], workers=WORKERS, shard_bytes=shard_bytes,
-            batch_lines=BATCH_LINES, line_len=LINE_LEN,
-        )
-        ebs = list(pool.batches())
-        mode = pool.stats()["mode"]
-        if b"".join(e.payload for e in ebs) != blob:
-            failures.append(f"shard_bytes={shard_bytes}: payload bytes "
-                            "diverge from the corpus")
-        buf = np.concatenate([e.buf for e in ebs])
-        lengths = np.concatenate([e.lengths for e in ebs])
-        if not (np.array_equal(buf, ref_buf)
-                and np.array_equal(lengths, ref_lengths)):
-            failures.append(f"shard_bytes={shard_bytes}: encoded buffers "
-                            "diverge from one-shot encode_blob")
+    transports = ("ring", "pickle") if ring_available() else ("pickle",)
+    modes = set()
+    for transport in transports:
+        for shard_bytes in shard_sizes:
+            tag = f"transport={transport} shard_bytes={shard_bytes}"
+            # Pass 1: framing byte-parity on the raw batch stream.
+            pool = FeederPool(
+                [blob], workers=WORKERS, shard_bytes=shard_bytes,
+                batch_lines=BATCH_LINES, line_len=LINE_LEN,
+                transport=transport,
+            )
+            ebs = list(pool.batches())
+            stats = pool.stats()
+            mode = stats["mode"]
+            modes.add(mode)
+            if mode == "process" and stats["transport"] != transport:
+                failures.append(
+                    f"{tag}: requested transport did not engage "
+                    f"(ran {stats['transport']!r})"
+                )
+            if b"".join(bytes(e.payload) for e in ebs) != blob:
+                failures.append(f"{tag}: payload bytes diverge from the "
+                                "corpus")
+            buf = np.concatenate([e.buf for e in ebs])
+            lengths = np.concatenate([e.lengths for e in ebs])
+            if not (np.array_equal(buf, ref_buf)
+                    and np.array_equal(lengths, ref_lengths)):
+                failures.append(f"{tag}: encoded buffers diverge from "
+                                "one-shot encode_blob")
 
-        # Pass 2: parse parity through the device consumer.
-        pool = FeederPool(
-            [blob], workers=WORKERS, shard_bytes=shard_bytes,
-            batch_lines=BATCH_LINES, line_len=LINE_LEN,
-        )
-        tables = [
-            r.to_arrow(include_validity=True, strings="copy")
-            for r in pool.feed(parser)
-        ]
-        table = pa.concat_tables(tables).combine_chunks()
-        if not table.equals(ref_table.combine_chunks()):
-            failures.append(f"shard_bytes={shard_bytes}: feeder-fed Arrow "
-                            "table diverges from parse_blob's")
-        print(f"feeder-smoke: shard_bytes={shard_bytes} mode={mode} "
-              f"batches={len(ebs)} rows={table.num_rows} OK")
+            # Pass 2: parse parity through the device consumer (the
+            # zero-copy flavor: slots release after materialization).
+            pool = FeederPool(
+                [blob], workers=WORKERS, shard_bytes=shard_bytes,
+                batch_lines=BATCH_LINES, line_len=LINE_LEN,
+                transport=transport,
+            )
+            tables = [
+                r.to_arrow(include_validity=True, strings="copy")
+                for r in pool.feed(parser)
+            ]
+            table = pa.concat_tables(tables).combine_chunks()
+            if not table.equals(ref_table.combine_chunks()):
+                failures.append(f"{tag}: feeder-fed Arrow table diverges "
+                                "from parse_blob's")
+            print(f"feeder-smoke: {tag} mode={mode} batches={len(ebs)} "
+                  f"rows={table.num_rows} OK")
+
+    # Shared-memory hygiene: every arena created above must be unlinked
+    # by pool teardown — a leaked segment is an unbounded /dev/shm drip
+    # on a long-lived serving host.
+    segments_after = _ring_segments()
+    if segments_before is not None and segments_after is not None:
+        leaked = sorted(set(segments_after) - set(segments_before))
+        if leaked:
+            failures.append(f"leaked shared-memory segments: {leaked}")
 
     reg = metrics()
     for family in ("feeder_bytes_read_total", "feeder_lines_total",
                    "feeder_batches_total", "feeder_shards_total"):
         if reg.get(family) <= 0:
             failures.append(f"metric family missing/zero: {family}")
+    if "process" in modes and "ring" in transports:
+        if reg.get("feeder_ring_bytes_inplace_total") <= 0:
+            failures.append(
+                "ring ran but feeder_ring_bytes_inplace_total stayed zero"
+            )
     text = reg.prometheus_text()
-    for needle in ('logparser_tpu_stage_seconds_bucket{stage="feeder_encode"',
-                   'logparser_tpu_stage_seconds_bucket{stage="feeder_read"',
-                   "logparser_tpu_feeder_bytes_read_total"):
+    needles = ['logparser_tpu_stage_seconds_bucket{stage="feeder_encode"',
+               'logparser_tpu_stage_seconds_bucket{stage="feeder_read"',
+               "logparser_tpu_feeder_bytes_read_total"]
+    if "process" in modes and "ring" in transports:
+        needles += ["logparser_tpu_feeder_ring_slot_wait_seconds_total",
+                    "logparser_tpu_feeder_ring_bytes_inplace_total"]
+    for needle in needles:
         if needle not in text:
             failures.append(f"/metrics exposition missing: {needle}")
     failures.extend(validate_exposition(text))
@@ -107,7 +157,8 @@ def main() -> int:
             print(" -", f)
         return 1
     print(f"feeder-smoke OK: {N_LINES} lines x {WORKERS} workers x "
-          f"{len(shard_sizes)} shard sizes, byte- and parse-parity held")
+          f"{len(shard_sizes)} shard sizes x {len(transports)} transports, "
+          f"byte- and parse-parity held, no leaked shm segments")
     return 0
 
 

@@ -391,32 +391,46 @@ class _BlobLines:
     when indexed (oracle-rescued rows, debugging).  Framing semantics are
     exactly :func:`logparser_tpu.native.encode_blob`'s: a final empty
     segment after a trailing newline is dropped and one trailing ``\\r``
-    per line is stripped."""
+    per line is stripped.
 
-    __slots__ = ("_blob", "_n", "_starts", "_ends")
+    ``blob`` may be bytes or any 1-D uint8 buffer (the feeder ring hands
+    a shared-memory slot VIEW straight through — the payload is never
+    copied unless a row is actually rescued)."""
 
-    def __init__(self, blob: bytes):
+    __slots__ = ("_blob", "_bytes", "_n", "_starts", "_ends")
+
+    def __init__(self, blob):
+        self._bytes = isinstance(blob, (bytes, bytearray))
+        if not self._bytes:
+            blob = np.frombuffer(blob, dtype=np.uint8)
         self._blob = blob
         # Cheap length only (one C-level count); the per-line index
         # arrays build lazily on first access — almost no row ever
         # materializes (only oracle-rescued ones).
-        if not blob:
-            self._n = 0
-        elif blob.endswith(b"\n"):
-            self._n = blob.count(b"\n")
+        if self._bytes:
+            from ..feeder.worker import _count_lines
+
+            # The single home of the trailing-newline counting rule
+            # (the ndarray branch below is its vectorized twin).
+            self._n = _count_lines(blob)
         else:
-            self._n = blob.count(b"\n") + 1
+            if not len(blob):
+                self._n = 0
+            else:
+                nl = int(np.count_nonzero(blob == 0x0A))
+                self._n = nl if blob[-1] == 0x0A else nl + 1
         self._starts = None
         self._ends = None
 
     def _index(self):
         if self._starts is None:
             blob = self._blob
-            arr = np.frombuffer(blob, dtype=np.uint8)
+            arr = (np.frombuffer(blob, dtype=np.uint8)
+                   if self._bytes else blob)
             nl = np.flatnonzero(arr == 0x0A)
             starts = np.concatenate([[0], nl + 1]).astype(np.int64)
             ends = np.concatenate([nl, [len(blob)]]).astype(np.int64)
-            if blob.endswith(b"\n"):
+            if len(arr) and arr[-1] == 0x0A:
                 starts = starts[:-1]
                 ends = ends[:-1]
             cr = (arr[np.maximum(ends - 1, 0)] == 0x0D) & (ends > starts)
@@ -431,11 +445,20 @@ class _BlobLines:
         if isinstance(i, slice):
             return [self[j] for j in range(*i.indices(len(self)))]
         starts, ends = self._index()
-        return self._blob[starts[i]: ends[i]]
+        raw = self._blob[starts[i]: ends[i]]
+        return raw if self._bytes else raw.tobytes()
 
     def __iter__(self):
         for i in range(len(self)):
             yield self[i]
+
+
+def _release_stream_item(item) -> None:
+    """Give a stream item's ring slot back (zero-copy feeder batches);
+    plain batches and line lists have no lease (no-op / absent)."""
+    release = getattr(item, "release", None)
+    if release is not None:
+        release()
 
 
 class BatchResult:
@@ -1409,21 +1432,38 @@ class TpuBatchParser:
         consumes.  Lines stay lazy (``_BlobLines`` over the shipped
         payload — only oracle-rescued rows ever materialize).  A
         framer/count disagreement falls back to the authoritative
-        per-line path, mirroring :meth:`parse_blob`."""
+        per-line path, mirroring :meth:`parse_blob`.
+
+        Ring batches (shared-memory slot views, feeder ring transport):
+        the PAYLOAD stays a zero-copy slot view end to end — rescue rows
+        read it in place during materialization, after which the stream
+        releases the slot.  The frame arrays are adopted into owned
+        buffers (the bucket pad does it for free on partial batches; an
+        exact-bucket batch pays one memcpy) because ``BatchResult.buf``
+        backs host span gathers and string_view tables for as long as
+        the caller keeps the result — longer than a recycling slot may
+        live."""
         from ..observability import pipeline_stage, record_batch_shape
 
-        lines = _BlobLines(bytes(batch.payload))
+        payload = batch.payload
+        if not isinstance(payload, (bytes, bytearray, np.ndarray)):
+            payload = bytes(payload)
+        lines = _BlobLines(payload)
         B = len(lines)
         buf, lengths = batch.buf, batch.lengths
         if B != batch.n_lines or buf.shape[0] != B:
             return self._encode_batch(list(lines))
+        leased = getattr(batch, "ring", None) is not None
         with pipeline_stage("encode", items=0):
-            # Adoption cost only (row padding): the real encode ran in
-            # the feeder worker and is accounted under feeder_encode.
+            # Adoption cost only (row padding / lease copy): the real
+            # encode ran in the feeder worker under feeder_encode.
             padded_b = _bucket_batch(B)
             if padded_b != B:
                 buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
                 lengths = np.pad(lengths, (0, padded_b - B))
+            elif leased:
+                buf = np.array(buf, copy=True)
+                lengths = np.array(lengths, copy=True)
         record_batch_shape(B, padded_b, buf.shape[1], int(lengths.sum()))
         return (lines, buf, lengths, list(batch.overflow), B, padded_b)
 
@@ -1432,6 +1472,7 @@ class TpuBatchParser:
         batches,
         depth: int = 1,
         emit_views: Optional[bool] = None,
+        stage_h2d: Optional[bool] = None,
     ):
         """Batches-in-flight streaming: yields one BatchResult per input
         batch, in order, overlapping the host-side stages with device
@@ -1457,30 +1498,82 @@ class TpuBatchParser:
         Items may also be feeder-framed batches
         (:class:`logparser_tpu.feeder.worker.EncodedBatch`, e.g. from
         ``FeederPool.batches()``): those skip the host encode entirely —
-        the framing already happened in the feeder worker."""
+        the framing already happened in the feeder worker.  Ring batches
+        (``FeederPool.batches(detach=False)`` / ``feed()``) are RELEASED
+        by the stream once their result materializes — device upload
+        done, rescue payload consumed — so the zero-copy slots recycle
+        exactly one materialization behind delivery.
+
+        ``stage_h2d`` double-buffers the host->device edge: batch k+1's
+        encoded frame is handed to ``jax.device_put`` BEFORE the stream
+        blocks on batch k's D2H fetch, so the upload overlaps the
+        in-flight device work instead of queueing behind the fetch (the
+        gap ``observe_stage`` used to charge to ``encode``/``device``).
+        Default (None): enabled unless ``LOGPARSER_TPU_STAGED_H2D=0`` —
+        the opt-out exists because staging reorders the link to
+        H2D(k+1)-before-D2H(k), which can HURT on tunneled/half-duplex
+        attachments for the same reason depth>1 does (see above)."""
         from collections import deque
 
         from ..feeder.worker import EncodedBatch
 
+        if stage_h2d is None:
+            stage_h2d = os.environ.get(
+                "LOGPARSER_TPU_STAGED_H2D", "1"
+            ).strip().lower() not in ("0", "false", "no")
         depth = max(1, depth)
         pending = deque()
-        for lines in batches:
-            enc = (
-                self._adopt_encoded(lines)
-                if isinstance(lines, EncodedBatch)
-                else self._encode_batch(lines)
-            )
-            if len(pending) >= depth:
-                # Drain the oldest D2H BEFORE enqueueing the next H2D
-                # (link order), then materialize it while the new batch
-                # computes.
-                fetched = self._fetch_packed(pending.popleft())
-                pending.append(self._dispatch_batch(enc, emit_views))
-                yield self._materialize_packed(fetched)
-            else:
-                pending.append(self._dispatch_batch(enc, emit_views))
-        while pending:
-            yield self._finish_batch(pending.popleft())
+        inflight = deque()  # source items of `pending`, for slot release
+        try:
+            for lines in batches:
+                enc = (
+                    self._adopt_encoded(lines)
+                    if isinstance(lines, EncodedBatch)
+                    else self._encode_batch(lines)
+                )
+                if stage_h2d:
+                    enc = self._stage_h2d(enc, emit_views)
+                inflight.append(lines)
+                if len(pending) >= depth:
+                    # Drain the oldest D2H BEFORE enqueueing the next H2D
+                    # (link order; the staged upload above is the deliberate
+                    # exception), then materialize it while the new batch
+                    # computes.
+                    fetched = self._fetch_packed(pending.popleft())
+                    pending.append(self._dispatch_batch(enc, emit_views))
+                    result = self._materialize_packed(fetched)
+                    _release_stream_item(inflight.popleft())
+                    yield result
+                else:
+                    pending.append(self._dispatch_batch(enc, emit_views))
+            while pending:
+                result = self._finish_batch(pending.popleft())
+                _release_stream_item(inflight.popleft())
+                yield result
+        finally:
+            # Abandoned stream (close/throw/error): give every undelivered
+            # ring slot back so the fabric can wind down instead of
+            # wedging producers on an exhausted ring.
+            while inflight:
+                _release_stream_item(inflight.popleft())
+
+    def _stage_h2d(self, enc, emit_views: Optional[bool]):
+        """Begin the async H2D transfer of one encoded batch (double
+        buffering: the upload overlaps whatever is already on device).
+        Returns the enc tuple extended with the staged device arrays;
+        a no-op for host-only parsers."""
+        from ..observability import metrics, observe_stage
+
+        if self._executor_for(emit_views) is None:
+            return enc
+        lines, buf, lengths, overflow, B, padded_b = enc[:6]
+        t0 = time.perf_counter()
+        staged = (jax.device_put(buf), jax.device_put(lengths))
+        observe_stage("h2d_stage", time.perf_counter() - t0, items=B)
+        metrics().increment(
+            "h2d_staged_bytes_total", int(buf.nbytes + lengths.nbytes)
+        )
+        return (lines, buf, lengths, overflow, B, padded_b, staged)
 
     def _start_batch(self, lines: Sequence[Union[bytes, str]]):
         """Encode + pad + asynchronously dispatch the device program.
@@ -1512,7 +1605,10 @@ class TpuBatchParser:
     def _dispatch_batch(self, enc, emit_views: Optional[bool] = None):
         from ..observability import metrics, pipeline_stage, tracer
 
-        lines, buf, lengths, overflow, B, padded_b = enc
+        # enc may carry a 7th element: device arrays already staged by
+        # _stage_h2d (the overlapped-upload path).
+        lines, buf, lengths, overflow, B, padded_b = enc[:6]
+        staged = enc[6] if len(enc) > 6 else None
         out = None
         fn = self._executor_for(emit_views)
         if fn is not None:
@@ -1528,7 +1624,10 @@ class TpuBatchParser:
                 labels={"views": "on" if views_on else "off"},
             )
             with pipeline_stage("device", items=B):
-                out = fn(jnp.asarray(buf), jnp.asarray(lengths))
+                if staged is not None:
+                    out = fn(*staged)
+                else:
+                    out = fn(jnp.asarray(buf), jnp.asarray(lengths))
                 if tracer().enabled:
                     # Dispatch is async: make the device stage contain the
                     # actual kernel time instead of misattributing it to

@@ -1,11 +1,15 @@
 """Sharded feeder subsystem (round 8): planner contract, shard-boundary
 framing edge cases, golden byte-/parse-parity with single-process
-``parse_blob``, worker modes, and the service ``feeder_workers`` key.
+``parse_blob``, worker modes, the service ``feeder_workers`` key, and
+(round 10) the zero-copy shared-memory ring transport: slot wraparound,
+exhaustion backpressure, arena cleanup, transport selection, and golden
+parity ring-vs-pickle.
 
 The planner's contract is the reference InputFormat's split semantics:
 a line belongs to the shard where its FIRST byte lies, healed payloads
 of consecutive shards tile the corpus exactly, and per-shard framing is
-byte-identical to one-shot framing of the whole corpus.
+byte-identical to one-shot framing of the whole corpus — on EVERY
+transport.
 """
 import os
 
@@ -17,10 +21,13 @@ from logparser_tpu.feeder import (
     EncodedBatch,
     FeederError,
     FeederPool,
+    RingBatch,
     healed_payload,
     line_start_at_or_after,
     normalize_sources,
     plan_shards,
+    resolve_transport,
+    ring_available,
     split_batches,
 )
 from logparser_tpu.native import encode_blob
@@ -134,13 +141,17 @@ def test_split_batches_line_aligned():
 # ---------------------------------------------------------------------------
 
 
-def _assert_framing_parity(blob, shard_bytes, batch_lines=3, line_len=64):
+def _assert_framing_parity(blob, shard_bytes, batch_lines=3, line_len=64,
+                           transport=None, ring_slots=None):
     """Sharded multi-worker framing must be byte-identical to one-shot
-    encode_blob (parse_blob's framer) over the same corpus."""
+    encode_blob (parse_blob's framer) over the same corpus — on every
+    transport (the ring variant reruns the boundary sweeps over
+    shared-memory slots)."""
     ref_buf, ref_lengths, ref_overflow = encode_blob(blob, line_len=line_len)
     pool = FeederPool([blob], workers=2, shard_bytes=shard_bytes,
                       batch_lines=batch_lines, line_len=line_len,
-                      use_processes=False)
+                      use_processes=False, transport=transport,
+                      ring_slots=ring_slots)
     ebs = list(pool.batches())
     assert [e.order_key for e in ebs] == sorted(e.order_key for e in ebs)
     assert b"".join(e.payload for e in ebs) == blob
@@ -171,23 +182,31 @@ def test_framing_shard_ends_exactly_on_newline():
     _assert_framing_parity(blob, shard_bytes=4)
 
 
-def test_framing_line_longer_than_a_shard():
+@pytest.mark.parametrize("transport", [None, "ring"])
+def test_framing_line_longer_than_a_shard(transport):
     blob = b"short\n" + b"L" * 200 + b"\nshort2\n" + b"M" * 90
     for shard_bytes in (16, 32, 64):
         # line_len=64 also forces overflow rows (200 > 64): truncation +
-        # overflow-index parity across the sharded path.
-        _assert_framing_parity(blob, shard_bytes=shard_bytes)
+        # overflow-index parity across the sharded path (ring variant:
+        # the in-place overflow-bit strip in the slot lengths).
+        _assert_framing_parity(blob, shard_bytes=shard_bytes,
+                               transport=transport, ring_slots=2)
 
 
-def test_framing_crlf_at_the_boundary():
+@pytest.mark.parametrize("transport", [None, "ring"])
+def test_framing_crlf_at_the_boundary(transport):
     blob = b"aaa\r\nbbb\r\nccc\r\nddd\r"
     for shard_bytes in range(1, len(blob) + 1):
-        _assert_framing_parity(blob, shard_bytes=shard_bytes)
+        _assert_framing_parity(blob, shard_bytes=shard_bytes,
+                               transport=transport, ring_slots=2)
 
 
-def test_framing_empty_lines_and_trailing_newline():
-    _assert_framing_parity(b"\n\nx\n\n", shard_bytes=2)
-    _assert_framing_parity(b"x\ny\n", shard_bytes=3)
+@pytest.mark.parametrize("transport", [None, "ring"])
+def test_framing_empty_lines_and_trailing_newline(transport):
+    _assert_framing_parity(b"\n\nx\n\n", shard_bytes=2,
+                           transport=transport, ring_slots=2)
+    _assert_framing_parity(b"x\ny\n", shard_bytes=3,
+                           transport=transport, ring_slots=2)
 
 
 # ---------------------------------------------------------------------------
@@ -356,3 +375,319 @@ def test_service_small_batches_skip_the_feeder():
             table = client.parse(_demolog(16, seed=13))
     assert table.num_rows == 16
     assert metrics().get("service_feeder_requests_total") == before
+
+
+# ---------------------------------------------------------------------------
+# ring transport (round 10): slot mechanics, backpressure, cleanup, parity
+# ---------------------------------------------------------------------------
+
+pytestmark_ring = pytest.mark.skipif(
+    not ring_available(), reason="multiprocessing.shared_memory unavailable"
+)
+
+
+def _ring_pool(blob, **kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("shard_bytes", 3000)
+    kw.setdefault("batch_lines", 64)
+    kw.setdefault("line_len", 64)
+    kw.setdefault("use_processes", False)
+    kw.setdefault("transport", "ring")
+    return FeederPool([blob], **kw)
+
+
+def _ring_segments():
+    from logparser_tpu.feeder import RING_NAME_PREFIX
+
+    if not os.path.isdir("/dev/shm"):
+        return None
+    return {f for f in os.listdir("/dev/shm")
+            if f.startswith(RING_NAME_PREFIX)}
+
+
+@pytestmark_ring
+def test_ring_slot_wraparound_byte_parity():
+    """Far more batches than slots: every slot recycles many times and
+    the delivered stream is still byte-identical to one-shot framing
+    (stale slot contents never bleed into a recycled batch)."""
+    blob = b"\n".join(b"row %06d with some filler text" % i
+                      for i in range(2000))
+    ref_buf, ref_lengths, _ = encode_blob(blob, line_len=64)
+    pool = _ring_pool(blob, ring_slots=2, batch_lines=32)
+    ebs = list(pool.batches())
+    assert pool.stats()["transport"] == "ring"
+    assert len(ebs) > 4 * pool.ring_slots * pool.workers  # real wraparound
+    assert b"".join(bytes(e.payload) for e in ebs) == blob
+    np.testing.assert_array_equal(
+        np.concatenate([e.buf for e in ebs]), ref_buf
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([e.lengths for e in ebs]), ref_lengths
+    )
+    assert pool.stats()["pickle_fallback_batches"] == 0
+
+
+@pytestmark_ring
+def test_ring_exhaustion_blocks_producer_without_dropping():
+    """Slot exhaustion IS the backpressure: with every slot leased the
+    producer stalls (no drop, no error), and releasing one slot lets
+    exactly the stream continue — all batches eventually arrive."""
+    import threading
+
+    blob = b"\n".join(b"line %04d" % i for i in range(400))
+    pool = _ring_pool(blob, workers=1, shard_bytes=1 << 20, batch_lines=16,
+                      ring_slots=2)
+    it = pool.batches(detach=False)
+    held = [next(it), next(it)]  # every slot in the (1-worker) ring leased
+    assert all(isinstance(e, RingBatch) for e in held)
+
+    got = []
+    grabbed = threading.Event()
+
+    def grab():
+        got.append(next(it))
+        grabbed.set()
+
+    t = threading.Thread(target=grab, daemon=True)
+    t.start()
+    # The producer owns no free slot: the consumer side cannot advance.
+    assert not grabbed.wait(0.4)
+    held.pop(0).release()  # one slot back -> exactly one batch flows
+    assert grabbed.wait(5.0)
+    # Both slots are leased again (held[0] + got[0]) — give them back,
+    # then drain releasing as we go: nothing was dropped, the whole
+    # corpus crossed, in order, through 2 recycling slots.
+    held.pop(0).release()
+    got[0].release()
+    rest = []
+    for eb in it:
+        rest.append(bytes(eb.payload))
+        eb.release()
+    from logparser_tpu.observability import metrics
+
+    assert metrics().get("feeder_ring_slot_wait_seconds_total") > 0
+    assert pool.stats()["payload_bytes"] == len(blob)
+    assert pool.stats()["batches"] == len(rest) + 3
+
+
+@pytestmark_ring
+def test_ring_slot_overflow_falls_back_to_pickle_per_batch():
+    """A batch that outgrows its slot ships over the pickled lane — the
+    stream stays complete and byte-identical, and the fallback is
+    counted (the ring degrades per batch, never wholesale)."""
+    big = b"X" * 3000  # one line far beyond the tiny slot below
+    blob = b"aaa\nbbb\n" + big + b"\nccc"
+    ref_buf, ref_lengths, _ = encode_blob(blob, line_len=4096)
+    pool = _ring_pool(blob, workers=1, shard_bytes=1 << 20, batch_lines=1,
+                      line_len=4096, slot_bytes=4096, ring_slots=2)
+    ebs = list(pool.batches())
+    assert b"".join(bytes(e.payload) for e in ebs) == blob
+    np.testing.assert_array_equal(
+        np.concatenate([e.buf for e in ebs]), ref_buf
+    )
+    stats = pool.stats()
+    assert stats["pickle_fallback_batches"] >= 1
+    from logparser_tpu.observability import metrics
+
+    assert metrics().get("feeder_ring_pickle_fallback_total") >= 1
+
+
+@pytestmark_ring
+def test_ring_arena_cleanup_on_close():
+    """Normal teardown unlinks every arena segment this pool created."""
+    before = _ring_segments()
+    if before is None:
+        pytest.skip("no /dev/shm to observe")
+    blob = b"\n".join(b"line %d" % i for i in range(100))
+    pool = _ring_pool(blob)
+    list(pool.batches())
+    after = _ring_segments()
+    assert after - before == set()
+
+
+@pytestmark_ring
+def test_ring_abandoned_stream_cleans_up():
+    """An abandoned (not fully drained) feed stream still winds the
+    fabric down: close() unlinks arenas even with slots leased."""
+    before = _ring_segments()
+    if before is None:
+        pytest.skip("no /dev/shm to observe")
+    blob = b"\n".join(b"line %04d" % i for i in range(600))
+    pool = _ring_pool(blob, ring_slots=2, batch_lines=16)
+    it = pool.batches(detach=False)
+    next(it)  # lease one slot, then walk away
+    it.close()
+    pool.close()
+    after = _ring_segments()
+    assert after - before == set()
+
+
+@pytest.mark.slow
+@pytestmark_ring
+def test_ring_consumer_crash_leaves_no_segments(tmp_path):
+    """A consumer process that dies WITHOUT closing the pool must not
+    leak /dev/shm segments: the resource tracker (which survives the
+    crash) unlinks the arenas the consumer registered at create time."""
+    import subprocess
+    import sys
+
+    if not os.path.isdir("/dev/shm"):
+        pytest.skip("no /dev/shm to observe")
+    script = tmp_path / "crash_consumer.py"
+    script.write_text(
+        "import os\n"
+        "from logparser_tpu.feeder import FeederPool, RING_NAME_PREFIX\n"
+        "if __name__ == '__main__':\n"  # forkserver re-imports __main__
+        "    blob = b'\\n'.join(b'line %d' % i for i in range(2000))\n"
+        "    pool = FeederPool([blob], workers=2, shard_bytes=3000,\n"
+        "                      batch_lines=32, line_len=64,\n"
+        "                      use_processes=True, transport='ring')\n"
+        "    it = pool.batches(detach=False)\n"
+        "    next(it)\n"
+        "    segs = [f for f in os.listdir('/dev/shm')\n"
+        "            if f.startswith(RING_NAME_PREFIX)]\n"
+        "    assert segs, 'arenas should exist while the pool runs'\n"
+        "    print('LIVE', len(segs), flush=True)\n"
+        "    os._exit(42)\n"  # no close(), no atexit: a crash
+    )
+    before = _ring_segments()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, str(script)], env=env, capture_output=True,
+        text=True, timeout=180,
+    )
+    assert proc.returncode == 42, proc.stderr
+    assert "LIVE" in proc.stdout
+    # The tracker reaps asynchronously after the process dies.
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        leaked = _ring_segments() - before
+        if not leaked:
+            break
+        time.sleep(0.5)
+    assert _ring_segments() - before == set(), "leaked shm segments"
+
+
+@pytestmark_ring
+@pytest.mark.parametrize("workers", [1, 3])
+@pytest.mark.parametrize("shard_bytes", [30_000, 1 << 20])
+def test_ring_feed_parity_with_parse_blob_and_pickle(workers, shard_bytes):
+    """Acceptance bar (round 10): feeder output over the RING transport
+    is byte-identical to single-process parse_blob AND to the pickled
+    transport, >= 2 worker counts x >= 2 shard sizes — spans, typed
+    columns, validity, counters, and the retained rescue payload (the
+    demolog garbage fraction forces oracle-rescued rows, which read the
+    payload in place from the slot)."""
+    import pyarrow as pa
+
+    parser = shared_parser("combined", FIELDS)
+    blob = "\n".join(_demolog(512)).encode()
+    ref = parser.parse_blob(blob)
+    ref_table = ref.to_arrow(include_validity=True, strings="copy")
+
+    tallies = {}
+    for transport in ("ring", "pickle"):
+        pool = FeederPool([blob], workers=workers, shard_bytes=shard_bytes,
+                          batch_lines=512, use_processes=False,
+                          transport=transport, ring_slots=3)
+        tables = []
+        oracle_rows = bad_lines = lines_read = 0
+        for result in pool.feed(parser):
+            tables.append(
+                result.to_arrow(include_validity=True, strings="copy")
+            )
+            oracle_rows += result.oracle_rows
+            bad_lines += result.bad_lines
+            lines_read += result.lines_read
+        table = pa.concat_tables(tables).combine_chunks()
+        assert table.equals(ref_table.combine_chunks()), transport
+        tallies[transport] = (lines_read, oracle_rows, bad_lines)
+    assert tallies["ring"] == tallies["pickle"] == (
+        ref.lines_read, ref.oracle_rows, ref.bad_lines
+    )
+
+
+@pytestmark_ring
+def test_ring_detach_and_parse_encoded():
+    """batches() detaches by default: the yielded batches own their
+    arrays (safe to hold all of them) and parse_encoded over a detached
+    batch equals parse_blob."""
+    parser = shared_parser("combined", FIELDS)
+    blob = "\n".join(_demolog(64, seed=8)).encode()
+    pool = FeederPool([blob], workers=1, shard_bytes=1 << 20,
+                      batch_lines=1024, use_processes=False,
+                      transport="ring")
+    (eb,) = list(pool.batches())
+    assert isinstance(eb, EncodedBatch) and not isinstance(eb, RingBatch)
+    got = parser.parse_encoded(eb)
+    ref = parser.parse_blob(blob)
+    assert got.to_arrow(strings="copy").equals(ref.to_arrow(strings="copy"))
+
+
+def test_transport_resolution_and_escape_hatch(monkeypatch):
+    """LOGPARSER_TPU_FEEDER_PICKLE=1 wins over everything; otherwise
+    explicit requests are honored and the defaults are ring (process) /
+    inline (thread)."""
+    from logparser_tpu.feeder import PICKLE_ENV
+
+    monkeypatch.delenv(PICKLE_ENV, raising=False)
+    if ring_available():
+        assert resolve_transport(None, "process") == "ring"
+    assert resolve_transport(None, "thread") == "inline"
+    assert resolve_transport("pickle", "process") == "pickle"
+    assert resolve_transport("ring", "thread") == (
+        "ring" if ring_available() else "inline"
+    )
+    with pytest.raises(ValueError):
+        resolve_transport("carrier-pigeon", "process")
+    monkeypatch.setenv(PICKLE_ENV, "1")
+    assert resolve_transport(None, "process") == "pickle"
+    assert resolve_transport("ring", "process") == "pickle"
+    assert resolve_transport("ring", "thread") == "inline"
+
+
+def test_pickle_escape_hatch_end_to_end(monkeypatch):
+    """The escape hatch selects the old transport and the parity suite's
+    bar still holds over it (threads fallback keeps working unchanged)."""
+    from logparser_tpu.feeder import PICKLE_ENV
+
+    monkeypatch.setenv(PICKLE_ENV, "1")
+    parser = shared_parser("combined", FIELDS)
+    blob = "\n".join(_demolog(128, seed=3)).encode()
+    pool = FeederPool([blob], workers=2, shard_bytes=4000, batch_lines=64,
+                      use_processes=False, transport="ring")
+    import pyarrow as pa
+
+    tables = [r.to_arrow(include_validity=True, strings="copy")
+              for r in pool.feed(parser)]
+    assert pool.stats()["transport"] == "inline"
+    table = pa.concat_tables(tables).combine_chunks()
+    ref = parser.parse_blob(blob).to_arrow(
+        include_validity=True, strings="copy"
+    ).combine_chunks()
+    assert table.equals(ref)
+
+
+def test_stream_staged_h2d_parity():
+    """The double-buffered H2D edge changes scheduling, never results:
+    staged and unstaged streams produce identical tables over the same
+    batches, and the staged path accounts its upload bytes."""
+    import pyarrow as pa
+
+    from logparser_tpu.observability import metrics
+
+    parser = shared_parser("combined", FIELDS)
+    lines = _demolog(256, seed=21)
+    batches = [lines[i : i + 64] for i in range(0, len(lines), 64)]
+    before = metrics().get("h2d_staged_bytes_total")
+    staged = [r.to_arrow(strings="copy")
+              for r in parser.parse_batch_stream(batches, stage_h2d=True)]
+    assert metrics().get("h2d_staged_bytes_total") > before
+    unstaged = [r.to_arrow(strings="copy")
+                for r in parser.parse_batch_stream(batches, stage_h2d=False)]
+    for a, b in zip(staged, unstaged):
+        assert a.equals(b)

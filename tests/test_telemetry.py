@@ -481,3 +481,73 @@ def test_metrics_endpoint_exposes_feeder_families():
     ):
         assert needle in text, f"/metrics missing {needle}"
     assert validate_exposition(text) == []
+
+
+def test_metrics_endpoint_exposes_ring_families():
+    """The ring transport's counter families (docs/OBSERVABILITY.md,
+    round 10) reach /metrics once a ring pool has run: per-worker slot
+    backpressure wait, in-place (pipe-bypassing) bytes, and — after a
+    device-fed stream — the staged-H2D upload bytes."""
+    import pytest
+
+    from logparser_tpu.feeder import FeederPool, ring_available
+    from logparser_tpu.service import MetricsEndpoint
+
+    if not ring_available():
+        pytest.skip("multiprocessing.shared_memory unavailable")
+    blob = b"\n".join(b"line %d" % i for i in range(200))
+    pool = FeederPool([blob], workers=1, shard_bytes=1 << 20, batch_lines=8,
+                      line_len=64, use_processes=False, transport="ring",
+                      ring_slots=2)
+    drained = sum(eb.source_bytes for eb in pool.batches())
+    assert drained == len(blob)
+    assert pool.stats()["bytes_inplace"] > 0
+    assert metrics().get("feeder_ring_bytes_inplace_total") > 0
+    endpoint = MetricsEndpoint().start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{endpoint.port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode("utf-8")
+    finally:
+        endpoint.shutdown()
+    for needle in (
+        "logparser_tpu_feeder_ring_slot_wait_seconds_total",
+        "logparser_tpu_feeder_ring_bytes_inplace_total",
+    ):
+        assert needle in text, f"/metrics missing {needle}"
+    assert validate_exposition(text) == []
+
+
+def test_process_mode_queue_depth_gauge_is_live():
+    """Round-10 satellite: process workers cannot update the parent's
+    registry, so depth is exported via shared put-counters — the gauge
+    must rise under a stalled process-mode consumer (the round-8 gap:
+    qsize()-less platforms read a dead gauge)."""
+    import pytest
+
+    from logparser_tpu.feeder import FeederPool
+
+    blob = b"\n".join(b"line %d" % i for i in range(64))
+    pool = FeederPool([blob], workers=1, shard_bytes=1 << 20,
+                      batch_lines=4, line_len=64, queue_batches=2,
+                      use_processes=True, ring_slots=2)
+    try:
+        stream = pool.batches()
+        try:
+            next(stream)  # prime, then stall the consumer
+        except Exception:
+            pytest.skip("multiprocessing unavailable in this environment")
+        assert pool.mode == "process"
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if pool._queue_depth() >= 1:
+                break
+            time.sleep(0.02)
+        assert pool._queue_depth() >= 1, (
+            "shared put-counter depth never rose under a stalled consumer"
+        )
+        list(stream)  # drain; exhaustion closes the pool
+        assert metrics().gauge_get("feeder_queue_depth") == 0
+    finally:
+        pool.close()
