@@ -1,7 +1,7 @@
 # Dev entry points (the reference's Maven/devtools tier, L0).
 PY ?= python
 
-.PHONY: test test-fast metrics-smoke feeder-smoke rescue-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -34,6 +34,16 @@ metrics-smoke:
 # metrics-smoke.
 feeder-smoke:
 	$(PY) -m logparser_tpu.tools.feeder_smoke
+
+# Chaos smoke: the fault-injection matrix (every fault class in
+# tools/chaos.py x ring+pickle transports at 2 real process workers) —
+# every faulted run must RECOVER to byte parity with the corpus (worker
+# respawn + shard replay, poison-shard quarantine, ring-fault re-frame,
+# transport demotion), the recovery ledger counters must move, and no
+# /dev/shm segment may leak (docs/FEEDER.md "Failure model & recovery").
+# CI runs this after feeder-smoke.
+chaos-smoke:
+	$(PY) -m logparser_tpu.tools.chaos_smoke
 
 # Rescue smoke: dirty corpus with forced ~5% device rejects — the former
 # overflow class must stay on device (full-int64 decoder), the forced

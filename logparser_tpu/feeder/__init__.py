@@ -14,9 +14,14 @@ device-ready batches so the device tier is never input-starved
   backpressure);
 - :mod:`~logparser_tpu.feeder.pool` — :class:`FeederPool`, the consumer
   API: ``batches()`` (ordered EncodedBatch stream with backpressure)
-  and ``feed(parser)`` (BatchResults via ``parse_batch_stream``).
+  and ``feed(parser)`` (BatchResults via ``parse_batch_stream``);
+- :mod:`~logparser_tpu.feeder.supervisor` — the fault-recovery policy:
+  bounded worker respawn with shard replay, poison-shard quarantine,
+  and the ring -> pickle -> inline transport demotion ladder (armed by
+  default; exercised on purpose by ``tools/chaos.py``).
 """
 from .pool import (  # noqa: F401
+    CHAOS_ENV,
     DEFAULT_BATCH_LINES,
     PICKLE_ENV,
     FeederError,
@@ -27,12 +32,20 @@ from .pool import (  # noqa: F401
 from .ring import (  # noqa: F401
     RING_NAME_PREFIX,
     RingBatch,
+    RingFault,
     SlotFrame,
     SlotOverflow,
     SlotRing,
     SlotWriter,
     ring_available,
     slot_layout,
+)
+from .supervisor import (  # noqa: F401
+    Decision,
+    FeederSupervisor,
+    SupervisorPolicy,
+    WorkerFault,
+    demote_transport,
 )
 from .shards import (  # noqa: F401
     DEFAULT_SHARD_BYTES,

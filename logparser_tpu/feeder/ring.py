@@ -57,7 +57,11 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-from .worker import EncodedBatch
+import logging
+
+from .worker import EncodedBatch, note_teardown_error
+
+LOG = logging.getLogger(__name__)
 
 #: Slot alignment: keeps every slot's int32 lengths view 4-byte aligned
 #: (and leaves room for wider frame dtypes later).
@@ -104,6 +108,33 @@ class SlotOverflow(Exception):
     """The framed batch does not fit one slot (fall back to pickle)."""
 
 
+class RingFault(RuntimeError):
+    """A descriptor failed map-time validation.  ``reason``:
+
+    - ``"generation"``: the descriptor's slot-use generation does not
+      match the consumer's ledger — a slot-reuse race or a stale/
+      duplicated descriptor; the slot's contents cannot be trusted;
+    - ``"descriptor"``: structurally invalid fields (slot id out of
+      range, negative shapes, a layout that exceeds the slot).
+
+    The supervised pool recovers per batch (in-process re-frame of the
+    expected batch — delivery order makes it unambiguous) and demotes
+    the worker off the ring past ``ring_fault_threshold`` faults; an
+    unsupervised pool surfaces the fault as a FeederError instead of
+    handing corrupt bytes downstream.
+
+    ``stale`` marks the generation sub-case where the descriptor's
+    generation is BEHIND the ledger: a replay of a send already mapped
+    and delivered.  Re-framing that batch would duplicate it in the
+    stream and releasing its slot would double-free a lease someone
+    else may hold — the pool DROPS a stale descriptor instead."""
+
+    def __init__(self, reason: str, detail: str = "", stale: bool = False):
+        super().__init__(f"ring fault ({reason}): {detail}")
+        self.reason = reason
+        self.stale = stale
+
+
 def slot_layout(n: int, line_len: int, payload_len: int) -> Tuple[int, int, int]:
     """(buf_offset, payload_offset, total_bytes) of one framed batch
     inside its slot — the single layout definition writer and reader
@@ -129,6 +160,13 @@ class SlotFrame:
     read_s: float = 0.0
     encode_s: float = 0.0
     slot_wait_s: float = 0.0    # time the worker blocked acquiring the slot
+    #: Slot-use generation: how many descriptors have been SENT for this
+    #: slot before this one.  The consumer keeps its own per-slot ledger
+    #: of mapped descriptors; since every sent descriptor is mapped
+    #: exactly once and in order, the two agree unless a reuse race, a
+    #: duplicate, or corruption intervened — verified in SlotRing.map,
+    #: counted as feeder_ring_generation_mismatch_total by the pool.
+    generation: int = 0
 
 
 @dataclass
@@ -188,6 +226,10 @@ class SlotWriter:
 
     def __init__(self, spec: RingSpec, shm: Any = None):
         self.spec = spec
+        # Per-slot count of descriptors SENT (not merely acquired:
+        # overflow/stop putbacks send nothing and must not advance the
+        # generation the consumer's ledger expects).
+        self._sent = [0] * spec.n_slots
         self._owns_attach = shm is None
         if shm is None:
             # Attaching registers with the resource tracker too (pre-3.13
@@ -216,6 +258,15 @@ class SlotWriter:
     def putback(self, slot: int) -> None:
         """Return an acquired-but-unused slot (overflow/stop paths)."""
         self.spec.free_q.put(slot)
+
+    def next_generation(self, slot: int) -> int:
+        """The generation a descriptor for ``slot`` must carry NOW
+        (descriptors sent so far); advance with :meth:`note_sent` only
+        after the descriptor actually crossed the queue."""
+        return self._sent[slot]
+
+    def note_sent(self, slot: int) -> None:
+        self._sent[slot] += 1
 
     def frame(self, chunk, line_len: int, slot: int):
         """Frame ``chunk`` (one batch's raw line bytes) directly into
@@ -257,8 +308,8 @@ class SlotWriter:
         if self._owns_attach:
             try:
                 self.shm.close()
-            except Exception:  # noqa: BLE001 — teardown is best-effort
-                pass
+            except Exception as e:  # noqa: BLE001 — teardown is best-effort
+                note_teardown_error(LOG, "SlotWriter.close", e)
 
 
 class SlotRing:
@@ -267,7 +318,7 @@ class SlotRing:
     views, recycles released slots, and unlinks on close."""
 
     def __init__(self, slot_bytes: int, n_slots: int, free_q: Any,
-                 name_hint: str = ""):
+                 name_hint: str = "", prefault: bool = True):
         shm_cls = _shared_memory_cls()
         if slot_bytes % SLOT_ALIGN:
             slot_bytes += SLOT_ALIGN - slot_bytes % SLOT_ALIGN
@@ -293,8 +344,16 @@ class SlotRing:
         # instead of as major faults inside the workers' first framing
         # passes — the difference between a warm ring and one that pays
         # page-allocation latency for its first n_slots batches.
-        np.frombuffer(shm.buf, dtype=np.uint8)[:] = 0
+        # ``prefault=False`` skips it (supervised respawns: the rebuild
+        # happens MID-RUN with the consumer waiting, so lazy faults —
+        # overlapped with worker framing — beat a serial multi-MB zero
+        # pass).
+        if prefault:
+            np.frombuffer(shm.buf, dtype=np.uint8)[:] = 0
         self._closed = False
+        # Consumer-side generation ledger: descriptors MAPPED per slot
+        # (the counterpart of SlotWriter._sent — see SlotFrame.generation).
+        self._gen = [0] * self.n_slots
         for slot in range(self.n_slots):
             free_q.put(slot)
 
@@ -303,12 +362,50 @@ class SlotRing:
                         self.free_q)
 
     def map(self, f: SlotFrame) -> RingBatch:
-        """One descriptor -> zero-copy RingBatch over the slot's views."""
+        """One descriptor -> zero-copy RingBatch over the slot's views.
+
+        Validates the descriptor FIRST (:class:`RingFault`): a corrupt
+        slot id or layout would otherwise read out of the arena, and a
+        stale generation would silently deliver a recycled slot's bytes
+        as this batch's."""
+        if not (0 <= f.slot < self.n_slots):
+            raise RingFault(
+                "descriptor", f"slot {f.slot} outside [0, {self.n_slots})"
+            )
+        # A descriptor carrying generation >= the ledger is a SEND not
+        # yet consumed — it advances the ledger whether it maps or
+        # faults below, so a faulted slot's next legitimate descriptor
+        # still maps cleanly once the pool releases the slot back.  One
+        # carrying generation < the ledger is a replay of a send already
+        # consumed (stale duplicate): its generation was counted when
+        # the original mapped, so the ledger must NOT move again.
+        expected = self._gen[f.slot]
+        if f.generation >= expected:
+            self._gen[f.slot] += 1
+        if f.n_lines < 0 or f.line_len < 0 or f.payload_len < 0:
+            raise RingFault(
+                "descriptor",
+                f"negative shape (n={f.n_lines}, L={f.line_len}, "
+                f"payload={f.payload_len})",
+            )
         base = f.slot * self.slot_bytes
         n = max(f.n_lines, 1)
-        buf_off, payload_off, _total = slot_layout(
+        buf_off, payload_off, total = slot_layout(
             n, f.line_len, f.payload_len
         )
+        if total > self.slot_bytes:
+            raise RingFault(
+                "descriptor",
+                f"layout needs {total}B > slot_bytes={self.slot_bytes}",
+            )
+        if f.generation != expected:
+            raise RingFault(
+                "generation",
+                f"slot {f.slot} descriptor generation {f.generation} != "
+                f"expected {expected} (slot-reuse race or stale "
+                "descriptor)",
+                stale=f.generation < expected,
+            )
         mv = self.shm.buf
         lengths = np.frombuffer(
             mv, dtype=np.int32, count=n, offset=base
@@ -338,8 +435,8 @@ class SlotRing:
         if not self._closed:
             try:
                 self.free_q.put(slot)
-            except Exception:  # noqa: BLE001 — queue torn down mid-release
-                pass
+            except Exception as e:  # noqa: BLE001 — queue torn down mid-release
+                note_teardown_error(LOG, "SlotRing.release", e)
 
     def inplace_bytes(self, f: SlotFrame) -> int:
         """Bytes this descriptor delivered through the arena instead of
@@ -362,11 +459,13 @@ class SlotRing:
         except BufferError:
             # Live RingBatch views pin the mapping: the segment still
             # gets unlinked below (names never leak); the mapping itself
-            # goes when the last view does.
+            # goes when the last view does.  Expected, not counted.
             pass
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001
+            note_teardown_error(LOG, "SlotRing.close", e)
         try:
             self.shm.unlink()
-        except Exception:  # noqa: BLE001 — already unlinked (tracker)
-            pass
+        except FileNotFoundError:
+            pass  # already unlinked (resource tracker beat us to it)
+        except Exception as e:  # noqa: BLE001
+            note_teardown_error(LOG, "SlotRing.unlink", e)

@@ -27,15 +27,37 @@ never acquire the device.
 """
 from __future__ import annotations
 
+import logging
 import time
 import traceback
 from dataclasses import dataclass, field
 from queue import Full
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .shards import Shard, _Source, read_shard_payload
+
+
+def note_teardown_error(logger: logging.Logger, site: str,
+                        exc: BaseException) -> None:
+    """Teardown/cleanup failures must not be silent: count them
+    (``feeder_teardown_errors_total{site=...}``) and warn once per
+    distinct message — a leak-shaped failure (unlinkable arena, wedged
+    queue) repeated across pools is exactly the drip a long-lived host
+    needs to see.  Shared by pool.py and ring.py; in a WORKER process
+    the counter lands in the child's registry (invisible to the
+    consumer) but the warning still reaches its stderr."""
+    from ..observability import log_warning_once, metrics
+
+    metrics().increment(
+        "feeder_teardown_errors_total", labels={"site": site}
+    )
+    log_warning_once(
+        logger,
+        f"feeder teardown: {site} failed "
+        f"({type(exc).__name__}: {exc})",
+    )
 
 # Queue message kinds (worker -> consumer).
 MSG_BATCH = "batch"          # pickled EncodedBatch body
@@ -116,6 +138,8 @@ def run_worker(
     ring=None,
     puts=None,
     watch_parent: bool = False,
+    resume: Optional[Dict[int, int]] = None,
+    chaos=None,
 ) -> None:
     """Read + frame this worker's shards, in shard order, into ``out_q``.
 
@@ -132,8 +156,25 @@ def run_worker(
     registry).  ``watch_parent`` arms the orphan watch — process
     workers only: there ``mp.parent_process()`` IS the consumer, while
     a thread worker's is whatever spawned the consumer, and that dying
-    says nothing about the consumer's health."""
+    says nothing about the consumer's health.
+
+    ``resume`` maps global shard index -> number of leading batches to
+    SKIP — how a respawned worker replays a partially-delivered shard
+    from the last delivered batch boundary (``split_batches`` is
+    deterministic over (payload, batch_lines), so the replayed suffix
+    is byte-identical to what the dead incarnation would have sent).
+    Batch indices keep their original values.  ``chaos`` is an optional
+    :class:`~logparser_tpu.tools.chaos.ChaosSpec` arming the
+    fault-injection hooks (parsed by the pool — env vars do not reach
+    forkserver children reliably)."""
     from ..native import encode_blob
+
+    hard_exit: Tuple = ()
+    if chaos is not None:
+        from ..tools.chaos import WorkerChaos, _ChaosHardExit
+
+        hard_exit = (_ChaosHardExit,)
+        chaos = WorkerChaos(chaos, worker_id, is_process=watch_parent)
 
     writer = None
     if ring is not None:
@@ -144,6 +185,8 @@ def run_worker(
     stop = _StopWatch(stop_event, watch_parent=watch_parent)
 
     def put(item) -> bool:
+        if chaos is not None:
+            chaos.before_put()
         while True:
             if stop.is_set():
                 return False
@@ -168,6 +211,8 @@ def run_worker(
             slot, wait_s = got
             t0 = time.perf_counter()
             try:
+                if chaos is not None and chaos.force_overflow():
+                    raise SlotOverflow("chaos: forced slot overflow")
                 n, L, overflow = writer.frame(chunk, line_len, slot)
             except SlotOverflow:
                 # This one batch outgrew the slot (pathological line
@@ -183,10 +228,14 @@ def run_worker(
                     read_s=read_share,
                     encode_s=time.perf_counter() - t0,
                     slot_wait_s=wait_s,
+                    generation=writer.next_generation(slot),
                 )
+                if chaos is not None:
+                    chaos.corrupt(desc)
                 if not put((MSG_SLOT, desc)):
                     writer.putback(slot)
                     return False
+                writer.note_sent(slot)
                 return True
         else:
             wait_s = 0.0
@@ -210,6 +259,9 @@ def run_worker(
 
     try:
         for shard in shards:
+            skip = resume.get(shard.index, 0) if resume else 0
+            if chaos is not None:
+                chaos.on_shard_start(shard.index)
             t_shard = time.perf_counter()
             t0 = time.perf_counter()
             payload = read_shard_payload(sources[shard.source], shard)
@@ -218,12 +270,20 @@ def run_worker(
             shard_lines = 0
             read_share = read_s / max(1, len(ranges))
             for bi, (p0, p1) in enumerate(ranges):
+                if bi < skip:
+                    continue  # replay: already delivered by a previous life
+                if chaos is not None:
+                    chaos.before_batch()
                 chunk = payload[p0:p1]
                 if not emit_batch(shard, bi, chunk, read_share):
                     return
+                if chaos is not None:
+                    chaos.after_emit()
                 shard_lines += _count_lines(chunk)
                 if delay_s:
                     time.sleep(delay_s)
+            if chaos is not None and chaos.drop_done(shard.index):
+                return  # injected protocol stall: vanish without DONE
             if not put((
                 MSG_SHARD_DONE,
                 shard.index,
@@ -233,6 +293,8 @@ def run_worker(
             )):
                 return
         put((MSG_DONE, worker_id))
+    except hard_exit:
+        return  # injected hard crash (thread flavor): no relay, no DONE
     except Exception:  # noqa: BLE001 — relay to the consumer, never die silent
         try:
             put((MSG_ERROR, worker_id, traceback.format_exc()))

@@ -20,7 +20,11 @@ Wire protocol (deliberately trivial to implement from any language):
                        large LINES payloads through the sharded feeder
                        fabric — N threads frame disjoint byte-range shards
                        in parallel; the ARROW frame is unchanged in shape
-                       and content, docs/FEEDER.md),
+                       and content, docs/FEEDER.md.  The fabric degrades,
+                       never drops: a feeder failure re-parses the request
+                       inline and demotes the session to inline parsing
+                       for its remaining frames,
+                       service_feeder_demotions_total),
                        "stats": bool (optional; true = one STATS JSON frame
                        after each ARROW frame — v1 sessions that omit the
                        key get byte-identical v1 behavior)}
@@ -63,6 +67,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from .observability import (
     log_version_banner_once,
+    log_warning_once,
     metrics,
     suppressed_warning_counts,
 )
@@ -256,17 +261,49 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                     )
                 blob_shape = count and blob and not blob.endswith(b"\n") \
                     and b"\r" not in blob
+                table = None
                 if blob_shape and feeder_workers >= 2 \
                         and count >= _FEEDER_MIN_LINES:
                     # Sharded-feeder framing: the blob splits into
                     # byte-range shards framed by N threads in parallel;
                     # result tables concatenate back in corpus order
                     # (byte-identical to the inline blob path).
-                    table, oracle_rows, bad_lines = _feeder_parse(
-                        parser, blob, count, feeder_workers
-                    )
-                    metrics().increment("service_feeder_requests_total")
-                else:
+                    try:
+                        table, oracle_rows, bad_lines = _feeder_parse(
+                            parser, blob, count, feeder_workers
+                        )
+                        metrics().increment(
+                            "service_feeder_requests_total")
+                    except Exception as e:  # noqa: BLE001 — degrade, not drop
+                        # ANY feeder-path failure demotes the SESSION:
+                        # its remaining LINES frames parse inline (the
+                        # fabric already self-heals worker crashes, so
+                        # reaching here means even quarantine failed —
+                        # don't re-enter it this session).
+                        from .feeder import FeederError
+
+                        feeder_workers = 0
+                        metrics().increment(
+                            "service_feeder_demotions_total")
+                        log_warning_once(
+                            LOG,
+                            "service: sharded-feeder framing failed "
+                            f"({type(e).__name__}); session demoted to "
+                            "inline parsing",
+                        )
+                        if not isinstance(e, FeederError):
+                            # A parse-shaped failure would fail inline
+                            # too: relay it as a well-formed error frame
+                            # (the session stays alive and its NEXT
+                            # frame takes the inline path).
+                            raise
+                        # A fabric failure with intact input: retry THIS
+                        # request inline below — the client sees an
+                        # error-free ARROW stream, not a dropped
+                        # connection or an error frame.
+                        LOG.error("feeder fabric failed; request "
+                                  "re-parsed inline: %s", e)
+                if table is None:
                     if blob_shape:
                         # (an empty blob is one empty LINE per the
                         # protocol, which blob framing would drop —

@@ -1,0 +1,213 @@
+"""Fault-injection harness for the feeder fabric (docs/FEEDER.md
+"Failure model & recovery").
+
+The supervision layer (``feeder/supervisor.py``) only earns trust if the
+failures it recovers from can be produced ON PURPOSE, deterministically,
+in tests and CI.  This module defines the injection points the feeder
+worker loop consults and the spec grammar that arms them:
+
+    LOGPARSER_TPU_CHAOS="kill_worker:worker=1:after=3;delay_put:seconds=0.01"
+
+A spec is ``;``-separated faults; each fault is a name followed by
+``:key=value`` params.  Faults (params in brackets are optional):
+
+- ``kill_worker:after=N[:worker=W][:mode=hard|soft][:sticky=1]`` — the
+  worker dies after emitting N batches (default 0 = before the first).
+  ``hard`` (default): a process worker ``os._exit``s mid-flight — no
+  error relay, the consumer sees a silently dead producer; a thread
+  worker returns without its DONE messages (threads cannot be killed).
+  ``soft``: raise — the worker relays MSG_ERROR before dying.
+- ``poison_shard:shard=S[:after=N][:mode=hard|soft]`` — die while
+  processing global shard S (after N of its batches).  STICKY by
+  default: respawned workers inherit it, so the shard keeps killing its
+  workers until the supervisor quarantines it — the poison-shard
+  scenario.
+- ``corrupt_descriptor:index=N[:worker=W][:field=generation|slot]`` —
+  scramble the Nth ring slot descriptor this worker sends (0-based);
+  the consumer's map-time validation must catch it.
+- ``slot_overflow[:worker=W][:after=N][:count=M]`` — force
+  :class:`~logparser_tpu.feeder.ring.SlotOverflow` on M consecutive
+  frames (default: every frame — the overflow STORM that demotes the
+  worker off the ring).
+- ``drop_done[:worker=W][:shard=S]`` — swallow the shard-done /
+  worker-done control messages: the worker emits shard S's batches then
+  returns silently (a protocol stall the consumer must detect via the
+  dead producer, not hang on).
+- ``delay_put:seconds=X[:worker=W]`` — sleep X before every queue put
+  (slow/wedged worker; pairs with the supervisor's worker deadline).
+
+``worker=W`` restricts a fault to one worker id (default: all).
+``sticky=1`` makes a fault survive respawns (default only for
+``poison_shard``); everything else fires in the first incarnation only —
+a recovered worker is healthy, which is what lets byte-parity runs
+complete.
+
+The spec travels EXPLICITLY through ``run_worker``'s args (the pool
+parses the env var — or an object passed as ``FeederPool(chaos=...)`` —
+at start time): forkserver children inherit the forkserver's
+environment, not the pool's at spawn time, so an env-only channel would
+silently disarm process-mode faults.  Everything here is jax-free and
+picklable; with no spec armed the worker loop never imports this
+module.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+#: The env var FeederPool consults at start (see module docstring).
+CHAOS_ENV = "LOGPARSER_TPU_CHAOS"
+
+_KNOWN = {
+    "kill_worker", "poison_shard", "corrupt_descriptor",
+    "slot_overflow", "drop_done", "delay_put",
+}
+
+
+class _ChaosHardExit(BaseException):
+    """Thread-worker 'hard' death: unwind run_worker WITHOUT the error
+    relay (BaseException so the worker's ``except Exception`` relay does
+    not catch it — a hard crash sends nothing)."""
+
+
+@dataclass
+class Fault:
+    kind: str
+    params: Dict[str, Any] = field(default_factory=dict)
+    sticky: bool = False
+
+    def param(self, key: str, default: Any = None) -> Any:
+        return self.params.get(key, default)
+
+
+@dataclass
+class ChaosSpec:
+    """A parsed fault plan (picklable — it rides Process args)."""
+
+    faults: List[Fault] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, text: str) -> "ChaosSpec":
+        faults: List[Fault] = []
+        for part in text.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            name, *kvs = part.split(":")
+            name = name.strip()
+            if name not in _KNOWN:
+                raise ValueError(
+                    f"unknown chaos fault {name!r} (known: {sorted(_KNOWN)})"
+                )
+            params: Dict[str, Any] = {}
+            for kv in kvs:
+                k, _, v = kv.partition("=")
+                k = k.strip()
+                v = v.strip()
+                try:
+                    params[k] = int(v)
+                except ValueError:
+                    try:
+                        params[k] = float(v)
+                    except ValueError:
+                        params[k] = v
+            sticky = bool(params.pop("sticky", name == "poison_shard"))
+            faults.append(Fault(name, params, sticky))
+        return cls(faults)
+
+    @classmethod
+    def from_env(cls) -> Optional["ChaosSpec"]:
+        raw = os.environ.get(CHAOS_ENV, "").strip()
+        return cls.parse(raw) if raw else None
+
+    def respawn_view(self) -> Optional["ChaosSpec"]:
+        """The spec a RESPAWNED worker receives: sticky faults only.
+        One-shot faults model transient failures — the respawn is the
+        recovery, so it must not re-fire them."""
+        sticky = [f for f in self.faults if f.sticky]
+        return ChaosSpec(sticky) if sticky else None
+
+
+class WorkerChaos:
+    """Per-worker-incarnation injection state; every hook is a no-op
+    when none of the spec's faults target this worker."""
+
+    def __init__(self, spec: ChaosSpec, worker_id: int, is_process: bool):
+        self.worker_id = worker_id
+        self.is_process = is_process
+        self.faults = [
+            f for f in spec.faults
+            if f.param("worker") is None or f.param("worker") == worker_id
+        ]
+        self.batches_emitted = 0
+        self.shard_emitted = 0
+        self.current_shard = -1
+        self.descriptors_sent = 0
+        self.overflows_forced = 0
+
+    # -- death ----------------------------------------------------------
+
+    def _die(self, mode: str) -> None:
+        if mode == "soft":
+            raise RuntimeError(
+                f"chaos: injected worker {self.worker_id} failure"
+            )
+        if self.is_process:
+            os._exit(23)  # a real crash: no relay, no teardown
+        raise _ChaosHardExit()  # threads: silent unwind, no DONE/ERROR
+
+    def on_shard_start(self, shard_index: int) -> None:
+        self.current_shard = shard_index
+        self.shard_emitted = 0
+
+    def before_batch(self) -> None:
+        """Called before framing each batch — the kill/poison window."""
+        for f in self.faults:
+            if f.kind == "kill_worker" and \
+                    self.batches_emitted >= int(f.param("after", 0)):
+                self._die(f.param("mode", "hard"))
+            if f.kind == "poison_shard" and \
+                    f.param("shard") == self.current_shard and \
+                    self.shard_emitted >= int(f.param("after", 0)):
+                self._die(f.param("mode", "hard"))
+
+    def after_emit(self) -> None:
+        self.batches_emitted += 1
+        self.shard_emitted += 1
+
+    # -- transport-level faults -----------------------------------------
+
+    def before_put(self) -> None:
+        for f in self.faults:
+            if f.kind == "delay_put":
+                time.sleep(float(f.param("seconds", 0.05)))
+
+    def corrupt(self, desc) -> None:
+        """Scramble the targeted descriptor in place (then count it)."""
+        for f in self.faults:
+            if f.kind == "corrupt_descriptor" and \
+                    self.descriptors_sent == int(f.param("index", 0)):
+                if f.param("field", "generation") == "slot":
+                    desc.slot = desc.slot + 1_000_000
+                else:
+                    desc.generation = desc.generation + 1_000_000
+        self.descriptors_sent += 1
+
+    def force_overflow(self) -> bool:
+        for f in self.faults:
+            if f.kind == "slot_overflow" and \
+                    self.batches_emitted >= int(f.param("after", 0)):
+                count = f.param("count")
+                if count is None or self.overflows_forced < int(count):
+                    self.overflows_forced += 1
+                    return True
+        return False
+
+    def drop_done(self, shard_index: int) -> bool:
+        for f in self.faults:
+            if f.kind == "drop_done" and \
+                    f.param("shard", shard_index) == shard_index:
+                return True
+        return False

@@ -1,0 +1,163 @@
+"""CI chaos smoke: the fault matrix must recover to byte parity.
+
+Runs the supervised feeder fabric (2 REAL process workers where
+multiprocessing works, threads otherwise) over a demolog corpus with
+every fault class injected on purpose (``tools/chaos.py``), across both
+process transports (zero-copy ring + pickled escape hatch), and fails
+(exit 1) unless:
+
+- every faulted run COMPLETES (no FeederError) and its concatenated
+  batch payloads are byte-identical to the corpus — replayed shards,
+  re-framed ring batches and quarantined shards included;
+- the recovery ledger moved the way the fault demands: worker restarts
+  for kills/stalls, exactly one quarantined shard for the poison drill,
+  a counted generation mismatch / descriptor fault for the corrupt-
+  descriptor drills, a transport demotion for the ring-fault storm;
+- the new metric families land in the registry and the rendered
+  Prometheus exposition stays structurally valid
+  (:func:`logparser_tpu.tools.metrics_smoke.validate_exposition`);
+- NO shared-memory segment outlives pool teardown (``/dev/shm`` carries
+  no ``lpring_*`` entries afterwards) — recovery must not leak arenas,
+  including the ones it rebuilds mid-run.
+
+Usage::
+
+    make chaos-smoke
+    python -m logparser_tpu.tools.chaos_smoke
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+N_LINES = 4096
+BATCH_LINES = 256
+WORKERS = 2
+LINE_LEN = 256
+SHM_DIR = "/dev/shm"
+
+
+def _ring_segments():
+    from logparser_tpu.feeder import RING_NAME_PREFIX
+
+    if not os.path.isdir(SHM_DIR):
+        return None
+    return sorted(
+        f for f in os.listdir(SHM_DIR) if f.startswith(RING_NAME_PREFIX)
+    )
+
+
+def main() -> int:
+    from logparser_tpu.feeder import (
+        FeederPool,
+        SupervisorPolicy,
+        ring_available,
+    )
+    from logparser_tpu.observability import metrics
+    from logparser_tpu.tools.demolog import generate_combined_lines
+    from logparser_tpu.tools.metrics_smoke import validate_exposition
+
+    lines = generate_combined_lines(N_LINES, seed=29, garbage_fraction=0.01)
+    blob = "\n".join(lines).encode()
+    reg = metrics()
+    policy = SupervisorPolicy(backoff_base_s=0.01,
+                              ring_fault_threshold=2)
+
+    # (fault spec, ring-transport only,
+    #  {registry counter or stats key: min value/delta})
+    drills = [
+        ("kill_worker:worker=1:after=2:mode=hard", False,
+         {"feeder_worker_restarts_total": 1}),
+        ("kill_worker:worker=0:after=0:mode=soft", False,
+         {"feeder_worker_restarts_total": 1,
+          "feeder_shards_requeued_total": 1}),
+        ("drop_done:worker=1", False,
+         {"feeder_worker_restarts_total": 1}),
+        ("poison_shard:shard=1:mode=hard", False,
+         {"feeder_shards_quarantined_total": 1,
+          "stats:shards_quarantined": 1}),
+        ("corrupt_descriptor:worker=0:index=1:field=generation", True,
+         {"feeder_ring_generation_mismatch_total": 1,
+          "stats:batches_reframed": 1}),
+        ("corrupt_descriptor:worker=0:index=1:field=slot;"
+         "corrupt_descriptor:worker=0:index=3:field=slot", True,
+         {"feeder_ring_descriptor_faults_total": 2,
+          "stats:transport_demotions": 1}),
+        ("slot_overflow:worker=1:count=20", True,
+         {"feeder_ring_pickle_fallback_total": 1}),
+    ]
+
+    failures = []
+    segments_before = _ring_segments()
+    transports = ("ring", "pickle") if ring_available() else ("pickle",)
+    shard_bytes = max(1, len(blob) // 5)
+    for transport in transports:
+        for spec, ring_only, expected in drills:
+            if ring_only and transport != "ring":
+                continue
+            tag = f"transport={transport} fault={spec.split(':', 1)[0]}"
+            before = {name: reg.get(name) for name in expected
+                      if not name.startswith("stats:")}
+            pool = FeederPool(
+                [blob], workers=WORKERS, shard_bytes=shard_bytes,
+                batch_lines=BATCH_LINES, line_len=LINE_LEN,
+                transport=transport, chaos=spec, policy=policy,
+            )
+            try:
+                ebs = list(pool.batches())
+            except Exception as e:  # noqa: BLE001 — a recovery bug, report it
+                failures.append(f"{tag}: run ABORTED ({type(e).__name__}: "
+                                f"{e})")
+                continue
+            if b"".join(bytes(e.payload) for e in ebs) != blob:
+                failures.append(
+                    f"{tag}: recovered payload diverges from the corpus"
+                )
+            stats = pool.stats()
+            for name, floor in expected.items():
+                if name.startswith("stats:"):
+                    moved = stats.get(name.split(":", 1)[1], 0)
+                else:
+                    moved = reg.get(name) - before[name]
+                if moved < floor:
+                    failures.append(
+                        f"{tag}: {name} moved {moved} "
+                        f"(expected >= {floor})"
+                    )
+            print(f"chaos-smoke: {tag} mode={stats['mode']} "
+                  f"batches={stats['batches']} "
+                  f"restarts={stats['worker_restarts']} "
+                  f"quarantined={stats['shards_quarantined']} "
+                  f"demotions={stats['transport_demotions']} OK")
+
+    # Shared-memory hygiene: recovery rebuilds arenas mid-run — every
+    # one of them (original and replacement) must be unlinked by pool
+    # teardown.
+    segments_after = _ring_segments()
+    if segments_before is not None and segments_after is not None:
+        leaked = sorted(set(segments_after) - set(segments_before))
+        if leaked:
+            failures.append(f"leaked shared-memory segments: {leaked}")
+
+    text = reg.prometheus_text()
+    for needle in ("logparser_tpu_feeder_worker_restarts_total",
+                   "logparser_tpu_feeder_shards_quarantined_total",
+                   "logparser_tpu_feeder_shards_requeued_total"):
+        if needle not in text:
+            failures.append(f"/metrics exposition missing: {needle}")
+    failures.extend(validate_exposition(text))
+
+    if failures:
+        print("CHAOS SMOKE FAILURES:")
+        for f in failures:
+            print(" -", f)
+        return 1
+    print(f"chaos-smoke OK: {len(drills)} fault drills x "
+          f"{len(transports)} transports at {WORKERS} workers — every "
+          "run recovered to byte parity, ledger counters moved, no "
+          "leaked shm segments")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

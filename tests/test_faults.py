@@ -1,0 +1,493 @@
+"""Fault-tolerant ingest: the chaos matrix (docs/FEEDER.md "Failure
+model & recovery").
+
+The supervision layer's contract is BYTE PARITY UNDER FAILURE: a run
+that loses workers, eats corrupt ring descriptors, or hits a poison
+shard must deliver exactly the stream an undisturbed run delivers —
+replay is deterministic from the last delivered batch boundary, poison
+shards re-frame in-process, ring faults re-frame per batch.  The matrix
+below injects every fault class (``tools/chaos.py``) across transports
+and worker counts and holds the recovered output to one-shot
+``encode_blob`` over the whole corpus.
+
+Fast tier: thread-mode pools (soft/silent deaths, abandoned stalls).
+Slow tier: real process workers (os._exit hard kills, SIGSTOP vs the
+close() terminate->kill escalation).
+"""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from _shared_parsers import shared_parser
+from logparser_tpu.feeder import (
+    FeederPool,
+    FeederSupervisor,
+    RingFault,
+    SlotRing,
+    SupervisorPolicy,
+    ring_available,
+)
+from logparser_tpu.native import encode_blob
+from logparser_tpu.observability import metrics
+from logparser_tpu.tools.chaos import ChaosSpec, WorkerChaos
+
+FIELDS = ["IP:connection.client.host", "STRING:request.status.last",
+          "BYTES:response.body.bytes"]
+
+#: Fast decisions for tests: near-zero backoff, tight ring thresholds.
+FAST = dict(backoff_base_s=0.001, backoff_max_s=0.01)
+
+
+def _corpus(n=1500):
+    return b"\n".join(b"198.51.100.7 row %06d some filler payload" % i
+                      for i in range(n))
+
+
+def _pool(blob, chaos=None, policy=None, **kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("shard_bytes", 8000)
+    kw.setdefault("batch_lines", 64)
+    kw.setdefault("line_len", 64)
+    kw.setdefault("use_processes", False)
+    return FeederPool(
+        [blob], chaos=chaos,
+        policy=policy or SupervisorPolicy(**FAST), **kw,
+    )
+
+
+def _assert_recovered_parity(pool, blob):
+    """Drain the pool and hold the recovered stream to one-shot framing
+    parity: payload bytes, encoded buffers, lengths, overflow rebasing,
+    global order."""
+    ref_buf, ref_lengths, ref_overflow = encode_blob(blob, line_len=64)
+    ebs = list(pool.batches())
+    assert [e.order_key for e in ebs] == sorted(e.order_key for e in ebs)
+    assert b"".join(bytes(e.payload) for e in ebs) == blob
+    np.testing.assert_array_equal(
+        np.concatenate([e.buf for e in ebs]), ref_buf)
+    np.testing.assert_array_equal(
+        np.concatenate([e.lengths for e in ebs]), ref_lengths)
+    got_overflow, row = [], 0
+    for e in ebs:
+        got_overflow.extend(row + i for i in e.overflow)
+        row += e.n_lines
+    assert got_overflow == list(ref_overflow)
+    return ebs
+
+
+# ---------------------------------------------------------------------------
+# the supervisor decision machine (pure unit)
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_restart_backoff_then_demotion():
+    sup = FeederSupervisor(
+        SupervisorPolicy(max_restarts=2, backoff_base_s=0.1,
+                         backoff_max_s=0.3),
+        workers=2, mode="process", transport="ring",
+    )
+    d1 = sup.on_worker_fault(0, shard_index=0)
+    d2 = sup.on_worker_fault(0, shard_index=2)
+    assert (d1.action, d2.action) == ("respawn", "respawn")
+    assert d1.backoff_s == pytest.approx(0.1)
+    assert d2.backoff_s == pytest.approx(0.2)
+    assert d1.demoted_from is None
+    # Third fault exceeds max_restarts=2: demote ring -> pickle.
+    d3 = sup.on_worker_fault(0, shard_index=4)
+    assert (d3.action, d3.transport, d3.demoted_from) == \
+        ("respawn", "pickle", "ring")
+    assert sup.transport_of[0] == "pickle"
+    # Budget is fresh at the new rung; burn it down to inline...
+    for shard in (6, 8):
+        assert sup.on_worker_fault(0, shard_index=shard).action == "respawn"
+    d6 = sup.on_worker_fault(0, shard_index=10)
+    assert (d6.transport, d6.demoted_from) == ("inline", "pickle")
+    # ...and at the bottom of the ladder every fault quarantines.
+    for _ in range(4):
+        sup.on_worker_fault(0, shard_index=14)
+    d = sup.on_worker_fault(0, shard_index=16)
+    assert d.action == "quarantine"
+    # Worker 1 is untouched by worker 0's ledger.
+    assert sup.transport_of[1] == "ring"
+    assert sup.on_worker_fault(1, shard_index=1).action == "respawn"
+
+
+def test_supervisor_poison_threshold_quarantines():
+    sup = FeederSupervisor(SupervisorPolicy(poison_threshold=2),
+                           workers=2, mode="thread", transport="inline")
+    assert sup.on_worker_fault(1, shard_index=3).action == "respawn"
+    d = sup.on_worker_fault(1, shard_index=3)
+    assert d.action == "quarantine"
+    assert sup.shard_kills[3] == 2
+
+
+def test_supervisor_ring_fault_and_overflow_demotions():
+    sup = FeederSupervisor(
+        SupervisorPolicy(ring_fault_threshold=2,
+                         overflow_demotion_threshold=3),
+        workers=2, mode="thread", transport="ring",
+    )
+    assert sup.on_ring_fault(0) is None
+    d = sup.on_ring_fault(0)
+    assert d is not None and (d.transport, d.demoted_from) == \
+        ("inline", "ring")
+    assert sup.transport_of[0] == "inline"
+    assert sup.on_ring_fault(0) is None  # already off the ring
+    assert sup.on_overflow_fallback(1) is None
+    assert sup.on_overflow_fallback(1) is None
+    d = sup.on_overflow_fallback(1)
+    assert d is not None and d.demoted_from == "ring"
+
+
+def test_chaos_spec_grammar():
+    spec = ChaosSpec.parse(
+        "kill_worker:worker=1:after=3;poison_shard:shard=2;"
+        "delay_put:seconds=0.5:sticky=1"
+    )
+    kinds = [f.kind for f in spec.faults]
+    assert kinds == ["kill_worker", "poison_shard", "delay_put"]
+    assert [f.sticky for f in spec.faults] == [False, True, True]
+    view = spec.respawn_view()
+    assert [f.kind for f in view.faults] == ["poison_shard", "delay_put"]
+    assert ChaosSpec.parse("kill_worker:after=1").respawn_view() is None
+    with pytest.raises(ValueError, match="unknown chaos fault"):
+        ChaosSpec.parse("meteor_strike")
+    chaos = WorkerChaos(spec, worker_id=0, is_process=False)
+    assert [f.kind for f in chaos.faults] == ["poison_shard", "delay_put"]
+
+
+# ---------------------------------------------------------------------------
+# the fault matrix: recovered output byte-identical to undisturbed
+# ---------------------------------------------------------------------------
+
+TRANSPORTS = ["inline"] + (["ring"] if ring_available() else [])
+
+FAULTS = {
+    "kill_soft": "kill_worker:worker=1:after=3:mode=soft",
+    "kill_silent": "kill_worker:worker=1:after=3:mode=hard",
+    "kill_at_start": "kill_worker:worker=0:after=0:mode=soft",
+    "drop_done": "drop_done:worker=1",
+    "poison": "poison_shard:shard=1:mode=soft",
+}
+
+
+@pytest.mark.parametrize("workers", [2, 4])
+@pytest.mark.parametrize("transport", TRANSPORTS)
+@pytest.mark.parametrize("fault", sorted(FAULTS))
+def test_fault_matrix_byte_parity(fault, transport, workers):
+    """Acceptance bar: every fault class x transport x worker count
+    yields a COMPLETED run whose batch stream is byte-identical to an
+    undisturbed one, with the recovery recorded in stats."""
+    blob = _corpus()
+    before = metrics().get("feeder_worker_restarts_total")
+    pool = _pool(blob, chaos=FAULTS[fault], transport=transport,
+                 workers=workers, ring_slots=3)
+    _assert_recovered_parity(pool, blob)
+    stats = pool.stats()
+    if fault == "poison":
+        assert stats["shards_quarantined"] == 1
+    else:
+        assert stats["worker_restarts"] >= 1
+        assert metrics().get("feeder_worker_restarts_total") > before
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+def test_one_of_four_workers_killed_feed_parity(transport):
+    """The headline acceptance criterion: killing 1 of 4 feeder workers
+    mid-corpus yields a completed run whose Arrow output is
+    byte-identical to the undisturbed run — on every transport."""
+    import pyarrow as pa
+
+    parser = shared_parser("combined", FIELDS)
+    from logparser_tpu.tools.demolog import generate_combined_lines
+
+    blob = "\n".join(
+        generate_combined_lines(600, seed=5, garbage_fraction=0.02)
+    ).encode()
+    ref = parser.parse_blob(blob)
+    ref_table = ref.to_arrow(include_validity=True, strings="copy")
+
+    def run(chaos):
+        pool = FeederPool(
+            [blob], workers=4, shard_bytes=len(blob) // 6,
+            batch_lines=32, use_processes=False, transport=transport,
+            ring_slots=3, chaos=chaos, policy=SupervisorPolicy(**FAST),
+        )
+        tables, counts = [], [0, 0, 0]
+        for r in pool.feed(parser):
+            tables.append(r.to_arrow(include_validity=True,
+                                     strings="copy"))
+            counts[0] += r.lines_read
+            counts[1] += r.oracle_rows
+            counts[2] += r.bad_lines
+        return pa.concat_tables(tables).combine_chunks(), counts, pool
+
+    undisturbed, ref_counts, _ = run(None)
+    assert undisturbed.equals(ref_table.combine_chunks())
+    killed, counts, pool = run("kill_worker:worker=2:after=2:mode=hard")
+    assert pool.stats()["worker_restarts"] >= 1
+    assert killed.equals(undisturbed)
+    assert counts == ref_counts == [
+        ref.lines_read, ref.oracle_rows, ref.bad_lines
+    ]
+
+
+def test_poison_shard_quarantined_run_completes():
+    """Acceptance: a shard that kills its worker twice is quarantined
+    through the in-process host path — the run completes with EVERY
+    line delivered (the poison shard's included: the in-process framer
+    is immune to the injected worker crash) and
+    feeder_shards_quarantined_total = 1, never an aborted run."""
+    blob = _corpus()
+    before = metrics().get("feeder_shards_quarantined_total")
+    pool = _pool(blob, chaos="poison_shard:shard=2:after=1:mode=soft",
+                 workers=2)
+    ebs = _assert_recovered_parity(pool, blob)
+    assert any(e.shard == 2 for e in ebs)
+    assert metrics().get("feeder_shards_quarantined_total") == before + 1
+    stats = pool.stats()
+    assert stats["shards_quarantined"] == 1
+    assert stats["quarantined_shards"] == [2]
+    assert stats["worker_restarts"] >= 1  # the pre-quarantine retry
+
+
+def test_worker_stall_deadline_respawns():
+    """An ALIVE but silent worker (delayed puts) trips the worker
+    deadline, is reaped + respawned (the one-shot fault does not follow
+    it), and the run still holds byte parity."""
+    blob = _corpus(800)
+    policy = SupervisorPolicy(worker_deadline_s=0.15, **FAST)
+    pool = _pool(blob, chaos="delay_put:worker=1:seconds=0.7",
+                 policy=policy, workers=2)
+    t0 = time.perf_counter()
+    _assert_recovered_parity(pool, blob)
+    assert time.perf_counter() - t0 < 30
+    assert pool.stats()["worker_restarts"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# ring-lane faults: generation verification, descriptor validation,
+# demotion ladder
+# ---------------------------------------------------------------------------
+
+pytestmark_ring = pytest.mark.skipif(
+    not ring_available(), reason="multiprocessing.shared_memory unavailable"
+)
+
+
+@pytestmark_ring
+@pytest.mark.parametrize("field", ["generation", "slot"])
+def test_corrupt_descriptor_recovers_per_batch(field):
+    """A corrupt slot descriptor (scrambled generation or slot id) is
+    caught by map-time validation, counted, and recovered by re-framing
+    the expected batch in-process — never silent corrupt bytes."""
+    blob = _corpus()
+    counter = ("feeder_ring_generation_mismatch_total"
+               if field == "generation"
+               else "feeder_ring_descriptor_faults_total")
+    before = metrics().get(counter)
+    pool = _pool(blob,
+                 chaos=f"corrupt_descriptor:worker=0:index=2:field={field}",
+                 transport="ring", workers=2, ring_slots=4,
+                 policy=SupervisorPolicy(ring_fault_threshold=10, **FAST))
+    _assert_recovered_parity(pool, blob)
+    assert metrics().get(counter) == before + 1
+    assert pool.stats()["batches_reframed"] == 1
+    assert pool.stats()["ring_faults"] == 1
+    assert pool.stats()["transport_demotions"] == 0  # below threshold
+
+
+@pytestmark_ring
+def test_repeated_ring_faults_demote_off_the_ring():
+    """Two corrupt descriptors from one worker cross the default
+    ring_fault_threshold: the worker is respawned one rung down
+    (thread pools: ring -> inline), counted in
+    feeder_transport_demotions_total, and parity still holds."""
+    blob = _corpus()
+    before = metrics().get("feeder_transport_demotions_total",
+                           labels={"from": "ring", "to": "inline"})
+    pool = _pool(
+        blob,
+        chaos=("corrupt_descriptor:worker=0:index=1;"
+               "corrupt_descriptor:worker=0:index=2"),
+        transport="ring", workers=2, ring_slots=4,
+        policy=SupervisorPolicy(ring_fault_threshold=2, **FAST),
+    )
+    _assert_recovered_parity(pool, blob)
+    stats = pool.stats()
+    assert stats["transport_demotions"] == 1
+    assert pool.supervisor.transport_of[0] == "inline"
+    assert pool.supervisor.transport_of[1] == "ring"
+    assert metrics().get("feeder_transport_demotions_total",
+                         labels={"from": "ring", "to": "inline"}) == \
+        before + 1
+
+
+@pytestmark_ring
+def test_slot_overflow_storm_demotes():
+    """A slot-overflow storm (every frame rejected) keeps falling back
+    per batch until the overflow threshold moves the worker off the
+    mis-sized ring entirely; the stream stays complete either way."""
+    blob = _corpus()
+    pool = _pool(
+        blob, chaos="slot_overflow:worker=0", transport="ring",
+        workers=2, ring_slots=3,
+        policy=SupervisorPolicy(overflow_demotion_threshold=3, **FAST),
+    )
+    _assert_recovered_parity(pool, blob)
+    stats = pool.stats()
+    assert stats["pickle_fallback_batches"] >= 3
+    assert stats["transport_demotions"] == 1
+    assert pool.supervisor.transport_of[0] == "inline"
+
+
+@pytestmark_ring
+def test_generation_ledger_catches_stale_descriptor():
+    """Direct SlotRing-level check: a descriptor replayed with a stale
+    generation raises RingFault('generation'); the slot's honest next
+    use still maps."""
+    import queue
+
+    from logparser_tpu.feeder.ring import SlotFrame, SlotWriter
+
+    ring = SlotRing(4096, 2, queue.Queue(), name_hint="gen_test")
+    try:
+        writer = SlotWriter(ring.spec(), shm=ring.shm)
+        chunk = b"hello world\nsecond line"
+
+        def send(slot):
+            n, L, overflow = writer.frame(chunk, 32, slot)
+            desc = SlotFrame(
+                shard=0, index=0, slot=slot, n_lines=n, line_len=L,
+                payload_len=len(chunk), overflow=overflow,
+                generation=writer.next_generation(slot),
+            )
+            writer.note_sent(slot)
+            return desc
+
+        d1 = send(0)
+        eb = ring.map(d1)
+        assert bytes(eb.payload) == chunk
+        eb.release()
+        # Replaying the SAME descriptor after the slot recycled is the
+        # corruption the ledger exists to catch.  Its generation is
+        # BEHIND the ledger -> flagged stale (the pool drops it: the
+        # original already delivered), and the ledger does NOT advance.
+        with pytest.raises(RingFault, match="generation") as ei:
+            ring.map(d1)
+        assert ei.value.stale
+        d2 = send(0)
+        assert ring.map(d2).n_lines == 2
+        # A corrupted-in-flight NEW send (generation AHEAD of the
+        # ledger) is not stale — and it advances the ledger, so the
+        # slot's next honest descriptor still maps cleanly.
+        d4 = send(0)
+        d4.generation += 1_000_000
+        with pytest.raises(RingFault, match="generation") as ei:
+            ring.map(d4)
+        assert not ei.value.stale
+        d5 = send(0)
+        assert ring.map(d5).n_lines == 2
+        # Structural validation: slot id out of range.
+        d3 = send(1)
+        d3.slot = 99
+        with pytest.raises(RingFault, match="outside"):
+            ring.map(d3)
+    finally:
+        ring.close()
+
+
+# ---------------------------------------------------------------------------
+# teardown-error routing (satellite: no silent `except: pass`)
+# ---------------------------------------------------------------------------
+
+
+def test_teardown_errors_are_counted_not_swallowed():
+    from logparser_tpu.feeder.worker import note_teardown_error
+    from logparser_tpu.observability import LOG as OBS_LOG
+
+    before = metrics().get("feeder_teardown_errors_total",
+                           labels={"site": "test.site"})
+    note_teardown_error(OBS_LOG, "test.site", RuntimeError("boom"))
+    assert metrics().get("feeder_teardown_errors_total",
+                         labels={"site": "test.site"}) == before + 1
+
+
+def test_close_drain_failure_routed_through_counter():
+    """A queue that breaks during close()'s drain is warned + counted,
+    and close still completes."""
+
+    class _BrokenQueue:
+        def get_nowait(self):
+            raise RuntimeError("pipe torn down")
+
+    blob = b"a\nb\nc"
+    pool = _pool(blob, workers=1)
+    list(pool.batches())
+    pool._closed = False  # re-enter close with a sabotaged queue
+    pool._queues = [_BrokenQueue()]
+    before = metrics().get("feeder_teardown_errors_total",
+                           labels={"site": "close.drain"})
+    pool.close()
+    assert metrics().get("feeder_teardown_errors_total",
+                         labels={"site": "close.drain"}) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# process-mode chaos: real crashes, real signals (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_process_hard_kill_recovery(tmp_path):
+    """A worker process that os._exit()s mid-corpus (no error relay, no
+    teardown) is detected as a dead producer, respawned, and the run
+    completes with byte parity — the real-crash flavor of the matrix."""
+    blob = _corpus(4000)
+    path = tmp_path / "corpus.log"
+    path.write_bytes(blob)
+    pool = FeederPool(
+        [str(path)], workers=2, shard_bytes=16000, batch_lines=64,
+        line_len=64, use_processes=True,
+        chaos="kill_worker:worker=1:after=2:mode=hard",
+        policy=SupervisorPolicy(**FAST),
+    )
+    ref_buf, ref_lengths, _ = encode_blob(blob, line_len=64)
+    ebs = list(pool.batches())
+    assert pool.stats()["mode"] == "process"
+    assert pool.stats()["worker_restarts"] >= 1
+    assert b"".join(bytes(e.payload) for e in ebs) == blob
+    np.testing.assert_array_equal(
+        np.concatenate([e.buf for e in ebs]), ref_buf)
+    np.testing.assert_array_equal(
+        np.concatenate([e.lengths for e in ebs]), ref_lengths)
+
+
+@pytest.mark.slow
+def test_sigstopped_worker_cannot_hang_close(tmp_path):
+    """The terminate->kill escalation: SIGTERM never reaches a
+    SIGSTOPped process (it stays pending), so close() must escalate to
+    SIGKILL instead of hanging — bounded by shutdown_timeout_s per
+    stage."""
+    blob = _corpus(4000)
+    path = tmp_path / "corpus.log"
+    path.write_bytes(blob)
+    pool = FeederPool(
+        [str(path)], workers=2, shard_bytes=4000, batch_lines=16,
+        line_len=64, use_processes=True, worker_delay_s=0.05,
+        shutdown_timeout_s=0.5,
+    )
+    it = pool.batches(detach=True)
+    next(it)  # workers are live
+    victim = pool._procs[0]
+    os.kill(victim.pid, signal.SIGSTOP)
+    t0 = time.perf_counter()
+    it.close()
+    pool.close()
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 10, f"close() took {elapsed:.1f}s"
+    victim.join(timeout=5)
+    assert not victim.is_alive()

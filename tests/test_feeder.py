@@ -296,13 +296,32 @@ def test_empty_source_yields_no_batches():
 
 
 def test_worker_failure_surfaces_as_feeder_error(tmp_path):
+    """Unsupervised pools keep the historical fail-stop contract; a
+    SUPERVISED pool retries (bounded), quarantines the shard, and only
+    aborts because the data is unreadable in-process too — the one
+    fault class recovery cannot route around."""
+    from logparser_tpu.feeder import SupervisorPolicy
+
     path = tmp_path / "gone.log"
     path.write_bytes(b"x\n" * 100)
     pool = FeederPool([str(path)], workers=1, shard_bytes=50,
-                      use_processes=False)
+                      use_processes=False, supervise=False)
     os.unlink(path)  # worker's open() will fail
     with pytest.raises(FeederError, match="worker 0 failed"):
         list(pool.batches())
+
+    path.write_bytes(b"x\n" * 100)
+    pool = FeederPool([str(path)], workers=1, shard_bytes=50,
+                      use_processes=False,
+                      policy=SupervisorPolicy(backoff_base_s=0.001))
+    os.unlink(path)
+    from logparser_tpu.observability import metrics
+
+    before = metrics().get("feeder_shards_quarantined_total")
+    with pytest.raises(FeederError, match="unprocessable"):
+        list(pool.batches())
+    assert metrics().get("feeder_shards_quarantined_total") == before + 1
+    assert pool.stats()["worker_restarts"] >= 1
 
 
 def test_batches_is_single_use():

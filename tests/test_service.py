@@ -114,3 +114,72 @@ def test_embedded_newline_rejected(service):
 def test_shutdown_before_start_does_not_hang():
     svc = ParseService()
     svc.shutdown()  # must not block on the never-started serve_forever loop
+
+
+# ---------------------------------------------------------------------------
+# feeder-session degradation (docs/FEEDER.md "Failure model & recovery"):
+# a feeder failure mid-session must NEVER drop the connection — the
+# request re-parses inline (error-free ARROW stream) or, for
+# parse-shaped failures, relays a well-formed error frame, and the
+# session survives on the degraded inline path either way.
+# ---------------------------------------------------------------------------
+
+
+def _feeder_session(monkeypatch, fail_with):
+    """A service whose _feeder_parse fails once with ``fail_with``,
+    counting calls; returns (service ctx entered by caller, calls)."""
+    from logparser_tpu import service as service_mod
+
+    monkeypatch.setattr(service_mod, "_FEEDER_MIN_LINES", 16)
+    calls = []
+
+    def exploding_feeder(parser, blob, count, workers):
+        calls.append(count)
+        raise fail_with
+
+    monkeypatch.setattr(service_mod, "_feeder_parse", exploding_feeder)
+    return calls
+
+
+def test_feeder_death_degrades_to_error_free_arrow(monkeypatch):
+    """A dead feeder fabric (FeederError) yields the SAME ARROW frame
+    the inline path produces — no error frame, no RST — and the session
+    is demoted: its next LINES frame skips the feeder entirely."""
+    from logparser_tpu.feeder import FeederError
+    from logparser_tpu.observability import metrics
+
+    calls = _feeder_session(
+        monkeypatch, FeederError("all workers dead"))
+    lines = generate_combined_lines(60, seed=9)
+    before = metrics().get("service_feeder_demotions_total")
+    with ParseService() as svc:
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1]
+        ) as plain:
+            ref = plain.parse(lines)
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1], feeder_workers=2,
+        ) as client:
+            got = client.parse(lines)          # feeder dies -> inline retry
+            again = client.parse(lines)        # demoted: inline directly
+    assert got.equals(ref) and again.equals(ref)
+    assert calls == [60]  # the demoted session never re-entered the feeder
+    assert metrics().get("service_feeder_demotions_total") == before + 1
+
+
+def test_feeder_parse_failure_relays_error_frame_and_survives(monkeypatch):
+    """A parse-shaped failure inside the feeder path relays a
+    WELL-FORMED error frame (the client raises ParseServiceError, the
+    socket stays open), and the next LINES frame succeeds via the
+    degraded inline path."""
+    calls = _feeder_session(monkeypatch, RuntimeError("bad parse state"))
+    lines = generate_combined_lines(40, seed=3)
+    with ParseService() as svc:
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1], feeder_workers=2,
+        ) as client:
+            with pytest.raises(ParseServiceError, match="bad parse state"):
+                client.parse(lines)
+            table = client.parse(lines)  # same socket, degraded inline
+    assert table.num_rows == 40
+    assert calls == [40]

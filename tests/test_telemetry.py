@@ -519,6 +519,41 @@ def test_metrics_endpoint_exposes_ring_families():
     assert validate_exposition(text) == []
 
 
+def test_metrics_endpoint_exposes_recovery_families():
+    """The supervision layer's recovery ledger (round 11,
+    docs/FEEDER.md "Failure model & recovery") reaches /metrics once a
+    fault has been recovered: worker restarts, requeued shards, and —
+    for a poison drill — the quarantine counter."""
+    from logparser_tpu.feeder import FeederPool, SupervisorPolicy
+    from logparser_tpu.service import MetricsEndpoint
+
+    blob = b"\n".join(b"line %06d padding payload" % i for i in range(600))
+    pool = FeederPool(
+        [blob], workers=2, shard_bytes=3000, batch_lines=32, line_len=64,
+        use_processes=False,
+        chaos="poison_shard:shard=1:mode=soft",
+        policy=SupervisorPolicy(backoff_base_s=0.001),
+    )
+    drained = b"".join(bytes(eb.payload) for eb in pool.batches())
+    assert drained == blob
+    assert pool.stats()["shards_quarantined"] == 1
+    endpoint = MetricsEndpoint().start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{endpoint.port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode("utf-8")
+    finally:
+        endpoint.shutdown()
+    for needle in (
+        "logparser_tpu_feeder_worker_restarts_total",
+        "logparser_tpu_feeder_shards_quarantined_total",
+        "logparser_tpu_feeder_shards_requeued_total",
+    ):
+        assert needle in text, f"/metrics missing {needle}"
+    assert validate_exposition(text) == []
+
+
 def test_process_mode_queue_depth_gauge_is_live():
     """Round-10 satellite: process workers cannot update the parent's
     registry, so depth is exported via shared put-counters — the gauge
