@@ -1,7 +1,7 @@
 # Dev entry points (the reference's Maven/devtools tier, L0).
 PY ?= python
 
-.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -52,6 +52,16 @@ chaos-smoke:
 # oracle_routed_lines_total counters.  CI runs this after feeder-smoke.
 rescue-smoke:
 	$(PY) -m logparser_tpu.tools.rescue_smoke
+
+# Service smoke: the serving-tier robustness drill (docs/SERVICE.md) —
+# a loadgen burst at 2x the admission budget against a live sidecar must
+# produce ZERO connection resets (all refusals structured BUSY frames),
+# /metrics must expose the shed/session families, and a graceful drain
+# with a session in flight must flip /readyz to 503, complete the
+# admitted work, and leak no session threads.  CI runs this after
+# chaos-smoke.
+service-smoke:
+	$(PY) -m logparser_tpu.tools.service_smoke
 
 lint:
 	$(PY) -m ruff check logparser_tpu tests

@@ -27,6 +27,7 @@ from .pool import (  # noqa: F401
     FeederError,
     FeederPool,
     default_feeder_workers,
+    queue_backpressure,
     resolve_transport,
 )
 from .ring import (  # noqa: F401

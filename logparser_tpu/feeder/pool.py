@@ -87,6 +87,7 @@ import os
 import queue as _queue
 import threading
 import time
+import weakref
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from ..observability import log_warning_once, metrics, observe_stage
@@ -208,6 +209,32 @@ def default_feeder_workers() -> int:
     return max(1, min(8, os.cpu_count() or 1))
 
 
+# Live pools, registered at _start() and dropped at close(): the process-
+# wide backpressure signal the serving tier's admission control reads
+# (docs/SERVICE.md).  A WeakSet so an abandoned pool (consumer crashed
+# between start and close) can never pin itself into the signal.
+_LIVE_POOLS: "weakref.WeakSet[FeederPool]" = weakref.WeakSet()
+
+
+def queue_backpressure() -> float:
+    """Aggregate feeder-queue occupancy across every LIVE pool in this
+    process as a 0.0–1.0 fraction (worst pool wins: one saturated ring
+    means the fabric is not absorbing new work, however idle the
+    others).  0.0 when no pool is running or depth is unknowable.  This
+    is the signal ``ParseService`` wires its per-request admission
+    control to: framed batches waiting at/above the configured fraction
+    of the bounded-queue capacity mean the parser is the bottleneck and
+    new requests should shed with a structured BUSY frame instead of
+    queueing without bound."""
+    worst = 0.0
+    for pool in list(_LIVE_POOLS):
+        try:
+            worst = max(worst, pool.backpressure())
+        except Exception:  # noqa: BLE001 — a pool mid-teardown reads as idle
+            continue
+    return worst
+
+
 def resolve_transport(requested: Optional[str], mode: str) -> str:
     """The transport a (request, worker-mode) pair actually runs:
     ``LOGPARSER_TPU_FEEDER_PICKLE=1`` wins over everything (the
@@ -291,6 +318,7 @@ class FeederPool:
         policy: Optional[SupervisorPolicy] = None,
         chaos: Any = None,
         shutdown_timeout_s: float = 5.0,
+        backpressure_signal: bool = True,
     ):
         if not sources:
             raise ValueError("FeederPool needs at least one source")
@@ -322,6 +350,14 @@ class FeederPool:
         self._chaos_arg = chaos
         self._chaos_spec: Any = None
         self._shutdown_timeout_s = float(shutdown_timeout_s)
+        # Whether this pool feeds the process-wide queue_backpressure()
+        # admission signal.  A STANDING ingest pool (the fabric keeping
+        # chips fed) should; a short-lived per-request framing pool (the
+        # service's _feeder_parse) must NOT — its queue sitting full for
+        # the length of one request is the healthy steady state of that
+        # request, not overload, and exporting it would shed every
+        # concurrent request whenever one feeder-framed request runs.
+        self._backpressure_signal = bool(backpressure_signal)
         self.mode: Optional[str] = None  # "process" | "thread" once started
         self.transport: Optional[str] = None  # resolved at start
         self.supervisor: Optional[FeederSupervisor] = None
@@ -366,6 +402,8 @@ class FeederPool:
         if self._started:
             raise RuntimeError("FeederPool.batches() can only run once")
         self._started = True
+        if self._backpressure_signal:
+            _LIVE_POOLS.add(self)
         if self._chaos_arg is not None or os.environ.get(CHAOS_ENV, "").strip():
             from ..tools.chaos import ChaosSpec
 
@@ -954,6 +992,7 @@ class FeederPool:
         if self._closed:
             return
         self._closed = True
+        _LIVE_POOLS.discard(self)
         for stop in self._stops:
             stop.set()
         # Drain so workers blocked on a full queue observe the stop event
@@ -1018,6 +1057,30 @@ class FeederPool:
             except (NotImplementedError, OSError):
                 return -1  # platform without qsize (macOS mp queues)
         return total
+
+    def backpressure(self) -> float:
+        """THIS pool's queue occupancy as a 0.0–1.0 fraction of its
+        REACHABLE capacity.  For the ring that is ``workers x
+        ring_slots`` — a saturated worker can have at most one
+        descriptor per leased slot outstanding, so dividing by the
+        descriptor-queue bound (``ring_slots + 2`` control slack) would
+        cap the fraction at ~0.75 and a fully wedged fabric could never
+        cross a 0.95 shed threshold.  For pickle/inline lanes the
+        bounded queue itself is the capacity.  0.0 before start, after
+        close, or on a platform where depth is unknowable — unknown
+        must read as "admit", never as "shed".  The process-wide
+        aggregate is :func:`queue_backpressure`."""
+        if not self._started or self._closed:
+            return 0.0
+        depth = self._queue_depth()
+        if depth < 0:
+            return 0.0
+        per_worker = (self.ring_slots if self.transport == "ring"
+                      else self._queue_bound(self.transport))
+        cap = self.workers * per_worker
+        if cap <= 0:
+            return 0.0
+        return min(1.0, depth / cap)
 
     def _publish_depth(self) -> None:
         depth = self._queue_depth()

@@ -701,6 +701,19 @@ def log_warning_once(logger: logging.Logger, message: str) -> None:
         _GLOBAL_METRICS.increment("suppressed_warnings_total")
 
 
+def note_teardown(logger: logging.Logger, counter: str, site: str,
+                  detail: str) -> None:
+    """Teardown/cleanup failures must never be silent: count them under
+    ``counter{site=...}`` and warn once per distinct message.  The
+    generic form of the feeder's ``note_teardown_error`` escalation
+    idiom (PR 6), shared by the serving tier
+    (``service_teardown_errors_total``): a leaked session thread or a
+    join that times out, repeated across restarts, is exactly the drip
+    a long-lived host needs to see."""
+    _GLOBAL_METRICS.increment(counter, labels={"site": site})
+    log_warning_once(logger, f"teardown: {site}: {detail}")
+
+
 def suppressed_warning_counts() -> Dict[str, int]:
     """{message: suppressed repeat count} for every once-logged warning
     that repeated — the end-of-run summary companion of

@@ -79,6 +79,19 @@ def generate_combined_lines(
     return lines
 
 
+def truncate_to_common(line: str) -> str:
+    """Strip the quoted referer/user-agent tail off a combined line,
+    yielding a common-format (`%h %l %u %t "%r" %>s %b`) line.  The ONE
+    definition of the combined->common derivation — bench.py's
+    multiformat corpus and the loadgen's mixed-format drill both use it,
+    so their corpora can never silently diverge."""
+    try:
+        cut = line.rindex(' "', 0, line.rindex(' "'))
+        return line[:cut]
+    except ValueError:
+        return line
+
+
 def write_demolog(
     path: str, n: int = 3456, seed: int = 42, garbage_fraction: float = 0.0
 ) -> int:

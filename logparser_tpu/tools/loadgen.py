@@ -1,0 +1,271 @@
+"""Loadgen + SLO harness for the sidecar parse service (docs/SERVICE.md).
+
+ROADMAP item 4's measurement half: N concurrent clients drive a live
+:class:`~logparser_tpu.service.ParseService` with bursty, open-loop-style
+arrivals over MIXED formats, and every wire outcome is classified the way
+an SLO cares about it:
+
+- ``ok``            — ARROW frame back; latency recorded (p50/p99).
+- ``busy``          — structured ``BUSY`` shed (the server refusing work
+  the DEFINED way); ``busy_unstructured`` counts BUSY frames whose JSON
+  detail failed to parse (must stay 0), ``busy_reasons`` breaks sheds
+  down by the server's reason code.
+- ``deadline``      — structured ``DEADLINE`` response (request expired
+  server-side, session survived).
+- ``errors``        — ordinary per-request error frames.
+- ``resets``        — the FORBIDDEN outcome: a connection that died where
+  a response frame was due (RST/EOF).  The bench gate holds this at 0
+  under a 2x overload burst.
+
+Arrival model: each client schedules bursts of ``burst`` back-to-back
+requests every ``interval_s`` on the wall clock.  When the service is
+slower than the schedule the client is already late and fires
+immediately — the backlog IS the overload — which is the open-loop
+property closed-loop harnesses lack (they politely slow down with the
+server and hide the melt).
+
+Used three ways: ``bench.py``'s ``service`` section (goodput-retention +
+zero-reset gates), ``tools/service_smoke.py`` (CI), and standalone::
+
+    python -m logparser_tpu.tools.loadgen --port 8123 --clients 8
+"""
+from __future__ import annotations
+
+import json
+import math
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..service import (
+    ParseServiceClient,
+    ParseServiceError,
+    ServiceBusyError,
+    ServiceClosedError,
+    ServiceDeadlineError,
+)
+
+#: (name, log_format, fields) triples the mixed-tenant drill rotates
+#: through per client index — two real formats so the parser cache and
+#: per-session compile reuse are part of what the SLO measures.
+DEFAULT_FORMATS: Tuple[Tuple[str, str, List[str]], ...] = (
+    ("combined", "combined",
+     ["IP:connection.client.host", "STRING:request.status.last"]),
+    ("common", '%h %l %u %t "%r" %>s %b',
+     ["IP:connection.client.host", "BYTES:response.body.bytes"]),
+)
+
+
+def make_lines(format_name: str, n: int, seed: int = 7) -> List[str]:
+    """A corpus for one of the DEFAULT_FORMATS entries."""
+    from .demolog import generate_combined_lines, truncate_to_common
+
+    lines = generate_combined_lines(n, seed=seed)
+    if format_name == "common":
+        lines = [truncate_to_common(ln) for ln in lines]
+    return lines
+
+
+@dataclass
+class _ClientStats:
+    requests: int = 0
+    ok: int = 0
+    busy: int = 0
+    busy_unstructured: int = 0
+    deadline: int = 0
+    errors: int = 0
+    resets: int = 0
+    connect_errors: int = 0
+    lines_ok: int = 0
+    busy_reasons: Dict[str, int] = field(default_factory=dict)
+    latencies: List[float] = field(default_factory=list)
+
+    def merge(self, other: "_ClientStats") -> None:
+        self.requests += other.requests
+        self.ok += other.ok
+        self.busy += other.busy
+        self.busy_unstructured += other.busy_unstructured
+        self.deadline += other.deadline
+        self.errors += other.errors
+        self.resets += other.resets
+        self.connect_errors += other.connect_errors
+        self.lines_ok += other.lines_ok
+        for k, v in other.busy_reasons.items():
+            self.busy_reasons[k] = self.busy_reasons.get(k, 0) + v
+        self.latencies.extend(other.latencies)
+
+
+def _quiet_close(client: Optional[ParseServiceClient]) -> None:
+    if client is not None:
+        try:
+            client.close()
+        except OSError:
+            pass
+
+
+def _percentile_ms(latencies: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile in ms: rank ceil(q*n) (1-based), so p99 of
+    100 samples is the 99th value, not the max.  The epsilon absorbs
+    float noise in q*n (0.99 * 200 = 198.000...03 must stay rank 198)."""
+    if not latencies:
+        return None
+    ordered = sorted(latencies)
+    rank = math.ceil(q * len(ordered) - 1e-9)
+    idx = max(0, min(len(ordered) - 1, rank - 1))
+    return round(ordered[idx] * 1000.0, 3)
+
+
+def _drive(host: str, port: int, cfg: Tuple[str, str, List[str]],
+           lines: List[str], stop_at: float, interval_s: float, burst: int,
+           timeout_s: float, rng: random.Random,
+           stats: _ClientStats) -> None:
+    _name, log_format, fields = cfg
+    client: Optional[ParseServiceClient] = None
+    next_t = time.monotonic() + rng.uniform(0.0, interval_s)
+    while time.monotonic() < stop_at:
+        if client is None:
+            try:
+                client = ParseServiceClient(
+                    host, port, log_format, fields, timeout=timeout_s
+                )
+            except OSError:
+                stats.connect_errors += 1
+                time.sleep(0.02)
+                continue
+        for _ in range(burst):
+            if time.monotonic() >= stop_at:
+                break
+            stats.requests += 1
+            t0 = time.monotonic()
+            try:
+                table = client.parse(lines)
+            except ServiceBusyError as e:
+                stats.busy += 1
+                if not e.structured:
+                    stats.busy_unstructured += 1
+                stats.busy_reasons[e.reason] = (
+                    stats.busy_reasons.get(e.reason, 0) + 1
+                )
+                if e.reason in ("sessions", "draining"):
+                    # Connection-level shed: the server closes this socket
+                    # by contract — reconnect (after the hint) to keep the
+                    # overload pressure standing.
+                    _quiet_close(client)
+                    client = None
+                time.sleep(max(e.retry_after_s, 0.01) * rng.uniform(0.5, 1.5))
+                break
+            except ServiceDeadlineError:
+                stats.deadline += 1
+            except ServiceClosedError:
+                stats.resets += 1
+                _quiet_close(client)
+                client = None
+                break
+            except ParseServiceError:
+                stats.errors += 1
+            except OSError:
+                stats.resets += 1
+                _quiet_close(client)
+                client = None
+                break
+            else:
+                stats.ok += 1
+                stats.lines_ok += table.num_rows
+                stats.latencies.append(time.monotonic() - t0)
+        # Open-loop pacing: the NEXT burst is due on the clock, not after
+        # this one's responses; a late client fires immediately.
+        next_t += interval_s
+        now = time.monotonic()
+        if next_t > now:
+            time.sleep(min(next_t - now, max(0.0, stop_at - now)))
+    _quiet_close(client)
+
+
+def run_loadgen(host: str, port: int, *, clients: int = 8,
+                duration_s: float = 3.0, batch_lines: int = 128,
+                burst: int = 4, interval_s: float = 0.05,
+                formats: Optional[Sequence[Tuple[str, str, List[str]]]] = None,
+                seed: int = 7, timeout_s: float = 30.0) -> Dict[str, Any]:
+    """Drive the service at ``host:port`` and return the SLO record:
+    outcome counts, ok-request p50/p99 (ms), and goodput
+    (ok lines per wall second)."""
+    fmts = list(formats or DEFAULT_FORMATS)
+    corpora = {name: make_lines(name, batch_lines, seed=seed)
+               for name, _lf, _f in fmts}
+    per_client = [_ClientStats() for _ in range(clients)]
+    t_start = time.monotonic()
+    stop_at = t_start + duration_s
+    threads = []
+    for i in range(clients):
+        cfg = fmts[i % len(fmts)]
+        t = threading.Thread(
+            target=_drive,
+            args=(host, port, cfg, corpora[cfg[0]], stop_at, interval_s,
+                  burst, timeout_s, random.Random(seed * 1000 + i),
+                  per_client[i]),
+            name=f"loadgen-{i}", daemon=True,
+        )
+        t.start()
+        threads.append(t)
+    for t in threads:
+        # Generous join slack: a client mid-request at stop_at finishes
+        # that request (bounded by the socket timeout) before exiting.
+        t.join(timeout=duration_s + timeout_s + 10.0)
+    wall_s = time.monotonic() - t_start
+    total = _ClientStats()
+    for s in per_client:
+        total.merge(s)
+    return {
+        "clients": clients,
+        "duration_s": round(wall_s, 3),
+        "batch_lines": batch_lines,
+        "burst": burst,
+        "interval_s": interval_s,
+        "formats": [name for name, _lf, _f in fmts],
+        "requests": total.requests,
+        "ok": total.ok,
+        "busy": total.busy,
+        "busy_unstructured": total.busy_unstructured,
+        "busy_reasons": dict(sorted(total.busy_reasons.items())),
+        "deadline": total.deadline,
+        "errors": total.errors,
+        "resets": total.resets,
+        "connect_errors": total.connect_errors,
+        "lines_ok": total.lines_ok,
+        "goodput_lines_per_sec": round(total.lines_ok / wall_s, 1)
+        if wall_s > 0 else 0.0,
+        "p50_ms": _percentile_ms(total.latencies, 0.50),
+        "p99_ms": _percentile_ms(total.latencies, 0.99),
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: run one loadgen window against a live service and print the
+    JSON record."""
+    import argparse
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--batch-lines", type=int, default=128)
+    ap.add_argument("--burst", type=int, default=4)
+    ap.add_argument("--interval", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    record = run_loadgen(
+        args.host, args.port, clients=args.clients,
+        duration_s=args.duration, batch_lines=args.batch_lines,
+        burst=args.burst, interval_s=args.interval, seed=args.seed,
+    )
+    print(json.dumps(record, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — CLI
+    raise SystemExit(main())

@@ -1,0 +1,183 @@
+"""CI service smoke: overload shedding + graceful drain against a LIVE
+sidecar (docs/SERVICE.md acceptance drill).
+
+Boots an in-process :class:`~logparser_tpu.service.ParseService` with a
+deliberately tiny admission budget, then:
+
+1. **Overload burst** — `tools/loadgen.py` drives 2x the session budget.
+   Asserts ZERO connection resets (every refusal is a structured ``BUSY``
+   error frame), zero unstructured sheds, and that goodput still flowed
+   (the admitted sessions were served while the rest shed).
+2. **Exposition** — scrapes ``/metrics`` and requires the overload metric
+   families (``service_shed_total{reason}``, active-session gauges) in a
+   structurally valid exposition (`metrics_smoke.validate_exposition`).
+3. **Drain drill** — with a session still OPEN, starts
+   ``shutdown(drain=True)``: ``/readyz`` must flip to 503 ``draining``
+   while ``/healthz`` stays 200, the in-flight session must still
+   complete a request (drain finishes admitted work, never drops it),
+   and after the drain no ``svc-sess-*`` thread may survive.
+
+Usage::
+
+    make service-smoke
+    python -m logparser_tpu.tools.service_smoke
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import List
+
+
+def _http_status(url: str) -> int:
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def main() -> int:
+    # Shed/drain smoke, not a perf run: never acquire a TPU for this.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from logparser_tpu.service import ParseService, ParseServiceClient
+    from logparser_tpu.tools.loadgen import make_lines, run_loadgen
+    from logparser_tpu.tools.metrics_smoke import validate_exposition
+
+    problems: List[str] = []
+    fields = ["IP:connection.client.host", "STRING:request.status.last"]
+    lines = make_lines("combined", 64, seed=11)
+
+    with ParseService(
+        metrics_port=0,
+        max_sessions=2,
+        max_inflight=2,
+        busy_retry_after_s=0.05,
+        drain_deadline_s=15.0,
+    ) as svc:
+        # Warm both drill formats OUTSIDE the timed burst: a cold XLA
+        # compile inside the window would measure the compiler.
+        with ParseServiceClient(svc.host, svc.port, "combined",
+                                fields) as warm:
+            warm.parse(lines)
+        with ParseServiceClient(
+            svc.host, svc.port, '%h %l %u %t "%r" %>s %b',
+            ["IP:connection.client.host", "BYTES:response.body.bytes"],
+        ) as warm:
+            warm.parse(make_lines("common", 64, seed=11))
+
+        # 1) Overload burst: 2x the session budget.
+        record = run_loadgen(
+            svc.host, svc.port, clients=4, duration_s=2.0,
+            batch_lines=64, burst=2, interval_s=0.02,
+        )
+        if record["resets"]:
+            problems.append(
+                f"{record['resets']} connection resets under overload "
+                "(every refusal must be a structured BUSY frame)"
+            )
+        if record["busy"] == 0:
+            problems.append(
+                "overload burst at 2x session budget never shed "
+                "(admission control is not engaging)"
+            )
+        if record["busy_unstructured"]:
+            problems.append(
+                f"{record['busy_unstructured']} BUSY frames carried "
+                "unparseable detail JSON"
+            )
+        if record["ok"] == 0:
+            problems.append("no request succeeded during the burst "
+                            "(admitted sessions were not served)")
+
+        # 2) /metrics must expose the overload families, well-formed.
+        url = f"http://{svc.host}:{svc.metrics_port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode("utf-8")
+        problems.extend(validate_exposition(text))
+        for needle in ("logparser_tpu_service_shed_total",
+                       "logparser_tpu_service_sessions_active",
+                       "logparser_tpu_service_requests_total"):
+            if needle not in text:
+                problems.append(f"required metric absent: {needle}")
+
+        # 3) Drain drill: readyz flips while an open session finishes.
+        base = f"http://{svc.host}:{svc.metrics_port}"
+        if _http_status(base + "/readyz") != 200:
+            problems.append("/readyz not 200 before drain")
+        client = ParseServiceClient(svc.host, svc.port, "combined", fields)
+        # One served request BEFORE the drain starts: proves the session
+        # is admitted server-side, so the drill never races the accept
+        # loop on a loaded CI box.
+        client.parse(lines)
+        drainer = threading.Thread(
+            target=lambda: svc.shutdown(drain=True), daemon=True
+        )
+        drainer.start()
+        flipped = False
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if _http_status(base + "/readyz") == 503:
+                flipped = True
+                break
+            time.sleep(0.02)
+        if not flipped:
+            problems.append("/readyz never flipped to 503 during drain")
+        if _http_status(base + "/healthz") != 200:
+            problems.append("/healthz not 200 during drain (liveness must "
+                            "hold while draining)")
+        # New connections during the drain window get the STRUCTURED
+        # draining shed (the listener stays up until admitted sessions
+        # finish), never ECONNREFUSED.
+        try:
+            ParseServiceClient(
+                svc.host, svc.port, "combined", fields
+            ).parse(lines[:1])
+            problems.append("a new session was admitted during drain")
+        except Exception as e:  # noqa: BLE001 — classify below
+            from logparser_tpu.service import ServiceBusyError
+
+            if not (isinstance(e, ServiceBusyError)
+                    and e.reason == "draining"):
+                problems.append(
+                    "new connection during drain did not shed "
+                    f"BUSY(draining): {type(e).__name__}: {e}"
+                )
+        try:
+            table = client.parse(lines)
+            if table.num_rows != len(lines):
+                problems.append("drained session returned a short table")
+        except Exception as e:  # noqa: BLE001 — the drill must report, not die
+            problems.append(
+                f"in-flight session failed during drain: {type(e).__name__}: {e}"
+            )
+        client.close()
+        drainer.join(timeout=20)
+        if drainer.is_alive():
+            problems.append("drain did not complete within its deadline")
+
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("svc-sess-") and t.is_alive()]
+    if leaked:
+        problems.append(f"leaked session threads after drain: {leaked}")
+
+    if problems:
+        print(f"service smoke FAILED ({len(problems)} problems):")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(
+        "service smoke OK: "
+        f"{record['ok']} served / {record['busy']} structured sheds "
+        f"({record['busy_reasons']}) / 0 resets; readyz flipped during "
+        "drain; no leaked session threads"
+    )
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — CLI
+    sys.exit(main())

@@ -1,11 +1,24 @@
 """Sidecar parse service: framing, Arrow IPC round trip, error relay,
-parser caching (SURVEY §7.5 "sidecar service mode")."""
+parser caching (SURVEY §7.5 "sidecar service mode"), and the round-12
+robustness tier: admission control / structured BUSY shedding, deadlines,
+malformed-wire hardening, graceful drain (docs/SERVICE.md)."""
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
 import pytest
 
+from logparser_tpu.observability import metrics
 from logparser_tpu.service import (
     ParseService,
     ParseServiceClient,
     ParseServiceError,
+    ServiceBusyError,
+    ServiceDeadlineError,
 )
 from logparser_tpu.tools.demolog import generate_combined_lines
 
@@ -183,3 +196,453 @@ def test_feeder_parse_failure_relays_error_frame_and_survives(monkeypatch):
             table = client.parse(lines)  # same socket, degraded inline
     assert table.num_rows == 40
     assert calls == [40]
+
+
+# ---------------------------------------------------------------------------
+# round 12 — serving-tier robustness (docs/SERVICE.md): admission control
+# with structured BUSY sheds, deadlines, input hardening, graceful drain.
+# ---------------------------------------------------------------------------
+
+
+class _StubResult:
+    oracle_rows = 0
+    bad_lines = 0
+
+    def __init__(self, n):
+        self.n = n
+
+    def to_arrow(self, include_validity=True, strings="copy"):
+        import pyarrow as pa
+
+        return pa.table({"x": list(range(self.n))})
+
+
+class _StubParser:
+    """Cache-injected parser double: no XLA compile, optional per-call
+    delays (``first_delays`` pop per request, then ``delay``)."""
+
+    def __init__(self, delay=0.0, first_delays=()):
+        self.delay = delay
+        self._first = list(first_delays)
+
+    def _sleep(self):
+        d = self._first.pop(0) if self._first else self.delay
+        if d:
+            time.sleep(d)
+
+    def parse_batch(self, rows, emit_views=False):
+        self._sleep()
+        return _StubResult(len(rows))
+
+    def parse_blob(self, blob, emit_views=False):
+        self._sleep()
+        return _StubResult(blob.count(b"\n") + 1)
+
+
+def _install_stub(svc, delay=0.0, first_delays=()):
+    parser = _StubParser(delay, first_delays)
+    svc._server.parser_cache.get = lambda cfg: parser
+    return parser
+
+
+def _wait_admitted(svc, n=1, deadline_s=2.0):
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        with svc._server.sessions_lock:
+            if sum(1 for h in svc._server.sessions if h.admitted) >= n:
+                return
+        time.sleep(0.01)
+    raise AssertionError(f"never saw {n} admitted sessions")
+
+
+def _send_frame(sock, payload: bytes):
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return bytes(buf)
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_response(sock):
+    """(kind, payload): 'arrow' | 'error' | 'eof' per PROTOCOL.md."""
+    header = _recv_exact(sock, 4)
+    if len(header) < 4:
+        return "eof", b""
+    (n,) = struct.unpack(">I", header)
+    if n == 0xFFFFFFFF:
+        (m,) = struct.unpack(">I", _recv_exact(sock, 4))
+        return "error", _recv_exact(sock, m)
+    return "arrow", _recv_exact(sock, n)
+
+
+_RAW_CONFIG = json.dumps({
+    "log_format": "combined", "fields": FIELDS[:1],
+    "timestamp_format": None,
+}).encode()
+
+
+def test_session_shed_is_structured_busy():
+    """Over the session budget a connection gets a structured BUSY frame
+    with the server's retry hint — never a reset — and the slot frees
+    when the holder leaves."""
+    before = metrics().get("service_shed_total",
+                           labels={"reason": "sessions"})
+    with ParseService(max_sessions=1, busy_retry_after_s=0.123) as svc:
+        _install_stub(svc)
+        holder = socket.create_connection((svc.host, svc.port))
+        try:
+            _wait_admitted(svc)
+            with pytest.raises(ServiceBusyError) as ei:
+                ParseServiceClient(
+                    svc.host, svc.port, "combined", FIELDS[:1]
+                ).parse(["x"])
+            assert ei.value.reason == "sessions"
+            assert ei.value.structured
+            assert ei.value.retry_after_s == pytest.approx(0.123)
+        finally:
+            holder.close()
+        # The freed slot admits the next session.
+        end = time.monotonic() + 2.0
+        while True:
+            try:
+                with ParseServiceClient(
+                    svc.host, svc.port, "combined", FIELDS[:1]
+                ) as client:
+                    assert client.parse(["x"]).num_rows == 1
+                break
+            except ServiceBusyError:
+                assert time.monotonic() < end, "slot never freed"
+                time.sleep(0.02)
+    assert metrics().get("service_shed_total",
+                         labels={"reason": "sessions"}) > before
+
+
+def test_request_shed_inflight_session_survives():
+    """Over the in-flight cap a REQUEST sheds BUSY but its session
+    survives and the next request (after capacity frees) succeeds."""
+    with ParseService(max_sessions=4, max_inflight=1) as svc:
+        _install_stub(svc, delay=0.0, first_delays=[0.6])
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1]
+        ) as slow, ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1]
+        ) as fast:
+            t = threading.Thread(target=lambda: slow.parse(["a"] * 3))
+            t.start()
+            time.sleep(0.15)  # slow's request holds the one slot
+            with pytest.raises(ServiceBusyError) as ei:
+                fast.parse(["b"])
+            assert ei.value.reason == "inflight"
+            t.join(5)
+            # Same socket, after the slot freed: served.
+            assert fast.parse(["b"]).num_rows == 1
+
+
+def test_backpressure_signal_sheds_requests(monkeypatch):
+    """A saturated feeder fabric (queue_backpressure >= threshold) sheds
+    per-request with reason=backpressure."""
+    import logparser_tpu.feeder as feeder_mod
+
+    monkeypatch.setattr(feeder_mod, "queue_backpressure", lambda: 1.0)
+    with ParseService() as svc:
+        _install_stub(svc)
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1]
+        ) as client:
+            with pytest.raises(ServiceBusyError) as ei:
+                client.parse(["x"])
+            assert ei.value.reason == "backpressure"
+
+
+def test_pool_backpressure_fraction():
+    """FeederPool.backpressure(): 0 before start/after close, rises when
+    the consumer stalls against the bounded queue, and feeds the
+    process-wide queue_backpressure() aggregate."""
+    from logparser_tpu.feeder import FeederPool, queue_backpressure
+
+    blob = b"\n".join(f"line {i}".encode() for i in range(400))
+    pool = FeederPool([blob], workers=1, shard_bytes=len(blob),
+                      batch_lines=10, use_processes=False, queue_batches=2)
+    assert pool.backpressure() == 0.0
+    it = pool.batches()
+    next(it)  # start the pool; the stalled consumer lets the queue fill
+    end = time.monotonic() + 2.0
+    while pool.backpressure() == 0.0 and time.monotonic() < end:
+        time.sleep(0.02)
+    assert pool.backpressure() > 0.0
+    assert queue_backpressure() >= pool.backpressure()
+    pool.close()
+    assert pool.backpressure() == 0.0
+    assert queue_backpressure() == 0.0
+
+
+def test_ring_backpressure_can_saturate():
+    """Ring-transport occupancy is measured against REACHABLE capacity
+    (slots, not the descriptor-queue bound + control slack), so a wedged
+    fabric can actually cross the 0.95 shed threshold."""
+    from logparser_tpu.feeder import FeederPool, ring_available
+
+    if not ring_available():
+        pytest.skip("shared memory unavailable")
+    blob = b"\n".join(f"line {i}".encode() for i in range(400))
+    pool = FeederPool([blob], workers=1, shard_bytes=len(blob),
+                      batch_lines=10, use_processes=False,
+                      transport="ring", queue_batches=2)
+    it = pool.batches()
+    next(it)  # start; the stalled consumer lets the worker lease all slots
+    end = time.monotonic() + 2.0
+    while pool.backpressure() < 0.95 and time.monotonic() < end:
+        time.sleep(0.02)
+    assert pool.backpressure() >= 0.95
+    pool.close()
+
+
+def test_zero_timeouts_disable_not_nonblocking():
+    """idle/frame timeout 0 means DISABLED (like every other 0-disables
+    knob), never non-blocking sockets that kill every session."""
+    with ParseService(idle_timeout_s=0.0, frame_timeout_s=0) as svc:
+        assert svc.limits.idle_timeout_s is None
+        assert svc.limits.frame_timeout_s is None
+        _install_stub(svc)
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1]
+        ) as client:
+            time.sleep(0.1)  # an instant-kill server would already be gone
+            assert client.parse(["x"]).num_rows == 1
+
+
+def test_request_deadline_yields_deadline_frame_and_survives():
+    """An expired request answers a structured DEADLINE frame; the
+    session survives and its next request succeeds."""
+    before = metrics().get("service_deadline_expired_total")
+    with ParseService(request_deadline_s=0.15) as svc:
+        _install_stub(svc, first_delays=[0.6])
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1]
+        ) as client:
+            with pytest.raises(ServiceDeadlineError) as ei:
+                client.parse(["a", "b"])
+            assert ei.value.deadline_s == pytest.approx(0.15)
+            assert client.parse(["a", "b"]).num_rows == 2
+    assert metrics().get("service_deadline_expired_total") == before + 1
+
+
+def test_idle_timeout_closes_cleanly():
+    before = metrics().get("service_timeouts_total",
+                           labels={"kind": "idle"})
+    with ParseService(idle_timeout_s=0.2) as svc:
+        sock = socket.create_connection((svc.host, svc.port))
+        sock.settimeout(5)
+        assert sock.recv(1) == b""  # clean EOF, not a reset
+        sock.close()
+    assert metrics().get("service_timeouts_total",
+                         labels={"kind": "idle"}) == before + 1
+
+
+def test_mid_frame_stall_times_out():
+    before = metrics().get("service_timeouts_total",
+                           labels={"kind": "frame"})
+    with ParseService(idle_timeout_s=5.0, frame_timeout_s=0.2) as svc:
+        sock = socket.create_connection((svc.host, svc.port))
+        sock.sendall(b"\x00\x00")  # half a header, then silence
+        sock.settimeout(5)
+        assert sock.recv(1) == b""
+        sock.close()
+    assert metrics().get("service_timeouts_total",
+                         labels={"kind": "frame"}) == before + 1
+
+
+def test_client_busy_retry_with_backoff():
+    """The BUSY-aware client absorbs session sheds: reconnect + jittered
+    backoff honoring the retry hint, then success once a slot frees."""
+    with ParseService(max_sessions=1, busy_retry_after_s=0.02) as svc:
+        _install_stub(svc)
+        holder = socket.create_connection((svc.host, svc.port))
+        _wait_admitted(svc)
+        threading.Timer(0.3, holder.close).start()
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1],
+            busy_retries=20, backoff_base_s=0.02,
+        ) as client:
+            assert client.parse(["x"]).num_rows == 1
+            assert client.busy_seen >= 1
+
+
+# -- malformed-wire fuzz: every case must end in an error frame or a clean
+#    close — never a traceback escaping the handler, never a hang. ---------
+
+
+def test_fuzz_truncated_config_frame(service):
+    sock = socket.create_connection((service.host, service.port))
+    sock.sendall(struct.pack(">I", 100) + b"ten bytes!")
+    sock.close()
+    # The service survives: a fresh session on the same server parses.
+    with ParseServiceClient(
+        service.host, service.port, "combined", FIELDS[:1]
+    ) as client:
+        assert client.parse(["x"]).num_rows == 1
+
+
+def test_fuzz_oversized_length_prefix(service):
+    """A hostile ~4 GiB length prefix costs one error frame (+ clean
+    close), never an allocation."""
+    sock = socket.create_connection((service.host, service.port))
+    try:
+        sock.sendall(struct.pack(">I", 0xF0000000))
+        sock.settimeout(5)
+        kind, payload = _recv_response(sock)
+        assert kind == "error"
+        assert b"cap" in payload
+        assert _recv_response(sock)[0] == "eof"
+    finally:
+        sock.close()
+
+
+def test_fuzz_non_json_config(service):
+    sock = socket.create_connection((service.host, service.port))
+    try:
+        _send_frame(sock, b"\x00\x01 this is not json {{{")
+        _send_frame(sock, struct.pack(">I", 1) + b"x")  # pipelined LINES
+        sock.settimeout(5)
+        kind, payload = _recv_response(sock)
+        assert kind == "error" and b"bad config" in payload
+        kind2, _ = _recv_response(sock)
+        assert kind2 == "error"
+    finally:
+        sock.close()
+
+
+def test_fuzz_mid_frame_disconnect(service):
+    sock = socket.create_connection((service.host, service.port))
+    _send_frame(sock, _RAW_CONFIG)
+    sock.sendall(struct.pack(">I", 50) + b"five!")  # truncated LINES
+    sock.close()
+    with ParseServiceClient(
+        service.host, service.port, "combined", FIELDS[:1]
+    ) as client:
+        assert client.parse(["x"]).num_rows == 1
+
+
+def test_fuzz_zero_length_lines_frame(service):
+    """A LINES frame shorter than its count header errors; the session
+    survives to parse the next frame."""
+    sock = socket.create_connection((service.host, service.port))
+    try:
+        _send_frame(sock, _RAW_CONFIG)
+        _send_frame(sock, b"\x00\x00")  # 2-byte LINES payload
+        sock.settimeout(10)
+        kind, payload = _recv_response(sock)
+        assert kind == "error" and b"count header" in payload
+        _send_frame(sock, struct.pack(">I", 1) + b"x")
+        assert _recv_response(sock)[0] == "arrow"
+        sock.sendall(struct.pack(">I", 0))
+    finally:
+        sock.close()
+
+
+def test_lines_payload_cap_discards_and_survives():
+    """A LINES frame over the payload cap is consumed WITHOUT allocation,
+    answered with an error frame, and the session survives."""
+    before = metrics().get("service_rejected_frames_total",
+                           labels={"reason": "lines_too_large"})
+    with ParseService(max_lines_bytes=64) as svc:
+        _install_stub(svc)
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1]
+        ) as client:
+            with pytest.raises(ParseServiceError, match="cap"):
+                client.parse(["y" * 200])
+            assert client.parse(["tiny"]).num_rows == 1
+    assert metrics().get("service_rejected_frames_total",
+                         labels={"reason": "lines_too_large"}) == before + 1
+
+
+def test_config_payload_cap():
+    with ParseService(max_config_bytes=32) as svc:
+        client = ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS  # > 32-byte CONFIG
+        )
+        with pytest.raises(ParseServiceError, match="bad config"):
+            client.parse(["x"])
+        client.close()
+
+
+# -- graceful drain (acceptance): readyz flips, admitted work completes,
+#    no leaked threads. ----------------------------------------------------
+
+
+def _http_status(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_graceful_drain_completes_admitted_requests():
+    with ParseService(metrics_port=0, drain_deadline_s=10.0) as svc:
+        _install_stub(svc, delay=0.3)
+        base = f"http://{svc.host}:{svc.metrics_port}"
+        assert _http_status(base + "/readyz") == 200
+        assert _http_status(base + "/healthz") == 200
+        client = ParseServiceClient(svc.host, svc.port, "combined",
+                                    FIELDS[:1])
+        results = []
+        req = threading.Thread(
+            target=lambda: results.append(client.parse(["a", "b", "c"]))
+        )
+        req.start()
+        time.sleep(0.05)  # request in flight
+        assert any(t.name.startswith("svc-sess-")
+                   for t in threading.enumerate())
+        drainer = threading.Thread(
+            target=lambda: svc.shutdown(drain=True), daemon=True
+        )
+        drainer.start()
+        # readyz flips to draining while the session is still in flight
+        # (the flip happens BEFORE the listener closes).
+        end = time.monotonic() + 3.0
+        while _http_status(base + "/readyz") != 503:
+            assert time.monotonic() < end, "/readyz never flipped"
+            time.sleep(0.02)
+        assert _http_status(base + "/healthz") == 200
+        req.join(5)
+        assert results and results[0].num_rows == 3
+        # The admitted session keeps serving THROUGH the drain window.
+        assert client.parse(["d"]).num_rows == 1
+        # A NEW connection during the window sheds structured
+        # BUSY(draining) — the listener stays up until admitted
+        # sessions finish, so readiness propagation never turns into
+        # ECONNREFUSED.
+        with pytest.raises(ServiceBusyError) as ei:
+            ParseServiceClient(
+                svc.host, svc.port, "combined", FIELDS[:1]
+            ).parse(["x"])
+        assert ei.value.reason == "draining"
+        client.close()
+        drainer.join(15)
+        assert not drainer.is_alive()
+        # Listener is closed: new connections are refused, not shed.
+        with pytest.raises(OSError):
+            socket.create_connection((svc.host, svc.port), timeout=1)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("svc-sess-") and t.is_alive()]
+
+
+def test_note_teardown_counts_and_warns_once():
+    from logparser_tpu.observability import note_teardown
+    import logging
+
+    log = logging.getLogger("test.teardown")
+    before = metrics().get("service_teardown_errors_total",
+                           labels={"site": "unit_test"})
+    note_teardown(log, "service_teardown_errors_total", "unit_test", "boom")
+    note_teardown(log, "service_teardown_errors_total", "unit_test", "boom")
+    assert metrics().get("service_teardown_errors_total",
+                         labels={"site": "unit_test"}) == before + 2
