@@ -1,7 +1,7 @@
 # Dev entry points (the reference's Maven/devtools tier, L0).
 PY ?= python
 
-.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke job-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -62,6 +62,15 @@ rescue-smoke:
 # chaos-smoke.
 service-smoke:
 	$(PY) -m logparser_tpu.tools.service_smoke
+
+# Job smoke: the durable batch tier's kill-drill (docs/JOBS.md) — run a
+# corpus->sharded-Arrow job, SIGKILL (-9) it mid-run from outside, and
+# resume from the manifest: the merged output (data + reject tables)
+# must be byte-identical to a single-shot run, committed shards must
+# never be re-parsed, and no temp file or shm segment may leak.  CI
+# runs this after service-smoke.
+job-smoke:
+	$(PY) -m logparser_tpu.tools.job_smoke
 
 lint:
 	$(PY) -m ruff check logparser_tpu tests

@@ -104,6 +104,20 @@ SERVICE_SESSIONS = 4
 SERVICE_OVERLOAD_FACTOR = 2
 SERVICE_LOADGEN_SECONDS = 3.0
 SERVICE_BATCH_LINES = 256
+# Durable-jobs drill (round 13, docs/JOBS.md): a job interrupted at a
+# commit boundary halfway through and RESUMED must (a) produce merged
+# output byte-identical to an undisturbed run (content hash over data +
+# reject tables in shard order), (b) never re-parse committed shards,
+# and (c) retain at least this fraction of the undisturbed throughput
+# across the interrupt+resume total wall — resuming is allowed to cost
+# a replayed in-flight shard and a manifest read, not a rerun.
+JOBS_RETENTION_GATE = 0.70
+# 2x the headline corpus on disk, ~16 shards at 2 MiB: three timed runs
+# (undisturbed, interrupted, resumed) stay bounded while the interrupt
+# still lands mid-corpus with a real committed prefix.
+JOBS_CORPUS_SCALE = FEEDER_CORPUS_REPEATS
+JOBS_SHARD_BYTES = 2 << 20
+JOBS_BATCH_LINES = CONFIG_BATCH
 
 GEO_TEST_DATA = "/root/reference/GeoIP2-TestData/test-data"
 if not os.path.isdir(GEO_TEST_DATA):
@@ -605,6 +619,111 @@ def bench_faults(lines):
         "wall_killed_s": round(killed["wall_s"], 4),
         "byte_identical": True,
     }
+
+
+def bench_jobs(parser, lines):
+    """The durable-jobs drill (round 13, docs/JOBS.md): steady-state
+    job throughput, resume overhead, and the kill-drill invariant.
+
+    Three runs over the same disk corpus: (1) undisturbed — the steady
+    GB/s record and the reference content hash; (2) interrupted at the
+    halfway commit boundary (JobPolicy.stop_after_shards — the timed
+    twin of tools/job_smoke.py's real SIGKILL drill) then (3) resumed
+    to completion.  Gated: byte-identical merged output, committed
+    shards never re-parsed, and interrupted-total throughput >=
+    JOBS_RETENTION_GATE of undisturbed."""
+    import shutil
+    import tempfile
+
+    from logparser_tpu.jobs import (
+        JobManifest,
+        JobPolicy,
+        JobSpec,
+        merged_hash,
+        run_job,
+    )
+
+    blob = "\n".join(lines).encode()
+    corpus = b"\n".join([blob] * JOBS_CORPUS_SCALE)
+    tmpdir = tempfile.mkdtemp(prefix="bench-jobs-")
+    try:
+        path = os.path.join(tmpdir, "corpus.log")
+        with open(path, "wb") as f:
+            f.write(corpus)
+
+        def spec(name):
+            return JobSpec(
+                [path], "combined", HEADLINE_FIELDS,
+                os.path.join(tmpdir, name),
+                shard_bytes=JOBS_SHARD_BYTES,
+                batch_lines=JOBS_BATCH_LINES,
+            )
+
+        t0 = time.perf_counter()
+        ref = run_job(spec("undisturbed"), parser=parser)
+        und_wall = time.perf_counter() - t0
+        if not ref.complete:
+            raise RuntimeError(
+                f"jobs drill: undisturbed run incomplete "
+                f"({len(ref.failed)} failed shards)"
+            )
+        ref_hash = merged_hash(
+            spec("undisturbed").out_dir,
+            JobManifest.load(spec("undisturbed").out_dir),
+        )
+        half = max(1, ref.shards_total // 2)
+        t0 = time.perf_counter()
+        r1 = run_job(spec("interrupted"), parser=parser,
+                     policy=JobPolicy(stop_after_shards=half))
+        t1 = time.perf_counter()
+        if not r1.stopped_early or r1.committed != half:
+            raise RuntimeError(
+                f"jobs drill: interrupt never landed (committed "
+                f"{r1.committed} of a {half}-shard budget)"
+            )
+        r2 = run_job(spec("interrupted"), parser=parser)
+        int_wall = time.perf_counter() - t0
+        resume_wall = time.perf_counter() - t1
+        if r2.skipped != half:
+            raise RuntimeError(
+                f"jobs drill: resume re-parsed committed work "
+                f"(skipped {r2.skipped}, expected {half})"
+            )
+        if not r2.complete:
+            raise RuntimeError("jobs drill: resumed run incomplete")
+        int_hash = merged_hash(
+            spec("interrupted").out_dir,
+            JobManifest.load(spec("interrupted").out_dir),
+        )
+        byte_identical = int_hash == ref_hash
+        if not byte_identical:
+            raise RuntimeError(
+                "jobs drill: interrupted+resumed output is NOT "
+                "byte-identical to the undisturbed run"
+            )
+        und_bps = len(corpus) / und_wall if und_wall > 0 else 0.0
+        int_bps = len(corpus) / int_wall if int_wall > 0 else 0.0
+        return {
+            "corpus_bytes": len(corpus),
+            "shards": ref.shards_total,
+            "rows": ref.rows,
+            "rejects": ref.rejects,
+            "reject_reasons": ref.reject_reasons,
+            "steady_gb_per_sec": round(und_bps / 1e9, 4),
+            "interrupted_gb_per_sec": round(int_bps / 1e9, 4),
+            "kill_drill_retention": round(
+                int_bps / und_bps, 4) if und_bps else 0.0,
+            "resume_overhead_fraction": round(
+                max(0.0, int_wall / und_wall - 1.0), 4
+            ) if und_wall else 0.0,
+            "resume_wall_s": round(resume_wall, 4),
+            "shards_committed_before_interrupt": half,
+            "byte_identical": byte_identical,
+            "wall_undisturbed_s": round(und_wall, 4),
+            "wall_interrupted_total_s": round(int_wall, 4),
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def hardware_fingerprint():
@@ -1243,6 +1362,13 @@ def main():
     except Exception as e:  # noqa: BLE001 — the drill must not kill the run
         service_section = {"error": f"{type(e).__name__}: {e}"}
 
+    # ---- jobs: the durable batch-tier drill (round 13) ------------------
+    # Clean-phase too (feeder worker processes + wall-clock ratios).
+    try:
+        jobs_section = bench_jobs(parser, lines)
+    except Exception as e:  # noqa: BLE001 — the drill must not kill the run
+        jobs_section = {"error": f"{type(e).__name__}: {e}"}
+
     # ---- all five BASELINE configs: host-side phase ---------------------
     # Strict two-phase order: every HOST measurement (oracle, Arrow) for
     # every config BEFORE the first kernel_rate call — the xplane parse
@@ -1471,6 +1597,24 @@ def main():
                 f"{SERVICE_OVERLOAD_FACTOR}x overload burst (below "
                 f"{SERVICE_RETENTION_GATE:.0%})"
             )
+    # (e4) Jobs gate (round 13): the durable batch tier must survive an
+    #      interrupt at a commit boundary with byte-identical merged
+    #      output (asserted inside the drill — an error here IS the
+    #      failed assertion) and keep >= JOBS_RETENTION_GATE of the
+    #      undisturbed throughput across interrupt + resume.
+    if "error" in jobs_section:
+        gate_failures.append(f"jobs: {jobs_section['error']}")
+    else:
+        retention = jobs_section.get("kill_drill_retention", 0.0)
+        if retention < JOBS_RETENTION_GATE:
+            gate_failures.append(
+                f"jobs: kill-drill retention {retention:.2f} (below "
+                f"{JOBS_RETENTION_GATE:.0%})"
+            )
+        if not jobs_section.get("byte_identical"):
+            gate_failures.append(
+                "jobs: interrupted+resumed output not byte-identical"
+            )
     # (f) Rescue gate (round 9): combined_rescue's MEASURED effective rate
     #     (real mixed stream; rescue term = traced oracle_fallback wall)
     #     must stay at/above the floor — the rescue cliff must not reopen.
@@ -1563,6 +1707,9 @@ def main():
         # structured-shed + goodput-retention gates, hardware fingerprint
         # (docs/SERVICE.md).
         "service": service_section,
+        # The durable batch-tier drill: steady job GB/s, interrupt +
+        # resume byte parity, kill-drill retention (docs/JOBS.md).
+        "jobs": jobs_section,
         "pipelined_end_to_end_lines_per_sec": round(pipelined, 1),
         "stream_lines_per_sec": round(stream_lps, 1),
         "serialized_lines_per_sec": round(serialized_lps, 1),
@@ -1667,6 +1814,17 @@ def main():
                 "retention": service_section["goodput_retention"],
                 "shed": service_section["overload"].get("busy", 0),
                 "resets": service_section["overload"].get("resets", 0),
+            }
+        ),
+        # Durable-jobs drill (round 13): the compact proof the batch
+        # tier is crash-resumable — kill-drill retention, resume
+        # overhead, steady GB/s.
+        "jobs": (
+            {"error": True} if "error" in jobs_section else {
+                "gbps": jobs_section["steady_gb_per_sec"],
+                "retention": jobs_section["kill_drill_retention"],
+                "resume_ovh": jobs_section["resume_overhead_fraction"],
+                "rejects": jobs_section["rejects"],
             }
         ),
         # Rescue composition (round 9): the gated measured effective rate,

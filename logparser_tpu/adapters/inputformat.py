@@ -266,7 +266,16 @@ class LogfileRecordReader:
                 if not line:
                     break
                 pos += len(line)
-                yield line.rstrip(b"\r\n")
+                # Strip ONE newline then ONE carriage return — exactly
+                # encode_blob's framing (and the regex's effective
+                # view).  rstrip(b"\r\n") would eat every trailing CR,
+                # so a line ending "...x\r\r\n" diverged between the
+                # split reader and the feeder/blob ingest paths.
+                if line.endswith(b"\n"):
+                    line = line[:-1]
+                if line.endswith(b"\r"):
+                    line = line[:-1]
+                yield line
 
     # -- record production --------------------------------------------------
 

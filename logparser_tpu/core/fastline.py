@@ -825,8 +825,13 @@ class FastLineEngine:
     def parse_many(self, lines, record_factory) -> List[Optional[Any]]:
         """Batched parse with amortized per-call setup: one engine fetch,
         hoisted locals, one record per line.  Returns the record for each
-        parsed line and None for each DissectionFailure — the shape the
-        batch runtime's rescue path consumes."""
+        parsed line, None for each DissectionFailure, and an
+        :class:`~logparser_tpu.core.exceptions.OracleEngineError` marker
+        where the engine itself raised — one broken line costs itself a
+        reasoned reject, never the whole rescue batch (matching
+        ``Parser.parse_many``)."""
+        from .exceptions import OracleEngineError
+
         parse = self.parse
         out: List[Optional[Any]] = []
         append = out.append
@@ -837,6 +842,8 @@ class FastLineEngine:
                 append(rec)
             except DissectionFailure:
                 append(None)
+            except Exception as e:  # noqa: BLE001 — engine fault, per line
+                append(OracleEngineError(f"{type(e).__name__}: {e}"))
         return out
 
 

@@ -555,10 +555,14 @@ class Parser:
         """Batched parse with amortized setup: one engine fetch for the
         whole batch (the per-call dispatch in :meth:`parse` was a
         measurable share of small-rescue cost), one fresh record per
-        line.  Returns the parsed record per line, or None where the
-        line raised DissectionFailure — the shape the batch runtime's
-        rescue path consumes.  Non-dissection errors propagate, exactly
-        like :meth:`parse`."""
+        line.  Returns the parsed record per line, None where the line
+        raised DissectionFailure, and an
+        :class:`~logparser_tpu.core.exceptions.OracleEngineError` marker
+        where the ENGINE itself raised — the shape the batch runtime's
+        rescue path consumes.  One broken line must cost itself a
+        reasoned reject, never abort the other N-1 lines of the rescue
+        batch (the per-line :meth:`parse` keeps raising for its own
+        callers)."""
         self.assemble_dissectors()
         if self.use_fastline:
             engine = self._fastline
@@ -568,6 +572,8 @@ class Parser:
                 engine = self._fastline = compile_fastline(self)
             if engine is not None:
                 return engine.parse_many(lines, record_factory)
+        from .exceptions import OracleEngineError
+
         out: List[Optional[Any]] = []
         for line in lines:
             record = record_factory()
@@ -578,6 +584,8 @@ class Parser:
                 out.append(parsable.get_record())
             except DissectionFailure:
                 out.append(None)
+            except Exception as e:  # noqa: BLE001 — engine fault, per line
+                out.append(OracleEngineError(f"{type(e).__name__}: {e}"))
         return out
 
     def _run(self, parsable: Parsable) -> Parsable:

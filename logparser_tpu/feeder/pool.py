@@ -319,11 +319,35 @@ class FeederPool:
         chaos: Any = None,
         shutdown_timeout_s: float = 5.0,
         backpressure_signal: bool = True,
+        shard_plan: Optional[Sequence[Shard]] = None,
     ):
         if not sources:
             raise ValueError("FeederPool needs at least one source")
         self._sources = normalize_sources(sources)
-        self.shards: List[Shard] = plan_shards(self._sources, shard_bytes)
+        if shard_plan is not None:
+            # Caller-supplied plan (the durable job runner resumes a
+            # partially-committed corpus by feeding only the shards it
+            # still owes).  Indices must be contiguous from 0: shard
+            # ownership (``index % workers``) and the positional worker
+            # split (``shards[w::workers]``) both assume index ==
+            # position — a caller keeping its own identity for each
+            # shard renumbers with dataclasses.replace and maps back by
+            # position (logparser_tpu/jobs does exactly this).
+            shards = list(shard_plan)
+            for i, s in enumerate(shards):
+                if s.index != i:
+                    raise ValueError(
+                        "shard_plan indices must be contiguous from 0 "
+                        f"(shard at position {i} carries index {s.index})"
+                    )
+                if not 0 <= s.source < len(self._sources):
+                    raise ValueError(
+                        f"shard_plan references source {s.source} of "
+                        f"{len(self._sources)}"
+                    )
+            self.shards: List[Shard] = shards
+        else:
+            self.shards = plan_shards(self._sources, shard_bytes)
         n_workers = workers if workers else default_feeder_workers()
         self.workers = max(1, min(int(n_workers), max(1, len(self.shards))))
         self.batch_lines = int(batch_lines)

@@ -1,0 +1,33 @@
+"""Durable batch jobs: corpus -> sharded Arrow files, exactly once.
+
+The batch tier's durability layer (docs/JOBS.md).  ``run_job`` parses
+multi-GB corpora through the feeder fabric + device pipeline into
+per-shard Arrow IPC files with a JSON manifest as the commit log:
+crash-resumable (committed shards are never re-parsed; the merged
+output of a killed-and-resumed run is byte-identical to an undisturbed
+one), with a first-class per-line reject channel (per-shard error
+tables, ``job_rejected_lines_total{reason}``) and writer I/O fault
+tolerance (bounded retry, shard-level failure isolation).
+
+CLI: ``python -m logparser_tpu.jobs`` (see ``--help``).
+"""
+from .manifest import (  # noqa: F401
+    MANIFEST_NAME,
+    JobManifest,
+    ManifestError,
+    ShardRecord,
+)
+from .runner import (  # noqa: F401
+    JobPolicy,
+    JobReport,
+    JobSpec,
+    run_job,
+)
+from .writer import (  # noqa: F401
+    JobWriter,
+    ShardWriteError,
+    build_reject_table,
+    leaked_temp_files,
+    merged_hash,
+    reject_schema,
+)

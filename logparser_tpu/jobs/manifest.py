@@ -1,0 +1,209 @@
+"""Durable job manifest: the commit log of a corpus -> sharded-Arrow job.
+
+The manifest is the SINGLE source of truth for what a job has durably
+produced.  The commit protocol (docs/JOBS.md) is strictly ordered:
+
+1. a shard's data/reject tables are written to ``*.tmp`` files,
+   flushed, **fsync**\\ ed, then atomically **renamed** into place;
+2. only then is the shard's :class:`ShardRecord` added to the manifest,
+   which is itself rewritten atomically (tmp -> fsync -> rename, plus a
+   directory fsync so the rename survives a power cut).
+
+A shard therefore exists in exactly one of two states after ANY crash:
+committed (recorded in the manifest, its files complete and hashed) or
+not committed (absent from the manifest; any leftover ``*.tmp`` debris
+or orphaned output file is overwritten deterministically on resume).
+There is no third state — that is what makes ``resume()`` exactly-once:
+committed shards are skipped wholesale, everything else replays from
+the corpus, and replay is deterministic (same shard plan, same batch
+splits, same parse), so the merged output is byte-identical to an
+undisturbed run's.
+
+The ``job`` fingerprint block pins everything that determines output
+bytes (format, fields, sources, shard/batch geometry).  A resume
+against a manifest whose fingerprint disagrees is REFUSED — silently
+mixing two configurations' shards would corrupt the corpus without any
+crash at all.
+
+Everything here is stdlib-only (json/os/hashlib) and jax-free.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+
+
+class ManifestError(RuntimeError):
+    """The manifest is unreadable, structurally invalid, or belongs to a
+    different job configuration than the one asking to resume."""
+
+
+@dataclass
+class ShardRecord:
+    """One committed shard: identity (the GLOBAL plan index + raw byte
+    range), volume, output files and their content hashes."""
+
+    shard: int                 # global shard index in the job plan
+    source: int                # index into the job's source list
+    start: int                 # raw byte range (pre-healing)
+    end: int
+    lines: int                 # lines parsed (valid + rejected)
+    rows: int                  # data rows written (valid lines)
+    rejects: int               # reject-table rows
+    payload_bytes: int         # healed payload bytes parsed
+    data_file: Optional[str]   # relative filename; None when rows == 0
+    reject_file: Optional[str]  # relative filename; None when rejects == 0
+    data_hash: Optional[str]   # blake2b hex of the data file bytes
+    reject_hash: Optional[str]
+    committed_at: float = 0.0  # wall clock; NOT part of output identity
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ShardRecord":
+        return cls(**{k: d.get(k) for k in cls.__dataclass_fields__})
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a just-renamed entry survives a power cut
+    (rename is atomic but not durable until the directory metadata is
+    flushed).  Best-effort on filesystems that refuse O_RDONLY dir
+    fsync (the rename is still atomic there)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp -> flush -> fsync -> rename -> dir fsync.  The reader either
+    sees the whole previous version or the whole new one, never a
+    torn write."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(os.path.dirname(path) or ".")
+
+
+@dataclass
+class JobManifest:
+    """The on-disk commit log (see module docstring)."""
+
+    job: Dict[str, Any]                      # the config fingerprint block
+    shards: Dict[int, ShardRecord] = field(default_factory=dict)
+    version: int = MANIFEST_VERSION
+    created_at: float = 0.0
+
+    # -- construction / io ----------------------------------------------
+
+    @classmethod
+    def fresh(cls, fingerprint: Dict[str, Any]) -> "JobManifest":
+        return cls(job=dict(fingerprint), created_at=time.time())
+
+    @classmethod
+    def load(cls, out_dir: str) -> Optional["JobManifest"]:
+        """The manifest of ``out_dir``, or None when none exists.
+        Raises :class:`ManifestError` on a corrupt/foreign file — a
+        half-written manifest cannot exist under the atomic-write
+        protocol, so corruption means outside interference and must not
+        be silently treated as 'no job here'."""
+        path = os.path.join(out_dir, MANIFEST_NAME)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                raw = json.loads(f.read().decode("utf-8"))
+            if raw.get("version") != MANIFEST_VERSION:
+                raise ManifestError(
+                    f"manifest version {raw.get('version')!r} != "
+                    f"{MANIFEST_VERSION} (written by a different build?)"
+                )
+            shards = {
+                int(k): ShardRecord.from_dict(v)
+                for k, v in raw.get("shards", {}).items()
+            }
+            return cls(
+                job=raw["job"], shards=shards,
+                version=raw["version"],
+                created_at=raw.get("created_at", 0.0),
+            )
+        except ManifestError:
+            raise
+        except Exception as e:  # noqa: BLE001 — corrupt json/schema
+            raise ManifestError(
+                f"unreadable manifest at {path}: {type(e).__name__}: {e}"
+            ) from e
+
+    def serialize(self) -> bytes:
+        payload = {
+            "version": self.version,
+            "created_at": self.created_at,
+            "job": self.job,
+            "shards": {
+                str(k): asdict(v) for k, v in sorted(self.shards.items())
+            },
+        }
+        return json.dumps(payload, indent=1, sort_keys=True).encode("utf-8")
+
+    def save(self, out_dir: str) -> None:
+        atomic_write_bytes(
+            os.path.join(out_dir, MANIFEST_NAME), self.serialize()
+        )
+
+    # -- commit log -----------------------------------------------------
+
+    def commit(self, out_dir: str, record: ShardRecord,
+               write_bytes=None) -> None:
+        """Record one shard as durably written — THE single commit
+        path.  The caller has already renamed the shard's files into
+        place; once the manifest rewrite lands, resume skips the shard
+        forever.  ``write_bytes(name, data)`` overrides the write (the
+        job runner routes it through its retrying
+        :class:`~logparser_tpu.jobs.writer.JobWriter`); on ANY write
+        failure the record is rolled back out of the in-memory map so
+        the manifest object still mirrors the disk truth."""
+        record.committed_at = time.time()
+        self.shards[record.shard] = record
+        try:
+            if write_bytes is not None:
+                write_bytes(MANIFEST_NAME, self.serialize())
+            else:
+                self.save(out_dir)
+        except BaseException:
+            del self.shards[record.shard]
+            raise
+
+    def committed_indices(self) -> List[int]:
+        return sorted(self.shards)
+
+    # -- fingerprinting -------------------------------------------------
+
+    def mismatch(self, fingerprint: Dict[str, Any]) -> Optional[str]:
+        """None when ``fingerprint`` matches this manifest's job block;
+        otherwise a human-readable description of the first divergence
+        (the resume refusal message)."""
+        for key in sorted(set(self.job) | set(fingerprint)):
+            a, b = self.job.get(key), fingerprint.get(key)
+            if a != b:
+                return f"{key}: manifest has {a!r}, job has {b!r}"
+        return None

@@ -36,11 +36,27 @@ A spec is ``;``-separated faults; each fault is a name followed by
 - ``delay_put:seconds=X[:worker=W]`` — sleep X before every queue put
   (slow/wedged worker; pairs with the supervisor's worker deadline).
 
-``worker=W`` restricts a fault to one worker id (default: all).
-``sticky=1`` makes a fault survive respawns (default only for
-``poison_shard``); everything else fires in the first incarnation only —
-a recovered worker is healthy, which is what lets byte-parity runs
-complete.
+I/O fault primitives (consumer-side: the durable job writer's
+``docs/JOBS.md`` failure drills and ``chaos_smoke`` both arm them —
+they never reach feeder workers):
+
+- ``io_error[:op=write|fsync|rename][:shard=S][:count=M][:sticky=1]`` —
+  raise ``OSError(EIO)`` from the matching writer operation.  Default
+  op: every op; default ``count=1`` (one transient fault — the retry
+  ladder must absorb it).
+- ``enospc[:op=...][:shard=S][:count=M][:sticky=1]`` — same injection
+  point raising ``OSError(ENOSPC)`` (disk full).
+
+``shard=S`` pins an I/O fault to ONE shard's writes; combined with
+``sticky=1`` it keeps firing through every retry — the shard must FAIL
+(and stay uncommitted in the manifest) while the job completes its
+other shards: the "sticky-per-shard" drill.
+
+``worker=W`` restricts a worker fault to one worker id (default: all).
+``sticky=1`` makes a fault survive respawns/retries (default only for
+``poison_shard``); everything else fires ``count`` times (worker faults:
+first incarnation only) — a recovered worker is healthy, which is what
+lets byte-parity runs complete.
 
 The spec travels EXPLICITLY through ``run_worker``'s args (the pool
 parses the env var — or an object passed as ``FeederPool(chaos=...)`` —
@@ -63,7 +79,12 @@ CHAOS_ENV = "LOGPARSER_TPU_CHAOS"
 _KNOWN = {
     "kill_worker", "poison_shard", "corrupt_descriptor",
     "slot_overflow", "drop_done", "delay_put",
+    "io_error", "enospc",
 }
+
+#: Consumer-side fault kinds: armed by the durable-job writer, inert in
+#: feeder workers (WorkerChaos hooks filter by kind and never match).
+IO_FAULTS = {"io_error", "enospc"}
 
 
 class _ChaosHardExit(BaseException):
@@ -211,3 +232,39 @@ class WorkerChaos:
                     f.param("shard", shard_index) == shard_index:
                 return True
         return False
+
+
+class WriterChaos:
+    """Consumer-side I/O fault injection for the durable job writer
+    (``logparser_tpu/jobs/writer.py``).  ``check(op, shard)`` raises the
+    armed ``OSError`` when a fault matches — ``count`` bounds one-shot
+    faults (the retry ladder must absorb them); ``sticky=1`` fires
+    forever (the shard-must-fail drill)."""
+
+    def __init__(self, spec: ChaosSpec):
+        self.faults = [f for f in spec.faults if f.kind in IO_FAULTS]
+        self._fired: Dict[int, int] = {}
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def check(self, op: str, shard: int) -> None:
+        import errno
+
+        for idx, f in enumerate(self.faults):
+            f_op = f.param("op")
+            if f_op is not None and f_op != op:
+                continue
+            f_shard = f.param("shard")
+            if f_shard is not None and f_shard != shard:
+                continue
+            fired = self._fired.get(idx, 0)
+            if not f.sticky and fired >= int(f.param("count", 1)):
+                continue
+            self._fired[idx] = fired + 1
+            code = errno.ENOSPC if f.kind == "enospc" else errno.EIO
+            raise OSError(
+                code,
+                f"chaos: injected {f.kind} during {op} "
+                f"(shard {shard})",
+            )

@@ -47,6 +47,47 @@ def _ring_segments():
     )
 
 
+def _io_writer_drill(failures) -> None:
+    """Exercise ``io_error``/``enospc`` against the durable job writer
+    (jax-free: tables only, no parser)."""
+    import tempfile
+
+    from logparser_tpu.feeder.shards import Shard
+    from logparser_tpu.jobs.writer import (
+        JobWriter,
+        ShardWriteError,
+        build_reject_table,
+        leaked_temp_files,
+    )
+    from logparser_tpu.tools.chaos import ChaosSpec, WriterChaos
+
+    shard = Shard(0, 0, 0, 64)
+    rejects = [(0, 0, 3, "oracle_reject", b"bad line")]
+    with tempfile.TemporaryDirectory() as d:
+        # Transient: one injected EIO, absorbed by the retry ladder.
+        w = JobWriter(d, retries=2, backoff_base_s=0.005,
+                      chaos=WriterChaos(ChaosSpec.parse(
+                          "io_error:op=fsync:count=1")))
+        rec = w.write_shard(shard, build_reject_table(rejects), rejects,
+                            lines=8, payload_bytes=64)
+        if rec.rejects != 1 or not rec.data_file:
+            failures.append("io drill: transient io_error did not commit")
+        # Sticky: every retry fails -> ShardWriteError, no tmp debris.
+        w = JobWriter(d, retries=1, backoff_base_s=0.005,
+                      chaos=WriterChaos(ChaosSpec.parse(
+                          "enospc:shard=0:sticky=1")))
+        try:
+            w.write_shard(shard, build_reject_table(rejects), rejects,
+                          lines=8, payload_bytes=64)
+            failures.append("io drill: sticky enospc did not fail")
+        except ShardWriteError:
+            pass
+        if leaked_temp_files(d):
+            failures.append("io drill: tmp debris leaked after faults")
+    print("chaos-smoke: io-fault writer drill OK "
+          "(transient retried, sticky failed cleanly)")
+
+
 def main() -> int:
     from logparser_tpu.feeder import (
         FeederPool,
@@ -129,6 +170,17 @@ def main() -> int:
                   f"restarts={stats['worker_restarts']} "
                   f"quarantined={stats['shards_quarantined']} "
                   f"demotions={stats['transport_demotions']} OK")
+
+    # I/O fault primitives (round 13): the durable-job writer must
+    # absorb a transient io_error via its retry ladder and fail cleanly
+    # (ShardWriteError, tmp cleaned up) on a sticky enospc — the same
+    # primitives the job tests and docs/JOBS.md drills use.
+    try:
+        import pyarrow  # noqa: F401 — writer drill needs Arrow
+
+        _io_writer_drill(failures)
+    except ImportError:  # pragma: no cover - arrow ships in CI
+        print("chaos-smoke: pyarrow unavailable; io-fault drill skipped")
 
     # Shared-memory hygiene: recovery rebuilds arenas mid-run — every
     # one of them (original and replacement) must be unlinked by pool

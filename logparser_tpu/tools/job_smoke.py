@@ -1,0 +1,210 @@
+"""CI job smoke: the kill-drill invariant on a REAL SIGKILL.
+
+Drills the durable batch tier (docs/JOBS.md) end to end and fails
+(exit 1) unless:
+
+- a single-shot job over a demolog-style corpus completes with every
+  shard committed and the garbage lines landing in reject tables;
+- a second job, SIGKILLed (-9) mid-run from another process, RESUMES
+  from its manifest to a merged output (data + reject tables, global
+  shard order) BYTE-IDENTICAL to the single-shot run's — with the
+  shards committed before the kill never re-parsed;
+- no ``*.tmp`` debris and no shared-memory segment survives either
+  run (the feeder's orphan watch must clean up after the kill);
+- the ``job_*`` metric families land in the registry and the rendered
+  Prometheus exposition stays structurally valid.
+
+Usage::
+
+    make job-smoke
+    python -m logparser_tpu.tools.job_smoke
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+N_LINES = 20000
+GARBAGE_EVERY = 997          # ~20 reject lines across the corpus
+SHARD_BYTES = 64 << 10       # ~20+ shards: a wide mid-run kill window
+BATCH_LINES = 1024
+KILL_POLL_S = 0.2
+KILL_TIMEOUT_S = 300.0
+SHM_DIR = "/dev/shm"
+
+FMT = "%h %u %>s"
+FIELDS = ["IP:connection.client.host", "STRING:request.status.last"]
+
+
+def _corpus(path: str) -> None:
+    with open(path, "w") as f:
+        for i in range(N_LINES):
+            if i % GARBAGE_EVERY == 7:
+                f.write(f"?? broken line {i} !! ::\n")
+            else:
+                f.write(f"10.0.{(i >> 8) % 256}.{i % 256} u{i} "
+                        f"{200 + i % 7}\n")
+
+
+def _ring_segments():
+    from logparser_tpu.feeder import RING_NAME_PREFIX
+
+    if not os.path.isdir(SHM_DIR):
+        return None
+    return sorted(
+        f for f in os.listdir(SHM_DIR) if f.startswith(RING_NAME_PREFIX)
+    )
+
+
+def _committed(out_dir: str) -> int:
+    """Committed-shard count per the on-disk manifest (atomic rewrite:
+    a mid-write read is impossible by construction)."""
+    path = os.path.join(out_dir, "manifest.json")
+    try:
+        with open(path, "rb") as f:
+            return len(json.loads(f.read().decode()).get("shards", {}))
+    except (OSError, ValueError):
+        return 0
+
+
+def main() -> int:
+    from logparser_tpu.jobs import (
+        JobManifest,
+        JobSpec,
+        leaked_temp_files,
+        merged_hash,
+        run_job,
+    )
+    from logparser_tpu.observability import metrics
+    from logparser_tpu.tools.metrics_smoke import validate_exposition
+
+    failures = []
+    segments_before = _ring_segments()
+    tmp = tempfile.mkdtemp(prefix="logparser-job-smoke-")
+    corpus = os.path.join(tmp, "corpus.log")
+    _corpus(corpus)
+
+    def spec(out_name):
+        return JobSpec([corpus], FMT, FIELDS,
+                       os.path.join(tmp, out_name),
+                       shard_bytes=SHARD_BYTES, batch_lines=BATCH_LINES)
+
+    # ---- single-shot reference run (in-process) ----------------------
+    t0 = time.perf_counter()
+    ref = run_job(spec("single-shot"))
+    ref_wall = time.perf_counter() - t0
+    if not ref.complete:
+        failures.append(f"single-shot run incomplete: {ref.as_dict()}")
+    if not ref.rejects:
+        failures.append("single-shot run saw no rejects (corpus has "
+                        "garbage lines — the reject channel is dark)")
+    ref_manifest = JobManifest.load(spec("single-shot").out_dir)
+    ref_hash = merged_hash(spec("single-shot").out_dir, ref_manifest)
+    print(f"job-smoke: single-shot {ref.shards_total} shards, "
+          f"{ref.rows} rows, {ref.rejects} rejects, "
+          f"{ref.payload_bytes / max(ref_wall, 1e-9) / 1e6:.1f} MB/s")
+
+    # ---- kill drill: SIGKILL the CLI mid-run, then resume ------------
+    kill_dir = spec("killed").out_dir
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (
+        repo_root + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH") else repo_root
+    )
+    argv = [sys.executable, "-m", "logparser_tpu.jobs", corpus,
+            "--format", FMT, "--out", kill_dir,
+            "--shard-bytes", str(SHARD_BYTES),
+            "--batch-lines", str(BATCH_LINES)]
+    for f in FIELDS:
+        argv += ["--field", f]
+    proc = subprocess.Popen(argv, env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    committed_at_kill = 0
+    deadline = time.monotonic() + KILL_TIMEOUT_S
+    while time.monotonic() < deadline:
+        committed_at_kill = _committed(kill_dir)
+        if committed_at_kill >= 2 or proc.poll() is not None:
+            break
+        time.sleep(KILL_POLL_S)
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    else:
+        print("job-smoke: WARNING subprocess finished before the kill "
+              "window (fast host) — resume still asserted below")
+    # Re-read AFTER the process is truly dead: a commit can land between
+    # the poll sample and SIGKILL delivery, and resume must be compared
+    # against the post-kill manifest truth, not the stale sample.
+    committed_at_kill = _committed(kill_dir)
+    print(f"job-smoke: job stopped with {committed_at_kill} of "
+          f"{ref.shards_total} shards committed")
+    if committed_at_kill >= ref.shards_total and proc.returncode == -9:
+        failures.append("kill drill never landed mid-run")
+
+    # Orphaned feeder workers must self-terminate and unlink arenas.
+    time.sleep(2.0)
+
+    t0 = time.perf_counter()
+    resumed = run_job(spec("killed"))
+    resume_wall = time.perf_counter() - t0
+    if not resumed.complete:
+        failures.append(f"resume incomplete: {resumed.as_dict()}")
+    if resumed.skipped != committed_at_kill:
+        failures.append(
+            f"resume re-parsed committed work: skipped "
+            f"{resumed.skipped}, manifest had {committed_at_kill} at kill"
+        )
+    kill_manifest = JobManifest.load(kill_dir)
+    kill_hash = merged_hash(kill_dir, kill_manifest)
+    if kill_hash != ref_hash:
+        failures.append(
+            "kill-drill output is NOT byte-identical to the single-shot "
+            f"run ({kill_hash[:16]} != {ref_hash[:16]})"
+        )
+    else:
+        print(f"job-smoke: kill+resume byte-identical "
+              f"({kill_hash[:16]}), resume wall {resume_wall:.2f}s, "
+              f"skipped {resumed.skipped} committed shards")
+
+    # ---- hygiene ------------------------------------------------------
+    for out_name in ("single-shot", "killed"):
+        debris = leaked_temp_files(spec(out_name).out_dir)
+        if debris:
+            failures.append(f"{out_name}: leaked temp files {debris}")
+    segments_after = _ring_segments()
+    if segments_before is not None and segments_after is not None:
+        leaked = sorted(set(segments_after) - set(segments_before))
+        if leaked:
+            failures.append(f"leaked shared-memory segments: {leaked}")
+
+    # ---- telemetry ----------------------------------------------------
+    text = metrics().prometheus_text()
+    for needle in ("logparser_tpu_job_shards_committed_total",
+                   "logparser_tpu_job_rejected_lines_total",
+                   "logparser_tpu_job_rows_total"):
+        if needle not in text:
+            failures.append(f"/metrics exposition missing: {needle}")
+    failures.extend(validate_exposition(text))
+
+    if failures:
+        print("JOB SMOKE FAILURES:")
+        for f in failures:
+            print(" -", f)
+        return 1
+    print("job-smoke OK: single-shot + SIGKILL/resume byte-identical, "
+          "committed shards never re-parsed, reject channel populated, "
+          "no leaked temp files or shm segments")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,8 +35,12 @@ import jax.numpy as jnp
 import os
 
 from ..core.casts import Cast
-from ..core.exceptions import DissectionFailure
+from ..core.exceptions import DissectionFailure, OracleEngineError
 from ..core.fields import cleanup_field_value
+
+import logging as _logging
+
+_LOG = _logging.getLogger(__name__)
 from ..httpd.parser import HttpdLoglineParser
 from .pipeline import (
     FieldPlan,
@@ -131,9 +135,17 @@ def _oracle_worker_init(blob: bytes) -> None:
     _WORKER_PARSER.assemble_dissectors()
 
 
+def _values_of(rec):
+    """parse_many result -> delivery value: the record's values dict, or
+    the None / OracleEngineError verdict passed through unchanged."""
+    if rec is None or isinstance(rec, OracleEngineError):
+        return rec
+    return rec.values
+
+
 def _oracle_worker_run(lines: List[str]) -> List[Optional[Dict[str, Any]]]:
     return [
-        rec.values if rec is not None else None
+        _values_of(rec)
         for rec in _WORKER_PARSER.parse_many(lines, _CollectingRecord)
     ]
 
@@ -501,6 +513,12 @@ class BatchResult:
         # counts by reject reason and the wall seconds rescue added.
         self.rescue_reasons: Dict[str, int] = {}
         self.rescue_wall_s: float = 0.0
+        # Per-row reject ledger (filled by the materializer): row ->
+        # stable reason ("implausible" | "oracle_reject" |
+        # "oracle_error") for every row whose ``valid`` ended False —
+        # the jobs reject channel reads it to build per-line error
+        # tables instead of silently dropping bad lines.
+        self.reject_reasons: Dict[int, str] = {}
         # Per-line index of the registered format that matched on device
         # (-1 = decided by the host oracle / no device match).  The columnar
         # analogue of the reference's "Switched to LogFormat" signal
@@ -524,6 +542,17 @@ class BatchResult:
                 B == 0 or int(self.buf[:B].max(initial=0)) < 0x80
             )
         return self._ascii_only
+
+    def raw_line(self, i: int) -> bytes:
+        """The raw bytes of line ``i`` exactly as ingested (lazy under
+        blob ingest — only requested rows materialize).  String inputs
+        encode UTF-8; the jobs reject channel stores these verbatim."""
+        line = self._lines[i]
+        if isinstance(line, bytes):
+            return line
+        if isinstance(line, (bytearray, memoryview)):
+            return bytes(line)
+        return str(line).encode("utf-8", errors="surrogateescape")
 
     def field_ids(self) -> List[str]:
         return list(self._columns.keys())
@@ -2039,6 +2068,15 @@ class TpuBatchParser:
         invalid_rows = set(
             int(i) for i in np.nonzero(inv & plausible_any)[0]
         )
+        # Per-row reject ledger: every row that ends the batch invalid
+        # carries a stable reason (the jobs reject channel and the fuzz
+        # suite both pin the vocabulary): "implausible" = no format even
+        # plausible, rejected without an oracle visit; "oracle_reject" =
+        # the oracle parsed and refused (DissectionFailure);
+        # "oracle_error" = the oracle engine ITSELF failed on the line.
+        reject_reasons: Dict[int, str] = {
+            int(i): "implausible" for i in np.nonzero(inv & ~plausible_any)[0]
+        }
         # Rows the oracle must visit: lines no automaton accepted (but some
         # format could still plausibly match), plus lines whose winning
         # format can't supply every requested field on device.
@@ -2149,7 +2187,7 @@ class TpuBatchParser:
                 plan_cache[key] = got
             return got
 
-        oracle_rescued = oracle_rejected = 0
+        oracle_rescued = oracle_rejected = engine_errors = 0
         for i, values in zip(oracle_rows_sorted, oracle_results):
             is_invalid = i in invalid_rows
             fields_needed = (
@@ -2157,10 +2195,37 @@ class TpuBatchParser:
                 if is_invalid
                 else self._unit_oracle_fields[winner[i]]
             )
-            if values is None:
+            if values is None or isinstance(values, OracleEngineError):
+                # None = the oracle parsed and refused (the reference's
+                # bad-line verdict).  OracleEngineError = the oracle
+                # ITSELF failed — surfaced as a counted, reasoned reject
+                # (never a raise, never a silent None): a device-valid
+                # line keeps its device columns with the host fields
+                # unresolved; an invalid line rejects as oracle_error.
                 oracle_rejected += 1
+                if isinstance(values, OracleEngineError):
+                    engine_errors += 1
+                    from ..observability import log_warning_once
+
+                    # STATIC warn-once key (per-line error text would
+                    # grow the warn-once table without bound on a
+                    # hostile corpus); the exact error rides the reject
+                    # table and DEBUG.
+                    log_warning_once(
+                        _LOG,
+                        "host oracle engine failed on one or more lines;"
+                        " surfaced as oracle_error rejects (details at "
+                        "DEBUG / in the reject channel)",
+                    )
+                    _LOG.debug("oracle engine fault on row %d: %s",
+                               i, values.error)
                 if is_invalid:
                     bad += 1
+                    reject_reasons[i] = (
+                        "oracle_error"
+                        if isinstance(values, OracleEngineError)
+                        else "oracle_reject"
+                    )
                 continue
             if is_invalid:
                 valid[i] = True
@@ -2198,6 +2263,8 @@ class TpuBatchParser:
             reg.increment("oracle_rescued_lines_total", oracle_rescued)
         if oracle_rejected:
             reg.increment("oracle_rejected_lines_total", oracle_rejected)
+        if engine_errors:
+            reg.increment("oracle_engine_errors_total", engine_errors)
         self._fold_oracle_engine_tally(engine_before)
 
         good = int(B - bad)
@@ -2242,6 +2309,7 @@ class TpuBatchParser:
         # composition line and the smoke tool read these).
         result.rescue_reasons = rescue_reasons
         result.rescue_wall_s = rescue_wall
+        result.reject_reasons = reject_reasons
         return result
 
     def _materialize_csr(
@@ -2806,7 +2874,7 @@ class TpuBatchParser:
         )
         if pool is None:
             return [
-                rec.values if rec is not None else None
+                _values_of(rec)
                 for rec in self.oracle.parse_many(decoded, _CollectingRecord)
             ]
         n_chunks = self._oracle_pool_n * 4

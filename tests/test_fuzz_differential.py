@@ -142,6 +142,100 @@ N_FORMATS = 10
 LINES_PER_FORMAT = 40
 GARBAGE = ["", "complete garbage", '"-', "\\x16\\x03", "a b c d e f g h i"]
 
+# Hostile byte classes (round 13): NUL bytes, invalid UTF-8, CRLF-only
+# lines, and the 8k truncation boundary (DEFAULT_MAX_LINE_LEN = 8191
+# frames a prefix; the full line goes to the oracle).  Every class must
+# hold device-vs-oracle parity AND a stable reject reason — the jobs
+# reject channel stores these reasons durably.
+REJECT_REASONS = {"implausible", "oracle_reject", "oracle_error"}
+
+
+def hostile_lines():
+    mid = "u" * 8160
+    return [
+        b"1.2.3.4 ok 200",                     # control
+        b"\x00",                                # lone NUL
+        b"1.2.3.4 b\x00b 200",                  # NUL inside a token
+        b"\x00 \x00 \x00",                      # NUL fields
+        b"\xff\xfe bad \x80\x81 200",           # invalid UTF-8, bad shape
+        b"1.2.3.4 \xff\xfe 200",                # invalid UTF-8 in a token
+        b"\xed\xa0\x80 surrogate 200",          # lone-surrogate encoding
+        b"\r",                                  # CR-only line
+        b"\r\n",                                # CRLF-only line
+        b"a\r\r\n",                             # double CR before LF
+        ("1.2.3.4 " + mid + " 200").encode(),   # under the cap
+        ("1.2.3.4 " + "u" * 8165 + " 200").encode(),  # 8190: at cap - 1
+        ("1.2.3.4 " + "u" * 8166 + " 200").encode(),  # 8191: exactly at cap
+        ("1.2.3.4 " + "u" * 8167 + " 200").encode(),  # 8192: first overflow
+        ("1.2.3.4 " + "u" * 9000 + " 200").encode(),  # far past the cap
+        ("1.2.3.4 " + "u" * 8166).encode() + b" \xff\x00",  # overflow + junk
+    ]
+
+
+def test_hostile_bytes_parity_and_stable_reject_reasons():
+    """Device-vs-oracle parity over the hostile byte classes, with
+    reject reasons drawn from the stable vocabulary and deterministic
+    across repeated parses (the jobs reject channel persists them)."""
+    parser = TpuBatchParser(
+        "%h %u %>s",
+        ["IP:connection.client.host", "STRING:request.status.last"],
+    )
+    lines = hostile_lines()
+    result = parser.parse_batch(lines)
+    oracle = parser.oracle
+    for i, raw in enumerate(lines):
+        decoded = raw.decode("utf-8", errors="replace")
+        try:
+            oracle.parse(decoded, _CollectingRecord())
+            ok = True
+        except Exception:
+            ok = False
+        assert bool(result.valid[i]) == ok, (
+            f"line {i}: device valid={bool(result.valid[i])} "
+            f"oracle ok={ok} raw={raw[:60]!r}"
+        )
+        if not ok:
+            assert result.reject_reasons.get(i) in REJECT_REASONS, (
+                f"line {i}: missing/unknown reject reason "
+                f"{result.reject_reasons.get(i)!r}"
+            )
+            assert result.raw_line(i) == raw
+    invalid = {i for i in range(result.lines_read) if not result.valid[i]}
+    assert set(result.reject_reasons) == invalid
+    # Determinism: a second parse produces the identical reject ledger.
+    again = parser.parse_batch(lines)
+    assert again.reject_reasons == result.reject_reasons
+    assert list(again.valid) == list(result.valid)
+    # The 8k boundary: lines past the cap route through overflow ->
+    # oracle rescue and must come back VALID with correct field values.
+    for i in (11, 12, 13, 14):
+        assert bool(result.valid[i]), f"8k-boundary line {i} lost"
+        got = result.to_pylist("STRING:request.status.last")[i]
+        assert got == "200", f"8k-boundary line {i}: status {got!r}"
+    parser.close()
+
+
+def test_hostile_bytes_blob_ingest_matches_list_ingest():
+    """The blob framer path (jobs/feeder ingest) must agree with the
+    per-line list path on the hostile classes — same validity, same
+    reject reasons (offset by framing semantics: blob mode splits on
+    newline, so CR/LF-bearing lines are exercised list-side only)."""
+    parser = TpuBatchParser(
+        "%h %u %>s",
+        ["IP:connection.client.host", "STRING:request.status.last"],
+    )
+    lines = [ln for ln in hostile_lines()
+             if b"\n" not in ln and not ln.endswith(b"\r")]
+    blob = b"\n".join(lines)
+    r_list = parser.parse_batch(lines)
+    r_blob = parser.parse_blob(blob)
+    assert r_blob.lines_read == r_list.lines_read == len(lines)
+    assert list(r_blob.valid) == list(r_list.valid)
+    assert r_blob.reject_reasons == r_list.reject_reasons
+    for i in r_blob.reject_reasons:
+        assert r_blob.raw_line(i) == lines[i]
+    parser.close()
+
 
 def assert_arrow_matches_pylist(result, fields, label, columns=None):
     """Every fuzz case also locks the Arrow bridge (zero-copy views,

@@ -1,0 +1,519 @@
+"""Durable batch jobs (logparser_tpu/jobs, docs/JOBS.md): exactly-once
+sharded output, crash-resumable runs, the per-line reject channel, and
+writer I/O fault tolerance — plus the EOF/no-trailing-newline boundary
+locks across the inputformat and feeder split paths.
+
+The kill-drill invariant drilled here in-process (JobPolicy.
+stop_after_shards models a crash landing on a commit boundary; the real
+SIGKILL drill lives in tools/job_smoke.py and the bench ``jobs``
+section): interrupted + resumed output must be BYTE-IDENTICAL to an
+undisturbed run's, with committed shards never re-parsed.
+"""
+import json
+import os
+
+import pytest
+
+from _shared_parsers import shared_parser
+from logparser_tpu.core.exceptions import OracleEngineError
+from logparser_tpu.jobs import (
+    JobManifest,
+    JobPolicy,
+    JobSpec,
+    ManifestError,
+    ShardRecord,
+    leaked_temp_files,
+    merged_hash,
+    run_job,
+)
+from logparser_tpu.observability import metrics
+
+pa = pytest.importorskip("pyarrow")
+
+FMT = "%h %u %>s"
+FIELDS = ["IP:connection.client.host", "STRING:request.status.last"]
+
+GARBAGE_LINES = [
+    b"total garbage ! that & matches nothing ::",
+    b"another \x01 bad line with weird bytes",
+]
+
+
+def make_corpus(n=240, trailing_newline=True):
+    lines = [
+        f"1.2.3.{i % 250} user{i} {200 + i % 3}".encode() for i in range(n)
+    ]
+    lines[17] = GARBAGE_LINES[0]
+    lines[n - 40] = GARBAGE_LINES[1]
+    blob = b"\n".join(lines)
+    if trailing_newline:
+        blob += b"\n"
+    return lines, blob
+
+
+def job_spec(tmp_path, corpus_file, out_name, **kw):
+    kw.setdefault("shard_bytes", 700)
+    kw.setdefault("batch_lines", 16)
+    kw.setdefault("use_processes", False)
+    return JobSpec([str(corpus_file)], FMT, FIELDS,
+                   str(tmp_path / out_name), **kw)
+
+
+@pytest.fixture()
+def corpus_file(tmp_path):
+    _, blob = make_corpus()
+    p = tmp_path / "corpus.log"
+    p.write_bytes(blob)
+    return p
+
+
+def parser():
+    return shared_parser(FMT, FIELDS)
+
+
+def run(spec, **kw):
+    kw.setdefault("parser", parser())
+    kw.setdefault("policy", JobPolicy(io_backoff_s=0.005))
+    return run_job(spec, **kw)
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_roundtrip_and_atomic_save(tmp_path):
+    m = JobManifest.fresh({"log_format": FMT, "fields": FIELDS})
+    m.commit(str(tmp_path), ShardRecord(
+        shard=3, source=0, start=0, end=100, lines=10, rows=9, rejects=1,
+        payload_bytes=95, data_file="shard-00003.arrow",
+        reject_file="shard-00003.rejects.arrow",
+        data_hash="aa", reject_hash="bb",
+    ))
+    assert not leaked_temp_files(str(tmp_path))  # atomic: no tmp debris
+    loaded = JobManifest.load(str(tmp_path))
+    assert loaded.committed_indices() == [3]
+    rec = loaded.shards[3]
+    assert (rec.rows, rec.rejects, rec.data_file) == (
+        9, 1, "shard-00003.arrow"
+    )
+    assert loaded.mismatch({"log_format": FMT, "fields": FIELDS}) is None
+    assert "fields" in loaded.mismatch({"log_format": FMT, "fields": ["x"]})
+
+
+def test_corrupt_manifest_refuses_not_ignores(tmp_path):
+    (tmp_path / "manifest.json").write_text("{not json")
+    with pytest.raises(ManifestError):
+        JobManifest.load(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# the job itself: outputs, reject channel, determinism
+# ---------------------------------------------------------------------------
+
+
+def test_job_outputs_reject_channel_and_byte_identity(tmp_path, corpus_file):
+    lines, _ = make_corpus()
+    specA = job_spec(tmp_path, corpus_file, "outA")
+    repA = run(specA)
+    assert repA.complete and not repA.failed
+    assert repA.lines == len(lines)
+    assert repA.rows == len(lines) - 2
+    assert repA.rejects == 2
+    assert set(repA.reject_reasons) <= {
+        "oracle_reject", "oracle_error", "implausible"
+    }
+    m = JobManifest.load(specA.out_dir)
+    assert len(m.shards) == repA.shards_total
+    # Reject tables carry the exact raw bytes + a stable reason.
+    raws, reasons = [], set()
+    for idx in m.committed_indices():
+        rec = m.shards[idx]
+        if not rec.reject_file:
+            continue
+        with open(os.path.join(specA.out_dir, rec.reject_file), "rb") as f:
+            t = pa.ipc.open_stream(f).read_all()
+        raws += t["raw"].to_pylist()
+        reasons |= set(t["reason"].to_pylist())
+        assert t["shard"].to_pylist() == [idx] * t.num_rows
+    assert sorted(raws) == sorted(GARBAGE_LINES)
+    assert reasons <= {"oracle_reject", "oracle_error", "implausible"}
+    # Data rows: every valid line survives into the data tables.
+    total_rows = sum(m.shards[i].rows for i in m.committed_indices())
+    assert total_rows == len(lines) - 2
+    # Determinism: a second fresh run is byte-identical.
+    specB = job_spec(tmp_path, corpus_file, "outB")
+    run(specB)
+    assert merged_hash(specA.out_dir, m) == merged_hash(
+        specB.out_dir, JobManifest.load(specB.out_dir)
+    )
+    assert metrics().get("job_rejected_lines_total",
+                         {"reason": "oracle_reject"}) >= 2
+
+
+def test_single_shard_reject_line_offsets(tmp_path, corpus_file):
+    lines, _ = make_corpus()
+    spec = job_spec(tmp_path, corpus_file, "out1", shard_bytes=1 << 20)
+    run(spec)
+    m = JobManifest.load(spec.out_dir)
+    assert m.committed_indices() == [0]
+    rec = m.shards[0]
+    with open(os.path.join(spec.out_dir, rec.reject_file), "rb") as f:
+        t = pa.ipc.open_stream(f).read_all()
+    # line offsets are absolute within the shard == corpus line indices
+    assert t["line"].to_pylist() == [17, len(lines) - 40]
+    assert t["raw"].to_pylist() == GARBAGE_LINES
+
+
+# ---------------------------------------------------------------------------
+# resume: exactly-once, byte-identical
+# ---------------------------------------------------------------------------
+
+
+def test_crash_at_commit_boundary_resume_is_byte_identical(
+    tmp_path, corpus_file
+):
+    specA = job_spec(tmp_path, corpus_file, "undisturbed")
+    run(specA)
+    href = merged_hash(specA.out_dir, JobManifest.load(specA.out_dir))
+
+    specB = job_spec(tmp_path, corpus_file, "crashed")
+    r1 = run(specB, policy=JobPolicy(stop_after_shards=3))
+    assert r1.stopped_early and r1.committed == 3
+    r2 = run(specB)
+    # committed shards are NEVER re-parsed: the resume skipped exactly
+    # the three committed shards and parsed only the rest.
+    assert r2.skipped == 3
+    assert r2.committed == r2.shards_total - 3
+    assert r2.complete
+    m = JobManifest.load(specB.out_dir)
+    assert merged_hash(specB.out_dir, m) == href
+    assert not leaked_temp_files(specB.out_dir)
+
+
+def test_orphaned_rename_without_manifest_entry_is_overwritten(
+    tmp_path, corpus_file
+):
+    """A crash BETWEEN the file rename and the manifest commit leaves a
+    complete-looking orphan file — resume must re-parse that shard and
+    overwrite it deterministically (the manifest is the only truth)."""
+    spec = job_spec(tmp_path, corpus_file, "orphan")
+    run(spec)
+    m = JobManifest.load(spec.out_dir)
+    href = merged_hash(spec.out_dir, m)
+    victim = m.committed_indices()[1]
+    del m.shards[victim]
+    m.save(spec.out_dir)
+    r = run(spec)
+    assert r.committed == 1 and r.complete
+    m2 = JobManifest.load(spec.out_dir)
+    assert victim in m2.shards
+    assert merged_hash(spec.out_dir, m2) == href
+
+
+def test_resume_all_committed_is_a_noop(tmp_path, corpus_file):
+    spec = job_spec(tmp_path, corpus_file, "noop")
+    run(spec)
+    r = run(spec, parser=None)  # no parser needed: nothing to parse
+    assert r.skipped == r.shards_total and r.committed == 0 and r.complete
+
+
+def test_modified_source_same_size_refuses_resume(tmp_path, corpus_file):
+    """A corpus rewritten IN PLACE to the same byte size must refuse to
+    resume (mtime enters the fingerprint): mixing two corpora's shards
+    would corrupt the merged output without any crash."""
+    import time as _time
+
+    spec = job_spec(tmp_path, corpus_file, "mtime")
+    run(spec, policy=JobPolicy(stop_after_shards=2, io_backoff_s=0.005))
+    data = corpus_file.read_bytes()
+    _time.sleep(0.02)
+    corpus_file.write_bytes(b"X" + data[1:])  # same size, new content
+    with pytest.raises(ManifestError, match="sources"):
+        run(spec)
+
+
+def test_manifest_write_fault_fails_shard_not_job(
+    tmp_path, corpus_file, monkeypatch
+):
+    """The manifest rewrite is the commit point AND a disk write: when
+    it exhausts its retries the shard fails (its renamed files without
+    an entry are the ordinary not-committed state), the job continues,
+    and resume heals byte-identically."""
+    from logparser_tpu.jobs.writer import JobWriter
+
+    real = JobWriter.write_file
+
+    def flaky(self, name, data, shard):
+        if name == "manifest.json" and shard == 1:
+            from logparser_tpu.jobs.writer import ShardWriteError
+
+            raise ShardWriteError(shard, "injected manifest write fault")
+        return real(self, name, data, shard)
+
+    monkeypatch.setattr(JobWriter, "write_file", flaky)
+    spec = job_spec(tmp_path, corpus_file, "mwf")
+    rep = run(spec)
+    assert [f["shard"] for f in rep.failed] == [1]
+    assert rep.committed == rep.shards_total - 1
+    assert 1 not in JobManifest.load(spec.out_dir).shards
+    monkeypatch.setattr(JobWriter, "write_file", real)
+    r2 = run(spec)
+    assert r2.complete and r2.committed == 1
+    ref = job_spec(tmp_path, corpus_file, "mwf-ref")
+    run(ref)
+    assert merged_hash(
+        spec.out_dir, JobManifest.load(spec.out_dir)
+    ) == merged_hash(ref.out_dir, JobManifest.load(ref.out_dir))
+
+
+def test_fingerprint_mismatch_refused(tmp_path, corpus_file):
+    spec = job_spec(tmp_path, corpus_file, "fp")
+    run(spec, policy=JobPolicy(stop_after_shards=1, io_backoff_s=0.005))
+    other = job_spec(tmp_path, corpus_file, "fp", batch_lines=8)
+    with pytest.raises(ManifestError, match="batch_lines"):
+        run_job(other, parser=parser())
+    with pytest.raises(ManifestError, match="manifest"):
+        run_job(spec, resume=False, parser=parser())
+
+
+# ---------------------------------------------------------------------------
+# writer I/O faults (chaos io primitives)
+# ---------------------------------------------------------------------------
+
+
+def test_transient_io_fault_absorbed_by_retry(tmp_path, corpus_file):
+    before = metrics().get("job_writer_retries_total",
+                           {"op": "io_error"})
+    specA = job_spec(tmp_path, corpus_file, "ioA")
+    repA = run(specA, chaos="io_error:op=fsync:count=2")
+    assert repA.complete and not repA.failed
+    assert metrics().get("job_writer_retries_total",
+                         {"op": "io_error"}) >= before + 2
+    specB = job_spec(tmp_path, corpus_file, "ioB")
+    run(specB)
+    assert merged_hash(
+        specA.out_dir, JobManifest.load(specA.out_dir)
+    ) == merged_hash(specB.out_dir, JobManifest.load(specB.out_dir))
+
+
+def test_sticky_enospc_fails_shard_not_job(tmp_path, corpus_file):
+    spec = job_spec(tmp_path, corpus_file, "sticky")
+    rep = run(spec, chaos="enospc:shard=2:sticky=1")
+    assert [f["shard"] for f in rep.failed] == [2]
+    assert rep.committed == rep.shards_total - 1
+    m = JobManifest.load(spec.out_dir)
+    assert 2 not in m.shards  # manifest stays consistent: no entry
+    assert metrics().get("job_shards_failed_total",
+                         {"reason": "write_io"}) >= 1
+    # the failure healed (space back): resume completes just that shard
+    r2 = run(spec)
+    assert r2.committed == 1 and r2.skipped == rep.shards_total - 1
+    ref = job_spec(tmp_path, corpus_file, "ref")
+    run(ref)
+    assert merged_hash(
+        spec.out_dir, JobManifest.load(spec.out_dir)
+    ) == merged_hash(ref.out_dir, JobManifest.load(ref.out_dir))
+
+
+# ---------------------------------------------------------------------------
+# feeder shard_plan hook
+# ---------------------------------------------------------------------------
+
+
+def test_feeder_shard_plan_subset_and_validation():
+    from dataclasses import replace
+
+    from logparser_tpu.feeder import FeederPool, plan_shards
+    from logparser_tpu.feeder.shards import normalize_sources
+
+    _, blob = make_corpus()
+    srcs = normalize_sources([blob])
+    plan = plan_shards(srcs, 700)
+    subset = [s for s in plan if s.index % 2 == 0]
+    renum = [replace(s, index=i) for i, s in enumerate(subset)]
+    pool = FeederPool([blob], workers=2, shard_bytes=700,
+                      batch_lines=16, use_processes=False,
+                      shard_plan=renum)
+    got = b"".join(bytes(eb.payload) for eb in pool.batches())
+    from logparser_tpu.feeder.shards import read_shard_payload
+
+    want = b"".join(read_shard_payload(srcs[0], s) for s in subset)
+    assert got == want
+    with pytest.raises(ValueError, match="contiguous"):
+        FeederPool([blob], shard_plan=subset, use_processes=False)
+
+
+# ---------------------------------------------------------------------------
+# oracle-failure surfacing (satellite: rescue-failure audit)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_error_becomes_marker_not_batch_abort():
+    """A record setter raising mid-parse is an ENGINE failure, not a
+    DissectionFailure: parse_many must mark that one line and keep
+    parsing the rest (both oracle engine flavors route through here)."""
+
+    class BoomRecord:
+        def __init__(self):
+            self.values = {}
+
+        def set_value(self, name, value):
+            raise ValueError("boom")
+
+    out = parser().oracle.parse_many(
+        ["1.2.3.4 bob 200", "total garbage ! ::"], BoomRecord
+    )
+    assert isinstance(out[0], OracleEngineError)
+    assert "boom" in out[0].error
+    assert out[1] is None  # ordinary reject stays None
+
+
+def test_oracle_engine_failure_is_a_counted_reasoned_reject(monkeypatch):
+    """When the oracle ITSELF fails on a routed line, the batch result
+    must carry a counted oracle_error reject — never a raise, never a
+    silent None (the jobs reject channel depends on this)."""
+    p = parser()
+    real = p.oracle.parse_many
+
+    def failing(lines, record_factory):
+        out = real(lines, record_factory)
+        return [
+            OracleEngineError("ValueError: injected engine fault")
+            if (b"ENGINEBOOM" in (ln if isinstance(ln, bytes)
+                                  else ln.encode()))
+            else r
+            for ln, r in zip(lines, out)
+        ]
+
+    monkeypatch.setattr(p.oracle, "parse_many", failing)
+    before = metrics().get("oracle_engine_errors_total")
+    result = p.parse_batch([
+        b"1.2.3.4 bob 200",
+        b"ENGINEBOOM garbage ! ::",   # invalid on device -> oracle
+        b"5.6.7.8 al 404",
+    ])
+    assert list(result.valid) == [True, False, True]
+    assert result.reject_reasons == {1: "oracle_error"}
+    assert result.bad_lines == 1
+    assert metrics().get("oracle_engine_errors_total") == before + 1
+
+
+def test_reject_reasons_cover_every_invalid_row():
+    p = parser()
+    result = p.parse_batch([
+        b"1.2.3.4 bob 200",
+        b"total garbage ! that & matches nothing ::",
+        b"",
+        b"x",
+    ])
+    invalid = {i for i in range(result.lines_read) if not result.valid[i]}
+    assert set(result.reject_reasons) == invalid
+    assert set(result.reject_reasons.values()) <= {
+        "implausible", "oracle_reject", "oracle_error"
+    }
+    assert result.raw_line(1) == b"total garbage ! that & matches nothing ::"
+
+
+# ---------------------------------------------------------------------------
+# EOF / no-trailing-newline boundary locks (inputformat + feeder + jobs)
+# ---------------------------------------------------------------------------
+
+
+class TestEofBoundary:
+    CONTENT = (b"1.1.1.1 aa 200\n" * 7) + b"2.2.2.2 final 204"
+
+    def _reader_lines(self, path, start, length):
+        from logparser_tpu.adapters.inputformat import (
+            FileSplit,
+            LogfileRecordReader,
+        )
+
+        reader = object.__new__(LogfileRecordReader)
+        reader.split = FileSplit(str(path), start, length)
+        return list(reader._iter_split_lines())
+
+    def test_inputformat_final_line_exactly_once(self, tmp_path):
+        p = tmp_path / "nofinalnl.log"
+        p.write_bytes(self.CONTENT)
+        size = len(self.CONTENT)
+        want = self.CONTENT.split(b"\n")
+        for split_size in list(range(1, 40)) + [size - 1, size, size + 7]:
+            splits, off = [], 0
+            while off < size:
+                ln = min(split_size, size - off)
+                splits.append((off, ln))
+                off += ln
+            got = [
+                ln for s, n in splits for ln in self._reader_lines(p, s, n)
+            ]
+            assert got == want, f"split_size={split_size}"
+
+    def test_inputformat_strips_one_cr_like_the_framer(self, tmp_path):
+        # "x\r\r\n" must yield "x\r" (one \n, then one \r) — exactly
+        # encode_blob's framing; rstrip(b"\r\n") used to eat both.
+        p = tmp_path / "cr.log"
+        p.write_bytes(b"a\r\r\nb\r\nc")
+        got = self._reader_lines(p, 0, 9)
+        assert got == [b"a\r", b"b", b"c"]
+
+    def test_feeder_shard_ending_at_eof_no_trailing_newline(self):
+        from logparser_tpu.feeder import FeederPool
+
+        size = len(self.CONTENT)
+        for shard_bytes in (5, 15, size - 1, size, size + 3):
+            pool = FeederPool([self.CONTENT], workers=2,
+                              shard_bytes=shard_bytes, batch_lines=3,
+                              use_processes=False)
+            ebs = list(pool.batches())
+            assert b"".join(bytes(eb.payload) for eb in ebs) == self.CONTENT
+            assert sum(eb.n_lines for eb in ebs) == 8
+
+    def test_job_delivers_final_line_exactly_once(self, tmp_path):
+        p = tmp_path / "job-eof.log"
+        p.write_bytes(self.CONTENT)
+        # shard boundary landing ON EOF and mid-final-line both sweep
+        for i, shard_bytes in enumerate((15, len(self.CONTENT),
+                                         len(self.CONTENT) - 4)):
+            spec = job_spec(tmp_path, p, f"eof{i}",
+                            shard_bytes=shard_bytes, batch_lines=4)
+            rep = run(spec)
+            assert rep.complete
+            assert rep.lines == 8 and rep.rows == 8 and rep.rejects == 0
+            m = JobManifest.load(spec.out_dir)
+            finals = 0
+            for idx in m.committed_indices():
+                rec = m.shards[idx]
+                if not rec.data_file:
+                    continue
+                with open(os.path.join(spec.out_dir, rec.data_file),
+                          "rb") as f:
+                    t = pa.ipc.open_stream(f).read_all()
+                finals += t[FIELDS[0]].to_pylist().count("2.2.2.2")
+            assert finals == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_roundtrip(tmp_path, corpus_file, capsys, monkeypatch):
+    from logparser_tpu.jobs.__main__ import main
+
+    out = tmp_path / "cli-out"
+    argv = [
+        str(corpus_file), "--format", FMT, "--out", str(out),
+        "--shard-bytes", "700", "--batch-lines", "16", "--threads",
+    ]
+    for f in FIELDS:
+        argv += ["--field", f]
+    assert main(argv) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["complete"] and rep["rejects"] == 2
+    # resume via CLI: nothing left to do
+    assert main(argv) == 0
+    rep2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep2["skipped"] == rep["shards_total"]
+    # --no-resume refuses the existing manifest
+    assert main(argv + ["--no-resume"]) == 2
