@@ -1,7 +1,7 @@
 # Dev entry points (the reference's Maven/devtools tier, L0).
 PY ?= python
 
-.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke job-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke job-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -62,6 +62,18 @@ rescue-smoke:
 # chaos-smoke.
 service-smoke:
 	$(PY) -m logparser_tpu.tools.service_smoke
+
+# Coalesce smoke: the continuous-batching drill (docs/SERVICE.md
+# "Continuous batching") — K concurrent sessions with interleaved
+# mixed-size requests through the cross-session coalescer must receive
+# ARROW payloads BYTE-identical to solo parsing (zero resets), at least
+# one shared batch must carry >1 session, the coalesce metric families
+# must be live on /metrics, and the C++ reference client
+# (native/svc_client.cc) must replay the golden protocol vector with
+# byte-identical payloads and drive live requests.  CI runs this after
+# service-smoke.
+coalesce-smoke:
+	$(PY) -m logparser_tpu.tools.coalesce_smoke
 
 # Job smoke: the durable batch tier's kill-drill (docs/JOBS.md) — run a
 # corpus->sharded-Arrow job, SIGKILL (-9) it mid-run from outside, and

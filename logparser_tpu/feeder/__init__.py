@@ -27,7 +27,9 @@ from .pool import (  # noqa: F401
     FeederError,
     FeederPool,
     default_feeder_workers,
+    deregister_backpressure_source,
     queue_backpressure,
+    register_backpressure_source,
     resolve_transport,
 )
 from .ring import (  # noqa: F401

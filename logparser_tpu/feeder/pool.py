@@ -216,6 +216,24 @@ def default_feeder_workers() -> int:
 _LIVE_POOLS: "weakref.WeakSet[FeederPool]" = weakref.WeakSet()
 
 
+def register_backpressure_source(source: Any) -> None:
+    """Register any object exposing ``backpressure() -> float`` (a 0-1
+    occupancy fraction) into the process-wide
+    :func:`queue_backpressure` aggregate.  FeederPools self-register at
+    ``_start``; the serving tier's cross-session batch coalescer
+    (:mod:`logparser_tpu.service_batching`) registers here so its
+    bounded submission queue feeds the SAME admission signal
+    (docs/SERVICE.md "Continuous batching").  The WeakSet means an
+    abandoned source can never pin itself into the signal."""
+    _LIVE_POOLS.add(source)
+
+
+def deregister_backpressure_source(source: Any) -> None:
+    """Drop a :func:`register_backpressure_source` registration (no-op
+    when absent)."""
+    _LIVE_POOLS.discard(source)
+
+
 def queue_backpressure() -> float:
     """Aggregate feeder-queue occupancy across every LIVE pool in this
     process as a 0.0–1.0 fraction (worst pool wins: one saturated ring

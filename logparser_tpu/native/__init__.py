@@ -144,6 +144,42 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return _lib
 
 
+def build_tool(source_path: str, stem: str) -> Optional[str]:
+    """Compile one standalone C++ TOOL (an executable, not a ctypes
+    library) with the same baked-in toolchain `_compile_lib` uses, cached
+    in ``_build/`` by source digest.  Returns the binary path, or None
+    when no toolchain is available (callers fall back / skip — exactly
+    the logframe.cc contract).  Used by the protocol reference client
+    (``svc_client.cc``, docs/PROTOCOL.md) and available to future
+    tools."""
+    try:
+        with open(source_path, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    exe_path = os.path.join(_BUILD_DIR, f"{stem}-{digest}")
+    if os.path.exists(exe_path):
+        return exe_path
+    tmp = exe_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-pthread", source_path, "-o", tmp]
+    try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, exe_path)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return exe_path
+
+
+def svc_client_path() -> Optional[str]:
+    """The compiled protocol reference client (svc_client.cc); None when
+    the toolchain is unavailable."""
+    return build_tool(
+        os.path.join(os.path.dirname(__file__), "svc_client.cc"),
+        "svc_client",
+    )
+
+
 def native_available() -> bool:
     return get_lib() is not None
 

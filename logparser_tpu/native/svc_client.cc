@@ -1,0 +1,345 @@
+// Minimal C++ client of the sidecar wire protocol (docs/PROTOCOL.md).
+//
+// The protocol's whole point is that a non-Python engine can implement it
+// in an afternoon: u32 big-endian length-prefixed frames, one CONFIG JSON
+// frame, then [LINES frame -> ARROW frame] pairs, 0xFFFFFFFF marker +
+// error frame for structured errors (BUSY / DEADLINE / plain), length-0
+// frame to end the session.  This file is that afternoon, kept to plain
+// POSIX sockets + C++17 so the in-image toolchain builds it exactly like
+// native/logframe.cc (g++, no third-party deps; the Arrow IPC payload is
+// received and byte-checked, not decoded — decoding is pyarrow's job in
+// the smoke tests that assert byte-parity against the golden vectors).
+//
+// Modes:
+//   --replay FILE   send FILE's bytes verbatim (a golden request vector),
+//                   read responses until EOF; --dump-prefix writes each
+//                   ARROW payload to PREFIX<k>.bin.  Prints one JSON line:
+//                   {"arrow":n,"errors":m,"bytes":total}.
+//   --config FILE --lines FILE
+//                   build the CONFIG frame from FILE's JSON bytes and ONE
+//                   LINES frame from FILE's newline-delimited text (one
+//                   trailing '\n' stripped; count = line count), send it
+//                   --repeat times or for --duration seconds, classify
+//                   every response (ok / busy / deadline / error / reset),
+//                   optionally --dump the first ARROW payload.  Prints one
+//                   JSON line with outcome counts + per-request latencies
+//                   in ms — the shape tools/loadgen.py merges as its
+//                   native fast-driver (one process per client).
+//
+// Build (done on demand by logparser_tpu.native.build_tool):
+//   g++ -O2 -std=c++17 -pthread svc_client.cc -o svc_client
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kErrorMarker = 0xFFFFFFFFu;
+
+double now_s() {
+  using clock = std::chrono::steady_clock;
+  return std::chrono::duration<double>(clock::now().time_since_epoch())
+      .count();
+}
+
+bool send_all(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+// 0 = clean EOF at a frame boundary, -1 = reset/mid-buffer EOF, 1 = ok.
+int recv_exact(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, p + got, n - got, 0);
+    if (r == 0) return got == 0 ? 0 : -1;
+    if (r < 0) return -1;
+    got += static_cast<size_t>(r);
+  }
+  return 1;
+}
+
+bool send_frame(int fd, const std::string& payload) {
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  return send_all(fd, &len, 4) &&
+         send_all(fd, payload.data(), payload.size());
+}
+
+// kind: 1 ARROW payload, 2 error text, 0 clean EOF, -1 reset.
+int recv_response(int fd, std::string* payload) {
+  uint32_t be = 0;
+  int rc = recv_exact(fd, &be, 4);
+  if (rc <= 0) return rc;
+  uint32_t len = ntohl(be);
+  bool is_error = (len == kErrorMarker);
+  if (is_error) {
+    rc = recv_exact(fd, &be, 4);
+    if (rc <= 0) return -1;
+    len = ntohl(be);
+  }
+  payload->resize(len);
+  if (len > 0 && recv_exact(fd, payload->data(), len) <= 0) return -1;
+  return is_error ? 2 : 1;
+}
+
+int dial(const std::string& host, const std::string& port) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+bool read_file(const std::string& path, std::string* out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  out->assign(std::istreambuf_iterator<char>(f),
+              std::istreambuf_iterator<char>());
+  return true;
+}
+
+bool write_file(const std::string& path, const std::string& data) {
+  std::ofstream f(path, std::ios::binary);
+  if (!f) return false;
+  f.write(data.data(), static_cast<std::streamsize>(data.size()));
+  return static_cast<bool>(f);
+}
+
+// One LINES frame payload from newline-delimited text: strip ONE trailing
+// '\n' (the framing joins lines WITH '\n', it does not terminate), count
+// the lines, prefix the u32 BE count (docs/PROTOCOL.md "LINES frame").
+std::string lines_payload(std::string text) {
+  if (!text.empty() && text.back() == '\n') text.pop_back();
+  // "" (and a lone "\n" after the strip) -> zero lines; the drivers
+  // never ship empty corpora.
+  uint32_t count = text.empty() ? 0 : 1;
+  for (char c : text)
+    if (c == '\n') ++count;
+  uint32_t be = htonl(count);
+  std::string payload(reinterpret_cast<const char*>(&be), 4);
+  payload += text;
+  return payload;
+}
+
+int run_replay(const std::string& host, const std::string& port,
+               const std::string& replay_path,
+               const std::string& dump_prefix) {
+  std::string request;
+  if (!read_file(replay_path, &request)) {
+    std::fprintf(stderr, "cannot read %s\n", replay_path.c_str());
+    return 2;
+  }
+  int fd = dial(host, port);
+  if (fd < 0) {
+    std::fprintf(stderr, "connect failed\n");
+    return 2;
+  }
+  if (!send_all(fd, request.data(), request.size())) {
+    std::fprintf(stderr, "send failed\n");
+    ::close(fd);
+    return 2;
+  }
+  size_t arrow = 0, errors = 0, bytes = 0;
+  std::string payload;
+  int rc;
+  while ((rc = recv_response(fd, &payload)) > 0) {
+    bytes += payload.size();
+    if (rc == 1) {
+      if (!dump_prefix.empty()) {
+        write_file(dump_prefix + std::to_string(arrow) + ".bin", payload);
+      }
+      ++arrow;
+    } else {
+      ++errors;
+    }
+  }
+  ::close(fd);
+  if (rc < 0) {
+    std::fprintf(stderr, "connection reset mid-frame\n");
+    return 2;
+  }
+  std::printf("{\"arrow\":%zu,\"errors\":%zu,\"bytes\":%zu}\n", arrow,
+              errors, bytes);
+  return 0;
+}
+
+struct DriveStats {
+  size_t ok = 0, busy = 0, deadline = 0, errors = 0, resets = 0;
+  size_t lines_ok = 0, arrow_bytes = 0;
+  std::vector<double> latencies_s;
+};
+
+int run_drive(const std::string& host, const std::string& port,
+              const std::string& config_path, const std::string& lines_path,
+              long repeat, double duration_s, const std::string& dump_path) {
+  std::string config, text;
+  if (!read_file(config_path, &config) || !read_file(lines_path, &text)) {
+    std::fprintf(stderr, "cannot read config/lines file\n");
+    return 2;
+  }
+  std::string payload = lines_payload(std::move(text));
+  uint32_t count_be;
+  std::memcpy(&count_be, payload.data(), 4);
+  uint32_t line_count = ntohl(count_be);
+
+  auto connect = [&]() -> int {
+    int fd = dial(host, port);
+    if (fd >= 0 && !send_frame(fd, config)) {
+      ::close(fd);
+      return -1;
+    }
+    return fd;
+  };
+  int fd = connect();
+  if (fd < 0) {
+    std::fprintf(stderr, "connect failed\n");
+    return 2;
+  }
+  DriveStats st;
+  std::string response;
+  bool dumped = false;
+  double stop_at = duration_s > 0 ? now_s() + duration_s : 0.0;
+  for (long i = 0; repeat <= 0 || i < repeat; ++i) {
+    if (stop_at > 0 && now_s() >= stop_at) break;
+    double t0 = now_s();
+    if (!send_frame(fd, payload)) {
+      ++st.resets;
+      break;
+    }
+    int rc = recv_response(fd, &response);
+    if (rc <= 0) {
+      ++st.resets;
+      break;
+    }
+    if (rc == 1) {
+      ++st.ok;
+      st.lines_ok += line_count;
+      st.arrow_bytes += response.size();
+      st.latencies_s.push_back(now_s() - t0);
+      if (!dumped && !dump_path.empty()) {
+        write_file(dump_path, response);
+        dumped = true;
+      }
+    } else if (response.rfind("BUSY", 0) == 0) {
+      ++st.busy;
+      // Session-level sheds (reason sessions/draining) close this
+      // connection BY CONTRACT (docs/PROTOCOL.md "Overload responses"):
+      // reconnect before the next request so the shed never reads as a
+      // reset.
+      if (response.find("\"reason\":\"sessions\"") != std::string::npos ||
+          response.find("\"reason\":\"draining\"") != std::string::npos) {
+        ::close(fd);
+        fd = connect();
+        if (fd < 0) break;
+      }
+    } else if (response.rfind("DEADLINE", 0) == 0) {
+      ++st.deadline;
+    } else {
+      ++st.errors;
+    }
+  }
+  if (fd < 0) {
+    // Reconnect after a session shed failed: report what we have.
+    std::fprintf(stderr, "reconnect after session shed failed\n");
+  }
+  // End of session: length-0 frame, then close.
+  if (fd >= 0) {
+    uint32_t zero = 0;
+    send_all(fd, &zero, 4);
+    ::close(fd);
+  }
+
+  std::string lat = "[";
+  char buf[32];
+  for (size_t i = 0; i < st.latencies_s.size(); ++i) {
+    std::snprintf(buf, sizeof(buf), "%s%.3f", i ? "," : "",
+                  st.latencies_s[i] * 1000.0);
+    lat += buf;
+  }
+  lat += "]";
+  std::printf(
+      "{\"ok\":%zu,\"busy\":%zu,\"deadline\":%zu,\"errors\":%zu,"
+      "\"resets\":%zu,\"lines_ok\":%zu,\"arrow_bytes\":%zu,"
+      "\"latencies_ms\":%s}\n",
+      st.ok, st.busy, st.deadline, st.errors, st.resets, st.lines_ok,
+      st.arrow_bytes, lat.c_str());
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1", port, config, lines, replay;
+  std::string dump, dump_prefix;
+  long repeat = 1;
+  double duration_s = 0.0;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&](const char* what) -> std::string {
+      if (i + 1 >= argc) {
+        std::fprintf(stderr, "missing value for %s\n", what);
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--host") host = next("--host");
+    else if (a == "--port") port = next("--port");
+    else if (a == "--config") config = next("--config");
+    else if (a == "--lines") lines = next("--lines");
+    else if (a == "--replay") replay = next("--replay");
+    else if (a == "--repeat") repeat = std::stol(next("--repeat"));
+    else if (a == "--duration") duration_s = std::stod(next("--duration"));
+    else if (a == "--dump") dump = next("--dump");
+    else if (a == "--dump-prefix") dump_prefix = next("--dump-prefix");
+    else {
+      std::fprintf(stderr, "unknown argument %s\n", a.c_str());
+      return 2;
+    }
+  }
+  if (port.empty()) {
+    std::fprintf(stderr,
+                 "usage: svc_client --port P [--host H] "
+                 "(--replay FILE [--dump-prefix P] | "
+                 "--config FILE --lines FILE [--repeat N | --duration S] "
+                 "[--dump FILE])\n");
+    return 2;
+  }
+  if (!replay.empty()) return run_replay(host, port, replay, dump_prefix);
+  if (config.empty() || lines.empty()) {
+    std::fprintf(stderr, "--config and --lines are required\n");
+    return 2;
+  }
+  if (duration_s > 0) {
+    repeat = 0;  // duration bounds the loop instead
+  } else if (repeat <= 0) {
+    repeat = 1;  // neither bound given: one shot, never a zero-run
+  }
+  return run_drive(host, port, config, lines, repeat, duration_s, dump);
+}

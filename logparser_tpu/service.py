@@ -69,6 +69,18 @@ feeder's "degrade, never drop" fault model):
   escalates force-close -> join — leaked threads are warned once and
   counted (``service_teardown_errors_total{site}``), never silent.
 
+Throughput contract (round 14, docs/SERVICE.md "Continuous batching"):
+concurrent sessions sharing a parser config COALESCE into shared device
+batches (:mod:`logparser_tpu.service_batching`): per-batch fixed costs
+(dispatch, pad waste, D2H round-trip) amortize across sessions, each
+session scatters back its exact row window — BYTE-identical to solo
+parsing, so nothing changes on the wire — and the coalescer's bounded
+queue composes with the admission tier above (full queue = structured
+``BUSY{coalesce_queue}``; queue occupancy feeds ``queue_backpressure()``;
+request deadlines expire queued entries without poisoning shared
+batches).  Knobs: ``coalesce`` / ``coalesce_window_ms`` /
+``coalesce_max_lines`` / ``coalesce_queue_depth``.
+
 Observability (docs/OBSERVABILITY.md): the service renders the process-wide
 metrics registry as a Prometheus ``/metrics`` HTTP endpoint
 (``metrics_port=``, or LOGPARSER_TPU_METRICS_PORT for the CLI) plus
@@ -368,6 +380,16 @@ class ServiceLimits:
     busy_retry_after_s: float = 0.25          # BUSY frame retry hint
     backpressure_threshold: float = 0.95      # feeder-queue shed fraction
     drain_deadline_s: float = 10.0            # graceful-drain budget
+    # Continuous batching (docs/SERVICE.md "Continuous batching"):
+    # cross-session device-batch coalescing, keyed per compiled-parser
+    # config.  window = how long a forming batch waits for stragglers
+    # (only when >1 session is live); max_lines = the shared batch
+    # geometry ceiling; queue_depth = the bounded submission queue
+    # (full = structured BUSY{coalesce_queue} shed).
+    coalesce: bool = True
+    coalesce_window_ms: float = 2.0
+    coalesce_max_lines: int = 4096
+    coalesce_queue_depth: int = 256
 
     @property
     def inflight(self) -> int:
@@ -388,15 +410,23 @@ class _ParserCache:
         self._parsers: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._building: Dict[Tuple, threading.Lock] = {}
 
-    def get(self, config: Dict[str, Any]):
-        from .tpu.batch import TpuBatchParser
-
-        key = (
+    @staticmethod
+    def key_of(config: Dict[str, Any]) -> Tuple:
+        """The compiled-parser identity of a CONFIG: sessions with the
+        same key share one parser — and one continuous-batching lane
+        (requests coalesce ONLY within a key: a shared device batch must
+        run exactly one compiled program)."""
+        return (
             config["log_format"],
             tuple(config["fields"]),
             config.get("timestamp_format"),
             config.get("assembly_workers"),
         )
+
+    def get(self, config: Dict[str, Any]):
+        from .tpu.batch import TpuBatchParser
+
+        key = self.key_of(config)
         # Compile outside the global lock: a cold compile takes seconds and
         # must not stall sessions whose parser is already cached.  A per-key
         # lock still deduplicates concurrent compiles of the same config.
@@ -454,7 +484,38 @@ class _ServiceServer(socketserver.ThreadingTCPServer):
         self.inflight_slots = threading.BoundedSemaphore(limits.inflight)
         self.sessions: Dict[Any, threading.Thread] = {}
         self.sessions_lock = threading.Lock()
+        self.key_sessions: Dict[Any, int] = {}
         self.draining = False
+        # Cross-session batch coalescer (service_batching.py), attached
+        # by ParseService when limits.coalesce is on; None = every
+        # request dispatches its own device batch (the pre-round-14
+        # behavior, and the bench A/B baseline).
+        self.coalescer: Optional[Any] = None
+
+    def admitted_sessions(self) -> int:
+        with self.sessions_lock:
+            return sum(1 for h in self.sessions if h.admitted)
+
+    # Sessions per PARSER KEY (registered once the CONFIG resolves,
+    # dropped when the session ends): the coalescer's window is only
+    # worth paying when another session on the SAME key could
+    # contribute — a global count would make a lone tenant on its own
+    # format pay the window because an unrelated format has traffic.
+    def key_session_enter(self, key: Any) -> None:
+        with self.sessions_lock:
+            self.key_sessions[key] = self.key_sessions.get(key, 0) + 1
+
+    def key_session_exit(self, key: Any) -> None:
+        with self.sessions_lock:
+            n = self.key_sessions.get(key, 0) - 1
+            if n > 0:
+                self.key_sessions[key] = n
+            else:
+                self.key_sessions.pop(key, None)
+
+    def sessions_on_key(self, key: Any) -> int:
+        with self.sessions_lock:
+            return self.key_sessions.get(key, 0)
 
     def admit_request(self) -> Optional[str]:
         """Per-request admission: None = admitted (ONE in-flight slot is
@@ -683,32 +744,44 @@ class _SessionHandler(socketserver.BaseRequestHandler):
             self._config_error_loop(f"bad config: {e}")
             return
 
-        state = {"feeder_workers": feeder_workers}
-        while True:
-            try:
-                lines_frame = self._read_frame(lim.lines_cap, True)
-            except _SessionTimeout as e:
-                self._timeout(e.kind)
-                return
-            except _FrameTooLarge as e:
-                if not self._reject_frame(
-                    "frame_overflow" if e.fatal else "lines_too_large",
-                    f"rejected: {e}", fatal=e.fatal,
-                ):
+        try:
+            parser_key = _ParserCache.key_of(config)
+        except Exception:  # noqa: BLE001 — doubles may bypass the schema
+            parser_key = repr(config)
+        state = {"feeder_workers": feeder_workers,
+                 "parser_key": parser_key}
+        # Per-key session registry: the coalescer skips its straggler
+        # window when this session is the key's only one.
+        self.server.key_session_enter(parser_key)
+        try:
+            while True:
+                try:
+                    lines_frame = self._read_frame(lim.lines_cap, True)
+                except _SessionTimeout as e:
+                    self._timeout(e.kind)
                     return
-                continue
-            except (ValueError, OSError, ParseServiceError) as e:
-                if isinstance(e, OSError) and not isinstance(e, ConnectionError):
-                    LOG.info("sess=%d socket closed between frames: %s",
-                             self.sid, e)
-                else:
-                    LOG.error("sess=%d bad lines frame: %s", self.sid, e)
-                return
-            if lines_frame is None:
-                return  # end of session
-            if not self._serve_request(sock, parser, lines_frame, state,
-                                       send_stats):
-                return
+                except _FrameTooLarge as e:
+                    if not self._reject_frame(
+                        "frame_overflow" if e.fatal else "lines_too_large",
+                        f"rejected: {e}", fatal=e.fatal,
+                    ):
+                        return
+                    continue
+                except (ValueError, OSError, ParseServiceError) as e:
+                    if isinstance(e, OSError) and not isinstance(
+                            e, ConnectionError):
+                        LOG.info("sess=%d socket closed between frames: %s",
+                                 self.sid, e)
+                    else:
+                        LOG.error("sess=%d bad lines frame: %s", self.sid, e)
+                    return
+                if lines_frame is None:
+                    return  # end of session
+                if not self._serve_request(sock, parser, lines_frame, state,
+                                           send_stats):
+                    return
+        finally:
+            self.server.key_session_exit(parser_key)
 
     # -- one request ----------------------------------------------------
 
@@ -752,6 +825,40 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 return False
             return True
         if isinstance(outcome, Exception):
+            from .service_batching import (
+                CoalesceDeadline,
+                CoalesceQueueFull,
+            )
+
+            if isinstance(outcome, CoalesceQueueFull):
+                # The coalescer's bounded submission queue is full: shed
+                # STRUCTURED, exactly like the admission legs — never an
+                # opaque parse error (docs/SERVICE.md).
+                reg.increment("service_shed_total",
+                              labels={"reason": "coalesce_queue"})
+                LOG.info("sess=%d request shed (coalesce_queue)", self.sid)
+                try:
+                    write_error(sock, busy_error_text(
+                        "coalesce_queue", lim.busy_retry_after_s))
+                except OSError:
+                    return False
+                return True
+            if isinstance(outcome, CoalesceDeadline):
+                # Expired while QUEUED (dropped before batch formation):
+                # the same structured DEADLINE answer an expired solo
+                # parse gets, and the session survives.
+                reg.increment("service_deadline_expired_total")
+                LOG.warning(
+                    "sess=%d request deadline (%.3fs) expired in the "
+                    "coalesce queue", self.sid,
+                    lim.request_deadline_s or 0.0,
+                )
+                try:
+                    write_error(sock, deadline_error_text(
+                        lim.request_deadline_s or 0.0))
+                except OSError:
+                    return False
+                return True
             LOG.error("sess=%d parse failed", self.sid, exc_info=outcome)
             reg.increment("service_request_errors_total")
             try:
@@ -906,7 +1013,24 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 LOG.error("sess=%d feeder fabric failed; request "
                           "re-parsed inline: %s", self.sid, e)
         if table is None:
-            if blob_shape:
+            coalescer = getattr(self.server, "coalescer", None)
+            if (
+                coalescer is not None and blob_shape
+                and count <= coalescer.max_lines
+            ):
+                # Continuous batching (docs/SERVICE.md): the payload
+                # joins the parser key's shared submission queue and
+                # comes back as this request's row window of a
+                # coalesced device batch — byte-identical to the solo
+                # parse below.  Oversize payloads (and the feeder path
+                # above) keep their own dispatch; CR-carrying and
+                # trailing-newline payloads need the exact-list
+                # semantics of the split path.
+                result = coalescer.parse(
+                    state["parser_key"], parser, bytes(blob), count,
+                    deadline_s=self.server.limits.request_deadline_s,
+                )
+            elif blob_shape:
                 # (an empty blob is one empty LINE per the
                 # protocol, which blob framing would drop —
                 # split path below)
@@ -1137,13 +1261,27 @@ class ParseService:
                  max_lines_bytes: int = 0,
                  busy_retry_after_s: float = 0.25,
                  backpressure_threshold: float = 0.95,
-                 drain_deadline_s: float = 10.0):
+                 drain_deadline_s: float = 10.0,
+                 coalesce: Optional[bool] = None,
+                 coalesce_window_ms: Optional[float] = None,
+                 coalesce_max_lines: Optional[int] = None,
+                 coalesce_queue_depth: Optional[int] = None):
         def _window(v: Optional[float]) -> Optional[float]:
             # <= 0 means "disabled", like request_deadline_s/max_inflight:
             # settimeout(0.0) would mean NON-BLOCKING and instantly kill
             # every session — never let that spelling through.
             return float(v) if v and v > 0 else None
 
+        defaults = ServiceLimits()
+        if coalesce is None:
+            # Env kill switch (docs/SERVICE.md): continuous batching is
+            # ON by default — it is byte-transparent on the wire — but
+            # an operator can hard-disable it without a code change.
+            import os
+
+            coalesce = os.environ.get(
+                "LOGPARSER_TPU_COALESCE", "1"
+            ).strip().lower() not in ("0", "false", "no")
         self.limits = ServiceLimits(
             max_sessions=int(max_sessions),
             max_inflight=int(max_inflight),
@@ -1156,9 +1294,31 @@ class ParseService:
             busy_retry_after_s=float(busy_retry_after_s),
             backpressure_threshold=float(backpressure_threshold),
             drain_deadline_s=float(drain_deadline_s),
+            coalesce=bool(coalesce),
+            coalesce_window_ms=float(
+                defaults.coalesce_window_ms if coalesce_window_ms is None
+                else coalesce_window_ms
+            ),
+            coalesce_max_lines=int(
+                defaults.coalesce_max_lines if coalesce_max_lines is None
+                else coalesce_max_lines
+            ),
+            coalesce_queue_depth=int(
+                defaults.coalesce_queue_depth if coalesce_queue_depth is None
+                else coalesce_queue_depth
+            ),
         )
         self._server = _ServiceServer((host, port), _SessionHandler,
                                       self.limits)
+        if self.limits.coalesce:
+            from .service_batching import BatchCoalescer
+
+            self._server.coalescer = BatchCoalescer(
+                window_s=self.limits.coalesce_window_ms / 1000.0,
+                max_lines=self.limits.coalesce_max_lines,
+                queue_depth=self.limits.coalesce_queue_depth,
+                live_sessions_fn=self._server.sessions_on_key,
+            )
         self._thread: Optional[threading.Thread] = None
         self._serving = False
         self._closed = False
@@ -1342,6 +1502,12 @@ class ParseService:
         else:
             self._force_close_sessions("shutdown", count=False)
         self._join_sessions()
+        # After the session join: queued coalescer entries belong to
+        # admitted sessions, so by now the lanes are empty on a graceful
+        # drain — shutdown() only has live work to fail when sessions
+        # were force-closed past the drain deadline.
+        if self._server.coalescer is not None:
+            self._server.coalescer.shutdown()
         if drain:
             # The drain is over (documented: "1 WHILE a graceful drain is
             # in progress") — a later service in this process must not
@@ -1589,6 +1755,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         default=_env_float("LOGPARSER_TPU_DRAIN_DEADLINE") or 10.0,
         help="graceful-drain budget before force-close escalation, seconds",
     )
+    ap.add_argument(
+        "--no-coalesce", action="store_true",
+        help="disable cross-session continuous batching (also "
+             "LOGPARSER_TPU_COALESCE=0)",
+    )
+    ap.add_argument(
+        "--coalesce-window-ms", type=float,
+        default=_env_float("LOGPARSER_TPU_COALESCE_WINDOW_MS"),
+        help="how long a forming shared batch waits for more sessions "
+             "(default 2 ms; only paid when >1 session is live)",
+    )
+    ap.add_argument(
+        "--coalesce-max-lines", type=int,
+        default=_env_int("LOGPARSER_TPU_COALESCE_MAX_LINES"),
+        help="shared device batch geometry ceiling in lines (default 4096)",
+    )
+    ap.add_argument(
+        "--coalesce-queue-depth", type=int,
+        default=_env_int("LOGPARSER_TPU_COALESCE_QUEUE_DEPTH"),
+        help="bounded coalesce submission queue; full = structured "
+             "BUSY{coalesce_queue} shed (default 256)",
+    )
     ap.add_argument("--log-level", default=os.environ.get(
         "LOGPARSER_TPU_LOG_LEVEL", "INFO"))
     args = ap.parse_args(argv)
@@ -1607,6 +1795,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         idle_timeout_s=args.idle_timeout,
         frame_timeout_s=args.frame_timeout,
         drain_deadline_s=args.drain_deadline,
+        coalesce=False if args.no_coalesce else None,
+        coalesce_window_ms=args.coalesce_window_ms,
+        coalesce_max_lines=args.coalesce_max_lines,
+        coalesce_queue_depth=args.coalesce_queue_depth,
     )
 
     def _on_sigterm(signum, frame):  # noqa: ARG001 — signal contract

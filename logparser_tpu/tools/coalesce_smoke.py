@@ -1,0 +1,273 @@
+"""CI coalesce smoke: continuous batching against a LIVE sidecar
+(docs/SERVICE.md "Continuous batching" acceptance drill).
+
+Boots two in-process :class:`~logparser_tpu.service.ParseService`
+instances — coalescing ON (generous window, so concurrent rounds land in
+shared batches) and OFF (the solo reference) — and asserts:
+
+1. **Byte parity** — K concurrent raw-socket sessions pushing
+   interleaved mixed-size LINES frames through the coalescer receive
+   ARROW payloads BYTE-identical to the same frames parsed solo, with
+   zero resets (every response a well-formed frame).
+2. **Real coalescing** — at least one shared batch carried >1 session
+   (``service_coalesced_sessions_per_batch``).
+3. **Exposition** — /metrics exposes the coalesce metric families in a
+   structurally valid exposition (`metrics_smoke.validate_exposition`).
+4. **C++ reference client** (skipped without a toolchain, like the
+   logframe fallback): ``native/svc_client.cc`` replays the golden
+   protocol vector 01 and its received ARROW payloads are byte-identical
+   to a Python raw-socket replay of the same bytes — the carried
+   VERDICT item: the protocol doc + vectors suffice to implement a
+   working client in another language.  Its drive mode then runs 3 live
+   requests through the coalescing service.
+
+Usage::
+
+    make coalesce-smoke
+    python -m logparser_tpu.tools.coalesce_smoke
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_response(sock: socket.socket) -> Tuple[str, bytes]:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return "reset", b""
+    (n,) = struct.unpack(">I", header)
+    if n == 0xFFFFFFFF:
+        (m,) = struct.unpack(">I", _recv_exact(sock, 4) or b"\0\0\0\0")
+        return "error", _recv_exact(sock, m) or b""
+    return "arrow", _recv_exact(sock, n) or b""
+
+
+def _session(host: str, port: int, config: bytes,
+             payloads: List[bytes], barrier: Optional[threading.Barrier],
+             out: Dict[int, List[Tuple[str, bytes]]], idx: int) -> None:
+    sock = socket.create_connection((host, port))
+    try:
+        sock.settimeout(120)
+        _send_frame(sock, config)
+        got = []
+        for payload in payloads:
+            if barrier is not None:
+                barrier.wait(timeout=60)
+            _send_frame(sock, payload)
+            got.append(_recv_response(sock))
+        out[idx] = got
+        sock.sendall(struct.pack(">I", 0))
+    finally:
+        sock.close()
+
+
+def _replay_python(host: str, port: int, path: str) -> List[bytes]:
+    with open(path, "rb") as f:
+        blob = f.read()
+    sock = socket.create_connection((host, port))
+    try:
+        sock.settimeout(60)
+        sock.sendall(blob)
+        payloads = []
+        while True:
+            kind, body = _recv_response(sock)
+            if kind == "reset":
+                return payloads
+            if kind == "arrow":
+                payloads.append(body)
+    finally:
+        sock.close()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from logparser_tpu.observability import metrics
+    from logparser_tpu.service import ParseService
+    from logparser_tpu.tools.demolog import generate_combined_lines
+    from logparser_tpu.tools.metrics_smoke import validate_exposition
+
+    problems: List[str] = []
+    config = json.dumps({
+        "log_format": "combined",
+        "fields": ["IP:connection.client.host",
+                   "STRING:request.status.last",
+                   "BYTES:response.body.bytes"],
+        "timestamp_format": None,
+    }).encode()
+    corpus = generate_combined_lines(240, seed=23)
+    sizes_by_session = [(1, 41, 9), (23, 2, 57), (11, 64, 5), (3, 17, 30)]
+    payload_sets: List[List[bytes]] = []
+    cursor = 0
+    for sizes in sizes_by_session:
+        payloads = []
+        for n in sizes:
+            rows = [corpus[(cursor + j) % len(corpus)] for j in range(n)]
+            blob = "\n".join(rows).encode()
+            payloads.append(struct.pack(">I", n) + blob)
+            cursor += n
+        payload_sets.append(payloads)
+
+    spb = metrics().histogram("service_coalesced_sessions_per_batch")
+    count0, sum0 = spb.count, spb.sum
+
+    # Solo reference (coalescing OFF), sequential sessions.
+    refs: Dict[int, List[Tuple[str, bytes]]] = {}
+    with ParseService(coalesce=False) as solo:
+        for i, payloads in enumerate(payload_sets):
+            _session(solo.host, solo.port, config, payloads, None, refs, i)
+
+    # Concurrent sessions through the coalescer.
+    out: Dict[int, List[Tuple[str, bytes]]] = {}
+    with ParseService(coalesce=True, coalesce_window_ms=50.0,
+                      metrics_port=0) as svc:
+        barrier = threading.Barrier(len(payload_sets))
+        threads = [
+            threading.Thread(target=_session,
+                             args=(svc.host, svc.port, config, payloads,
+                                   barrier, out, i))
+            for i, payloads in enumerate(payload_sets)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+
+        # 3) exposition + family presence, while the service is live.
+        url = f"http://{svc.host}:{svc.metrics_port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode("utf-8")
+        problems.extend(validate_exposition(text))
+        for needle in (
+            "logparser_tpu_service_coalesce_batch_occupancy",
+            "logparser_tpu_service_coalesce_wait_seconds",
+            "logparser_tpu_service_coalesced_sessions_per_batch",
+            "logparser_tpu_service_coalesce_batches_total",
+        ):
+            if needle not in text:
+                problems.append(f"required metric absent: {needle}")
+
+        # 4) the C++ reference client, against the same live service.
+        from logparser_tpu.native import svc_client_path
+
+        exe = svc_client_path()
+        if exe is None:
+            print("coalesce-smoke: no C++ toolchain; native client leg "
+                  "skipped (numpy-fallback hosts)")
+        else:
+            import subprocess
+            import tempfile
+
+            golden = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))),
+                "tests", "golden", "protocol", "01_session_request.bin",
+            )
+            ref_payloads = _replay_python(svc.host, svc.port, golden)
+            with tempfile.TemporaryDirectory() as td:
+                proc = subprocess.run(
+                    [exe, "--host", svc.host, "--port", str(svc.port),
+                     "--replay", golden, "--dump-prefix", td + "/v"],
+                    capture_output=True, text=True, timeout=120,
+                )
+                if proc.returncode != 0:
+                    problems.append(
+                        f"C++ client replay failed: {proc.stderr.strip()}"
+                    )
+                else:
+                    for i, ref in enumerate(ref_payloads):
+                        try:
+                            with open(f"{td}/v{i}.bin", "rb") as f:
+                                got = f.read()
+                        except OSError:
+                            got = None
+                        if got != ref:
+                            problems.append(
+                                f"C++ client ARROW payload {i} not "
+                                "byte-identical to the Python replay"
+                            )
+                # Drive mode: 3 live requests through the coalescer.
+                cf = os.path.join(td, "config.json")
+                lf = os.path.join(td, "lines.txt")
+                with open(cf, "wb") as f:
+                    f.write(config)
+                with open(lf, "w") as f:
+                    f.write("\n".join(corpus[:16]))
+                proc = subprocess.run(
+                    [exe, "--host", svc.host, "--port", str(svc.port),
+                     "--config", cf, "--lines", lf, "--repeat", "3"],
+                    capture_output=True, text=True, timeout=120,
+                )
+                try:
+                    rec = json.loads(proc.stdout)
+                except ValueError:
+                    rec = {}
+                if rec.get("ok") != 3 or rec.get("resets"):
+                    problems.append(
+                        f"C++ client drive mode: {proc.stdout.strip()} "
+                        f"{proc.stderr.strip()}"
+                    )
+
+    # 1) byte parity + zero resets.
+    for i, ref in refs.items():
+        got = out.get(i)
+        if got is None:
+            problems.append(f"session {i} never completed")
+            continue
+        for r, (kind, body) in enumerate(got):
+            if kind != "arrow":
+                problems.append(
+                    f"session {i} round {r}: {kind} instead of ARROW"
+                )
+            elif body != ref[r][1]:
+                problems.append(
+                    f"session {i} round {r}: coalesced bytes differ "
+                    "from solo parse"
+                )
+
+    # 2) real coalescing happened.
+    spb = metrics().histogram("service_coalesced_sessions_per_batch")
+    batches = spb.count - count0
+    sessions = spb.sum - sum0
+    if not batches or sessions <= batches:
+        problems.append(
+            f"no shared batch coalesced >1 session "
+            f"({sessions:.0f} sessions over {batches} batches)"
+        )
+
+    if problems:
+        print("coalesce-smoke: FAIL")
+        for p in problems:
+            print(" -", p)
+        return 1
+    print(
+        "coalesce-smoke: OK — "
+        f"{len(payload_sets)} concurrent sessions byte-identical to solo, "
+        f"{sessions:.0f} sessions over {batches} shared batches, "
+        "coalesce families live on /metrics"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,106 @@ class _ClientStats:
         self.latencies.extend(other.latencies)
 
 
+#: Prometheus families the coalesce occupancy report reads
+#: (docs/OBSERVABILITY.md "Continuous batching").
+_COALESCE_PREFIX = "logparser_tpu_service_coalesce"
+
+
+def scrape_metrics(url: str) -> Dict[str, float]:
+    """Flat {series_name_with_labels: value} view of one Prometheus text
+    exposition scrape (comment lines dropped)."""
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        text = resp.read().decode("utf-8")
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def coalesce_report(before: Dict[str, float],
+                    after: Dict[str, float]) -> Dict[str, Any]:
+    """The continuous-batching occupancy report for one loadgen window,
+    from /metrics scrapes taken around it: formed batches, mean batch
+    occupancy (fill fraction of the configured geometry), mean coalesced
+    sessions per batch, mean queue wait — the server-side half of the
+    SLO record (the outcome counts above are the client-side half)."""
+    def delta(name: str) -> float:
+        return after.get(name, 0.0) - before.get(name, 0.0)
+
+    spb = _COALESCE_PREFIX + "d_sessions_per_batch"
+    occ = _COALESCE_PREFIX + "_batch_occupancy"
+    wait = _COALESCE_PREFIX + "_wait_seconds"
+    batches = delta(spb + "_count")
+    waits = delta(wait + "_count")
+    return {
+        "batches": int(batches),
+        "mean_sessions_per_batch": round(delta(spb + "_sum") / batches, 3)
+        if batches else None,
+        "mean_batch_occupancy": round(delta(occ + "_sum") / batches, 4)
+        if batches else None,
+        "mean_wait_ms": round(delta(wait + "_sum") / waits * 1000.0, 3)
+        if waits else None,
+        "expired_in_queue": int(delta(
+            "logparser_tpu_service_coalesce_expired_total")),
+    }
+
+
+def _drive_native(host: str, port: int, cfg: Tuple[str, str, List[str]],
+                  lines: List[str], duration_s: float, timeout_s: float,
+                  stats: _ClientStats, exe: str, workdir: str) -> None:
+    """One client driven by the compiled C++ protocol client
+    (native/svc_client.cc): closed-loop back-to-back requests for the
+    window, outcomes merged from its JSON report.  The fast driver takes
+    the Python client's GIL share out of the measurement loop — the
+    loadgen process spends its cycles on the OTHER clients."""
+    import json as _json
+    import os
+    import subprocess
+
+    _name, log_format, fields = cfg
+    config_path = os.path.join(workdir, f"config-{_name}.json")
+    lines_path = os.path.join(workdir, f"lines-{_name}.txt")
+    if not os.path.exists(config_path):
+        with open(config_path, "w") as f:
+            _json.dump({"log_format": log_format, "fields": fields,
+                        "timestamp_format": None}, f)
+    if not os.path.exists(lines_path):
+        with open(lines_path, "w") as f:
+            f.write("\n".join(lines))
+    try:
+        out = subprocess.run(
+            [exe, "--host", host, "--port", str(port),
+             "--config", config_path, "--lines", lines_path,
+             "--duration", str(duration_s)],
+            capture_output=True, text=True,
+            timeout=duration_s + timeout_s + 10.0,
+        )
+        rec = _json.loads(out.stdout)
+    except Exception:  # noqa: BLE001 — a dead driver reads as a reset
+        stats.requests += 1
+        stats.resets += 1
+        return
+    stats.ok += int(rec.get("ok", 0))
+    stats.busy += int(rec.get("busy", 0))
+    stats.deadline += int(rec.get("deadline", 0))
+    stats.errors += int(rec.get("errors", 0))
+    stats.resets += int(rec.get("resets", 0))
+    stats.lines_ok += int(rec.get("lines_ok", 0))
+    stats.requests += sum(int(rec.get(k, 0)) for k in
+                          ("ok", "busy", "deadline", "errors", "resets"))
+    stats.latencies.extend(
+        ms / 1000.0 for ms in rec.get("latencies_ms", ())
+    )
+
+
 def _quiet_close(client: Optional[ParseServiceClient]) -> None:
     if client is not None:
         try:
@@ -187,26 +287,57 @@ def run_loadgen(host: str, port: int, *, clients: int = 8,
                 duration_s: float = 3.0, batch_lines: int = 128,
                 burst: int = 4, interval_s: float = 0.05,
                 formats: Optional[Sequence[Tuple[str, str, List[str]]]] = None,
-                seed: int = 7, timeout_s: float = 30.0) -> Dict[str, Any]:
+                seed: int = 7, timeout_s: float = 30.0,
+                metrics_url: Optional[str] = None,
+                native: bool = False) -> Dict[str, Any]:
     """Drive the service at ``host:port`` and return the SLO record:
     outcome counts, ok-request p50/p99 (ms), and goodput
-    (ok lines per wall second)."""
+    (ok lines per wall second).
+
+    ``formats`` with a SINGLE entry is the many-small-clients shared-
+    format scenario (every client on one parser cache key — the shape
+    continuous batching coalesces, docs/SERVICE.md).  ``metrics_url``
+    (the server's /metrics endpoint) adds a ``coalesce`` block with the
+    server-side occupancy report for the window.  ``native=True`` runs
+    each client through the compiled C++ protocol client
+    (native/svc_client.cc) instead of the Python one — closed-loop
+    back-to-back requests, no burst pacing — falling back to the Python
+    driver when no toolchain is available."""
     fmts = list(formats or DEFAULT_FORMATS)
     corpora = {name: make_lines(name, batch_lines, seed=seed)
                for name, _lf, _f in fmts}
     per_client = [_ClientStats() for _ in range(clients)]
+    native_exe = None
+    workdir = None
+    if native:
+        from ..native import svc_client_path
+
+        native_exe = svc_client_path()
+        if native_exe is not None:
+            import tempfile
+
+            workdir = tempfile.mkdtemp(prefix="loadgen-native-")
+    before = scrape_metrics(metrics_url) if metrics_url else None
     t_start = time.monotonic()
     stop_at = t_start + duration_s
     threads = []
     for i in range(clients):
         cfg = fmts[i % len(fmts)]
-        t = threading.Thread(
-            target=_drive,
-            args=(host, port, cfg, corpora[cfg[0]], stop_at, interval_s,
-                  burst, timeout_s, random.Random(seed * 1000 + i),
-                  per_client[i]),
-            name=f"loadgen-{i}", daemon=True,
-        )
+        if native_exe is not None:
+            t = threading.Thread(
+                target=_drive_native,
+                args=(host, port, cfg, corpora[cfg[0]], duration_s,
+                      timeout_s, per_client[i], native_exe, workdir),
+                name=f"loadgen-native-{i}", daemon=True,
+            )
+        else:
+            t = threading.Thread(
+                target=_drive,
+                args=(host, port, cfg, corpora[cfg[0]], stop_at, interval_s,
+                      burst, timeout_s, random.Random(seed * 1000 + i),
+                      per_client[i]),
+                name=f"loadgen-{i}", daemon=True,
+            )
         t.start()
         threads.append(t)
     for t in threads:
@@ -217,7 +348,18 @@ def run_loadgen(host: str, port: int, *, clients: int = 8,
     total = _ClientStats()
     for s in per_client:
         total.merge(s)
+    if workdir is not None:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    extra: Dict[str, Any] = {}
+    if before is not None:
+        extra["coalesce"] = coalesce_report(
+            before, scrape_metrics(metrics_url))
+    if native:
+        extra["driver"] = "native" if native_exe is not None else "python"
     return {
+        **extra,
         "clients": clients,
         "duration_s": round(wall_s, 3),
         "batch_lines": batch_lines,
@@ -257,11 +399,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--burst", type=int, default=4)
     ap.add_argument("--interval", type=float, default=0.05)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument(
+        "--shared-format", action="store_true",
+        help="many-small-clients scenario: every client on ONE format "
+             "(one parser cache key), the shape continuous batching "
+             "coalesces",
+    )
+    ap.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="server /metrics port: adds the server-side coalesce "
+             "occupancy report (batches, sessions/batch, occupancy, "
+             "queue wait) to the record",
+    )
+    ap.add_argument(
+        "--native", action="store_true",
+        help="drive with the compiled C++ protocol client "
+             "(native/svc_client.cc); falls back to the Python client "
+             "when no toolchain is available",
+    )
     args = ap.parse_args(argv)
     record = run_loadgen(
         args.host, args.port, clients=args.clients,
         duration_s=args.duration, batch_lines=args.batch_lines,
         burst=args.burst, interval_s=args.interval, seed=args.seed,
+        formats=DEFAULT_FORMATS[:1] if args.shared_format else None,
+        metrics_url=(
+            f"http://{args.host}:{args.metrics_port}/metrics"
+            if args.metrics_port else None
+        ),
+        native=args.native,
     )
     print(json.dumps(record, indent=1, sort_keys=True))
     return 0

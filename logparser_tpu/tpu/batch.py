@@ -222,6 +222,46 @@ class _LazyWildcard:
             len(c[0]) for c in self.chunks
         ) or bool(self._dense)
 
+    def sliced(self, start: int, stop: int) -> "_LazyWildcard":
+        """Row-window copy for :meth:`BatchResult.slice`: eager rows and
+        tombstones rebase to slice-local indices; each flat chunk is
+        filtered to the window's segments with its byte runs re-packed —
+        the SAME one-chunk construction a solo parse of those rows would
+        have produced, so ``to_arrow_map``'s fast path (and its output
+        bytes) are preserved across slicing."""
+        out = _LazyWildcard()
+        out.eager = {
+            i - start: v for i, v in self.eager.items() if start <= i < stop
+        }
+        out.dropped = {
+            i - start for i in self.dropped if start <= i < stop
+        }
+        for vrows, seg_row, nb, non, vb, nov, seg_high in self.chunks:
+            vrows = np.asarray(vrows, dtype=np.int64)
+            seg_row = np.asarray(seg_row, dtype=np.int64)
+            vsel = (vrows >= start) & (vrows < stop)
+            ssel = (seg_row >= start) & (seg_row < stop)
+            if not vsel.any() and not ssel.any():
+                continue
+            name_lens = np.diff(np.asarray(non, dtype=np.int64))
+            val_lens = np.diff(np.asarray(nov, dtype=np.int64))
+            nb_np = np.frombuffer(nb, dtype=np.uint8)
+            vb_np = np.frombuffer(vb, dtype=np.uint8)
+            new_non = np.zeros(int(ssel.sum()) + 1, dtype=np.int64)
+            np.cumsum(name_lens[ssel], out=new_non[1:])
+            new_nov = np.zeros(int(ssel.sum()) + 1, dtype=np.int64)
+            np.cumsum(val_lens[ssel], out=new_nov[1:])
+            out.add_chunk(
+                vrows[vsel] - start,
+                seg_row[ssel] - start,
+                nb_np[np.repeat(ssel, name_lens)].tobytes(),
+                new_non,
+                vb_np[np.repeat(ssel, val_lens)].tobytes(),
+                new_nov,
+                np.asarray(seg_high, dtype=bool)[ssel],
+            )
+        return out
+
     def to_arrow_map(self, B: int):
         """pyarrow MapArray built straight from the flat buffers; None when
         this needs the exact dict path (multi-chunk/multi-format results,
@@ -465,6 +505,36 @@ class _BlobLines:
             yield self[i]
 
 
+class _SliceLines:
+    """Row-window view of a parent lines sequence (list or
+    :class:`_BlobLines`): the lines handle a sliced :class:`BatchResult`
+    carries.  Rows materialize lazily through the parent — a blob-backed
+    parent still only ever materializes the rows somebody indexes."""
+
+    __slots__ = ("_parent", "_start", "_n")
+
+    def __init__(self, parent, start: int, n: int):
+        self._parent = parent
+        self._start = start
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._n))]
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        return self._parent[self._start + i]
+
+    def __iter__(self):
+        for i in range(self._n):
+            yield self[i]
+
+
 def _release_stream_item(item) -> None:
     """Give a stream item's ring slot back (zero-copy feeder batches);
     plain batches and line lists have no lease (no-op / absent)."""
@@ -519,6 +589,10 @@ class BatchResult:
         # the jobs reject channel reads it to build per-line error
         # tables instead of silently dropping bad lines.
         self.reject_reasons: Dict[int, str] = {}
+        # Sorted row ids the host oracle visited (set by the
+        # materializer; slices rebase it) — lets :meth:`slice` report the
+        # EXACT per-window oracle_rows a solo parse would have counted.
+        self.oracle_row_ids: Optional[np.ndarray] = None
         # Per-line index of the registered format that matched on device
         # (-1 = decided by the host oracle / no device match).  The columnar
         # analogue of the reference's "Switched to LogFormat" signal
@@ -713,6 +787,81 @@ class BatchResult:
         return batch_to_arrow(
             self, include_validity=include_validity, strings=strings
         )
+
+    # Column-dict entries that are NOT per-row arrays (shared metadata /
+    # vocab tables) and therefore must never be row-sliced.  Explicit
+    # allowlist: a geo vocab array's length could coincide with the batch
+    # size, so "slice every ndarray of length B" would silently corrupt.
+    _NON_ROW_KEYS = frozenset(
+        ("kind", "fix_mode", "mixed_fill", "typed_kind", "dict_values")
+    )
+
+    def slice(self, start: int, stop: int) -> "BatchResult":
+        """Row-window VIEW ``[start, stop)`` of this result, without
+        re-materializing anything: column arrays and the byte buffer are
+        numpy views, override dicts rebase to window-local row ids, and
+        wildcard CSR chunks re-pack to the window's segments.
+
+        Delivery parity contract (locked by tests/test_tpu_batch.py and
+        the service's cross-session suite): every delivery surface of the
+        slice — ``to_arrow``/``to_pylist``/``span_bytes``/validity/
+        ``oracle_rows``/``bad_lines`` — is byte-identical to parsing the
+        window's lines ALONE, because every per-line verdict (automaton
+        winner, oracle routing, overrides) is computed independently per
+        row.  This is what lets the serving tier's continuous batching
+        coalesce many sessions into one device batch and scatter each
+        session its exact solo answer (docs/SERVICE.md).
+
+        Two deliberate non-goals: device-emitted Arrow view rows are
+        DROPPED (slices deliver copy-mode Arrow — the coalesced wire
+        path never ships views; ``strings="view"`` still works through
+        the host gather), and the parent's batch-level rescue
+        composition stats (``rescue_reasons``/``rescue_wall_s``) stay on
+        the parent — they describe the shared batch, not any window."""
+        B = self.lines_read
+        start = max(0, min(int(start), B))
+        stop = max(start, min(int(stop), B))
+        n = stop - start
+        columns: Dict[str, Dict[str, Any]] = {}
+        for fid, col in self._columns.items():
+            columns[fid] = {
+                k: (v if k in self._NON_ROW_KEYS
+                    or not isinstance(v, np.ndarray) else v[start:stop])
+                for k, v in col.items()
+            }
+        overrides: Dict[str, Any] = {}
+        for fid, ov in self._overrides.items():
+            if isinstance(ov, _LazyWildcard):
+                overrides[fid] = ov.sliced(start, stop)
+            else:
+                overrides[fid] = {
+                    i - start: v for i, v in ov.items() if start <= i < stop
+                }
+        valid = self.valid[start:stop]
+        bad = int(np.count_nonzero(~np.asarray(valid, dtype=bool)))
+        out = BatchResult(
+            _SliceLines(self._lines, start, n),
+            self.buf[start:stop],
+            self.lengths[start:stop],
+            valid,
+            columns,
+            overrides,
+            n - bad,
+            bad,
+            format_index=self.format_index[start:stop],
+            assembly_pool=self.assembly_pool,
+        )
+        ids = self.oracle_row_ids
+        if ids is not None:
+            lo = int(np.searchsorted(ids, start, side="left"))
+            hi = int(np.searchsorted(ids, stop, side="left"))
+            out.oracle_row_ids = ids[lo:hi] - start
+            out.oracle_rows = hi - lo
+        out.reject_reasons = {
+            i - start: r for i, r in self.reject_reasons.items()
+            if start <= i < stop
+        }
+        return out
 
 
 def _bucket_batch(b: int, minimum: int = 64) -> int:
@@ -2310,6 +2459,7 @@ class TpuBatchParser:
         result.rescue_reasons = rescue_reasons
         result.rescue_wall_s = rescue_wall
         result.reject_reasons = reject_reasons
+        result.oracle_row_ids = np.asarray(oracle_rows_sorted, dtype=np.int64)
         return result
 
     def _materialize_csr(

@@ -419,7 +419,11 @@ def test_zero_timeouts_disable_not_nonblocking():
 
 def test_request_deadline_yields_deadline_frame_and_survives():
     """An expired request answers a structured DEADLINE frame; the
-    session survives and its next request succeeds."""
+    session survives and its next request succeeds.  With continuous
+    batching (round 14) an abandoned slow batch serializes the key's
+    lane, so a follow-up inside the wedge window may ALSO answer
+    DEADLINE (expired while queued — still structured, still
+    session-surviving); once the lane clears, the same socket serves."""
     before = metrics().get("service_deadline_expired_total")
     with ParseService(request_deadline_s=0.15) as svc:
         _install_stub(svc, first_delays=[0.6])
@@ -429,8 +433,15 @@ def test_request_deadline_yields_deadline_frame_and_survives():
             with pytest.raises(ServiceDeadlineError) as ei:
                 client.parse(["a", "b"])
             assert ei.value.deadline_s == pytest.approx(0.15)
-            assert client.parse(["a", "b"]).num_rows == 2
-    assert metrics().get("service_deadline_expired_total") == before + 1
+            end = time.monotonic() + 5.0
+            while True:
+                try:
+                    assert client.parse(["a", "b"]).num_rows == 2
+                    break
+                except ServiceDeadlineError:
+                    assert time.monotonic() < end, "lane never cleared"
+                    time.sleep(0.05)
+    assert metrics().get("service_deadline_expired_total") > before
 
 
 def test_idle_timeout_closes_cleanly():
@@ -646,3 +657,318 @@ def test_note_teardown_counts_and_warns_once():
     note_teardown(log, "service_teardown_errors_total", "unit_test", "boom")
     assert metrics().get("service_teardown_errors_total",
                          labels={"site": "unit_test"}) == before + 2
+
+
+# ---------------------------------------------------------------------------
+# round 14 — continuous batching (docs/SERVICE.md "Continuous batching"):
+# cross-session byte parity, deadline-expiry-while-queued, shed-while-
+# queued, drain-with-queued-entries.
+# ---------------------------------------------------------------------------
+
+
+def _raw_parity_session(host, port, config_payload, payloads, barrier,
+                        out, idx):
+    """One raw-socket session: per round, rendezvous on the barrier then
+    ship one LINES frame and capture the raw ARROW payload bytes."""
+    sock = socket.create_connection((host, port))
+    try:
+        _send_frame(sock, config_payload)
+        sock.settimeout(120)
+        got = []
+        for payload in payloads:
+            barrier.wait(timeout=60)
+            _send_frame(sock, payload)
+            kind, body = _recv_response(sock)
+            got.append((kind, body))
+        out[idx] = got
+        sock.sendall(struct.pack(">I", 0))
+    finally:
+        sock.close()
+
+
+def _lines_payload(lines):
+    blob = "\n".join(lines).encode()
+    return struct.pack(">I", len(lines)) + blob
+
+
+def _bench_wire_configs():
+    """The bench config table, restricted to wire-expressible entries
+    (extra_dissectors cannot ride a CONFIG frame)."""
+    import bench
+
+    return [(name, fmt, fields, lines_fn)
+            for name, fmt, fields, lines_fn, extra in bench.build_configs()
+            if not extra]
+
+
+def _inject_parser(svc, config):
+    """Share ONE compiled parser between the solo and coalescing
+    services (and across runs, via the session parser cache) — the suite
+    measures coalescing parity, not compile time."""
+    from logparser_tpu.service import _ParserCache
+
+    from _shared_parsers import shared_parser
+
+    parser = shared_parser(config["log_format"], config["fields"],
+                           view_fields=())
+    svc._server.parser_cache._parsers[_ParserCache.key_of(config)] = parser
+
+
+def test_cross_session_coalesce_parity_bench_configs():
+    """THE coalescing invariant (acceptance): for every wire-expressible
+    bench config, K concurrent sessions pushing interleaved mixed-size
+    requests through the coalescer receive Arrow bytes IDENTICAL to the
+    same requests parsed solo — and the drill must actually coalesce
+    (>1 session in at least one shared batch)."""
+    spb = metrics().histogram("service_coalesced_sessions_per_batch")
+    count0, sum0 = spb.count, spb.sum
+    sizes_by_session = [(1, 37, 8), (19, 3, 52), (7, 64, 2)]
+    for name, fmt, fields, lines_fn in _bench_wire_configs():
+        corpus = lines_fn(160)
+        config = {"log_format": fmt, "fields": list(fields),
+                  "timestamp_format": None}
+        config_payload = json.dumps(config).encode()
+        payload_sets = []
+        cursor = 0
+        for sizes in sizes_by_session:
+            payloads = []
+            for n in sizes:
+                payloads.append(_lines_payload(
+                    [corpus[(cursor + j) % len(corpus)] for j in range(n)]
+                ))
+                cursor += n
+            payload_sets.append(payloads)
+        # Solo reference: coalescing OFF, same injected parser.
+        with ParseService(coalesce=False) as solo:
+            _inject_parser(solo, config)
+            refs = []
+            for payloads in payload_sets:
+                out = {}
+                _raw_parity_session(solo.host, solo.port, config_payload,
+                                    payloads,
+                                    threading.Barrier(1), out, 0)
+                refs.append(out[0])
+        # Concurrent: coalescing ON, generous window so the sessions'
+        # rounds land in shared batches deterministically.
+        with ParseService(coalesce=True, coalesce_window_ms=50.0) as svc:
+            _inject_parser(svc, config)
+            barrier = threading.Barrier(len(payload_sets))
+            out = {}
+            threads = [
+                threading.Thread(
+                    target=_raw_parity_session,
+                    args=(svc.host, svc.port, config_payload, payloads,
+                          barrier, out, i),
+                )
+                for i, payloads in enumerate(payload_sets)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        for i, ref in enumerate(refs):
+            assert out.get(i) is not None, (name, i)
+            for r, (kind, body) in enumerate(out[i]):
+                assert kind == "arrow", (name, i, r)
+                assert body == ref[r][1], (
+                    f"{name}: session {i} round {r} coalesced bytes "
+                    "differ from solo parse"
+                )
+    assert metrics().histogram(
+        "service_coalesced_sessions_per_batch"
+    ).sum - sum0 > metrics().histogram(
+        "service_coalesced_sessions_per_batch"
+    ).count - count0, "no batch ever coalesced >1 session"
+
+
+def test_deadline_expiry_while_queued():
+    """An entry whose deadline expires while QUEUED behind a slow shared
+    batch answers a structured DEADLINE (counted as a queue expiry) and
+    never poisons the batch: the lane serves again once it clears."""
+    before = metrics().get("service_coalesce_expired_total")
+    with ParseService(request_deadline_s=0.2,
+                      coalesce_window_ms=0.0) as svc:
+        started = _stub_with_start_signal(svc, [1.0])
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1]
+        ) as slow, ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1]
+        ) as queued:
+            errs = {}
+
+            def drive(client, key):
+                try:
+                    client.parse(["a", "b"])
+                except Exception as e:  # noqa: BLE001
+                    errs[key] = e
+
+            t1 = threading.Thread(target=drive, args=(slow, "slow"))
+            t1.start()
+            assert started.wait(5)  # slow's batch is claimed, in flight
+            t2 = threading.Thread(target=drive, args=(queued, "queued"))
+            t2.start()
+            t1.join(10)
+            t2.join(10)
+            assert isinstance(errs.get("slow"), ServiceDeadlineError)
+            assert isinstance(errs.get("queued"), ServiceDeadlineError)
+            # The lane recovers: a later request on a surviving session
+            # succeeds once the abandoned batch clears.
+            end = time.monotonic() + 5.0
+            while True:
+                try:
+                    assert queued.parse(["c"]).num_rows == 1
+                    break
+                except ServiceDeadlineError:
+                    assert time.monotonic() < end, "lane never cleared"
+                    time.sleep(0.05)
+    assert metrics().get("service_coalesce_expired_total") >= before + 1
+
+
+def _stub_with_start_signal(svc, first_delays):
+    """Install the stub parser and return an Event set when a parse
+    BEGINS — the deterministic 'the batch is claimed and in flight'
+    rendezvous the queue-bound drills need (sleeps race under load)."""
+    started = threading.Event()
+    parser = _install_stub(svc, first_delays=list(first_delays))
+    orig = parser._sleep
+
+    def sleep_and_signal():
+        started.set()
+        orig()
+
+    parser._sleep = sleep_and_signal
+    return started
+
+
+def _wait_lane_queue(svc, depth, deadline_s=5.0):
+    """Poll until some coalescer lane's submission queue holds exactly
+    ``depth`` PENDING entries."""
+    co = svc._server.coalescer
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        with co._lock:
+            lanes = list(co._batchers.values())
+        if any(len(b.queue) == depth for b in lanes):
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"no lane ever held {depth} queued entries")
+
+
+def test_coalesce_queue_feeds_admission_backpressure():
+    """The coalescer's queue occupancy feeds queue_backpressure(): a
+    saturated submission queue makes the ADMISSION tier shed
+    BUSY{backpressure} before the queue itself has to (docs/SERVICE.md
+    — coalescing composes with admission, it does not bypass it)."""
+    with ParseService(coalesce_queue_depth=1,
+                      coalesce_window_ms=0.0) as svc:
+        started = _stub_with_start_signal(svc, [0.8])
+        clients = [
+            ParseServiceClient(svc.host, svc.port, "combined", FIELDS[:1])
+            for _ in range(3)
+        ]
+        try:
+            results = {}
+
+            def drive(i):
+                try:
+                    results[i] = clients[i].parse(["x"]).num_rows
+                except Exception as e:  # noqa: BLE001
+                    results[i] = e
+
+            t0 = threading.Thread(target=drive, args=(0,))
+            t0.start()
+            assert started.wait(5)  # claimed into the in-flight batch
+            t1 = threading.Thread(target=drive, args=(1,))
+            t1.start()
+            _wait_lane_queue(svc, 1)  # occupancy 1/1 >= the threshold
+            with pytest.raises(ServiceBusyError) as ei:
+                clients[2].parse(["y"])
+            assert ei.value.reason == "backpressure"
+            t0.join(10)
+            t1.join(10)
+            assert results[0] == 1 and results[1] == 1
+        finally:
+            for c in clients:
+                c.close()
+
+
+def test_shed_while_queued_coalesce_queue():
+    """At coalesce_queue_depth the submission queue itself sheds a
+    STRUCTURED BUSY{coalesce_queue} — coalescing must never reintroduce
+    the unbounded queue (docs/SERVICE.md).  The admission backpressure
+    leg (which normally fires first, test above) is disabled so the
+    drill reaches the queue's own bound."""
+    before = metrics().get("service_shed_total",
+                           labels={"reason": "coalesce_queue"})
+    with ParseService(coalesce_queue_depth=1,
+                      coalesce_window_ms=0.0,
+                      backpressure_threshold=2.0) as svc:
+        started = _stub_with_start_signal(svc, [0.8])
+        clients = [
+            ParseServiceClient(svc.host, svc.port, "combined", FIELDS[:1])
+            for _ in range(3)
+        ]
+        try:
+            results = {}
+
+            def drive(i):
+                try:
+                    results[i] = clients[i].parse(["x"]).num_rows
+                except Exception as e:  # noqa: BLE001
+                    results[i] = e
+
+            t0 = threading.Thread(target=drive, args=(0,))
+            t0.start()
+            assert started.wait(5)  # claimed into the in-flight batch
+            t1 = threading.Thread(target=drive, args=(1,))
+            t1.start()
+            _wait_lane_queue(svc, 1)  # the 1-entry queue is now full
+            with pytest.raises(ServiceBusyError) as ei:
+                clients[2].parse(["y"])
+            assert ei.value.reason == "coalesce_queue"
+            assert ei.value.structured
+            t0.join(10)
+            t1.join(10)
+            assert results[0] == 1 and results[1] == 1
+        finally:
+            for c in clients:
+                c.close()
+    assert metrics().get("service_shed_total",
+                         labels={"reason": "coalesce_queue"}) == before + 1
+
+
+def test_drain_completes_queued_coalesce_entries():
+    """A graceful drain finishes BOTH the in-flight shared batch and the
+    entries still queued behind it — queued work belongs to admitted
+    sessions, which the drain waits for."""
+    with ParseService(drain_deadline_s=15.0,
+                      coalesce_window_ms=0.0) as svc:
+        started = _stub_with_start_signal(svc, [0.5])
+        c1 = ParseServiceClient(svc.host, svc.port, "combined", FIELDS[:1])
+        c2 = ParseServiceClient(svc.host, svc.port, "combined", FIELDS[:1])
+        results = {}
+
+        def drive(i, client, n):
+            try:
+                results[i] = client.parse(["r"] * n).num_rows
+            except Exception as e:  # noqa: BLE001
+                results[i] = e
+
+        t1 = threading.Thread(target=drive, args=(1, c1, 2))
+        t1.start()
+        assert started.wait(5)   # claimed + parsing (0.5 s)
+        t2 = threading.Thread(target=drive, args=(2, c2, 3))
+        t2.start()
+        _wait_lane_queue(svc, 1)  # queued behind the in-flight batch
+        drainer = threading.Thread(
+            target=lambda: svc.shutdown(drain=True), daemon=True
+        )
+        drainer.start()
+        t1.join(10)
+        t2.join(10)
+        drainer.join(20)
+        assert not drainer.is_alive()
+        assert results.get(1) == 2, results.get(1)
+        assert results.get(2) == 3, results.get(2)
+        c1.close()
+        c2.close()

@@ -713,3 +713,77 @@ class TestParseBlob:
         # The overflow row re-parses from the FULL blob bytes on host.
         ua = res.to_pylist("HTTP.USERAGENT:request.user-agent")
         assert ua[1] is not None and ua[1].endswith("x" * 20 + '')
+
+
+@pytest.mark.slow  # own parser compile (wildcard field): slow tier
+class TestBatchSlice:
+    """BatchResult.slice (round 14): the sub-batch windowing contract the
+    serving tier's continuous batching stands on — every delivery surface
+    of a slice must be BYTE-identical to parsing the window's lines
+    alone, including oracle-rescued rows, wildcard CSR columns, and the
+    invalid-row ledger."""
+
+    FIELDS = [
+        "IP:connection.client.host",
+        "TIME.EPOCH:request.receive.time.epoch",
+        "STRING:request.status.last",
+        "BYTES:response.body.bytes",
+        "HTTP.USERAGENT:request.user-agent",
+        "STRING:request.firstline.uri.query.*",
+    ]
+
+    def _corpus(self):
+        import bench  # force_reject_lines: the host-rescued line class
+
+        lines = generate_combined_lines(160, seed=13)
+        lines = bench.force_reject_lines(lines, 10)  # ~10% oracle-rescued
+        lines[5] = "complete garbage"                # definitely-bad row
+        return lines
+
+    def _ipc(self, result):
+        from logparser_tpu.tpu.arrow_bridge import table_to_ipc_bytes
+
+        return table_to_ipc_bytes(
+            result.to_arrow(include_validity=True, strings="copy")
+        )
+
+    def test_slice_matches_solo_parse(self):
+        parser = shared_parser("combined", self.FIELDS)
+        lines = self._corpus()
+        combined = parser.parse_blob("\n".join(lines).encode(),
+                                     emit_views=False)
+        for a, b in ((0, 23), (23, 64), (64, 65), (65, 160), (0, 160)):
+            window = parser.parse_blob(
+                "\n".join(lines[a:b]).encode(), emit_views=False
+            )
+            sl = combined.slice(a, b)
+            assert self._ipc(sl) == self._ipc(window), (a, b)
+            assert sl.oracle_rows == window.oracle_rows, (a, b)
+            assert sl.bad_lines == window.bad_lines, (a, b)
+            assert sl.good_lines == window.good_lines, (a, b)
+            # Per-row ledgers rebase to window-local ids.
+            assert sl.reject_reasons == window.reject_reasons, (a, b)
+
+    def test_slice_pylist_and_raw_lines(self):
+        parser = shared_parser("combined", self.FIELDS)
+        lines = self._corpus()
+        combined = parser.parse_blob("\n".join(lines).encode(),
+                                     emit_views=False)
+        sl = combined.slice(40, 90)
+        solo = parser.parse_blob("\n".join(lines[40:90]).encode(),
+                                 emit_views=False)
+        for fid in self.FIELDS:
+            assert sl.to_pylist(fid) == solo.to_pylist(fid), fid
+        assert sl.raw_line(0) == lines[40].encode()
+        assert sl.raw_line(49) == lines[89].encode()
+        assert len(sl.lengths) == sl.lines_read == 50
+
+    def test_slice_bounds_clamp(self):
+        parser = shared_parser("combined", self.FIELDS)
+        res = parser.parse_blob(
+            "\n".join(generate_combined_lines(8, seed=2)).encode(),
+            emit_views=False,
+        )
+        assert res.slice(-5, 100).lines_read == 8
+        assert res.slice(6, 3).lines_read == 0
+        assert res.slice(8, 8).to_arrow().num_rows == 0
