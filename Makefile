@@ -1,7 +1,7 @@
 # Dev entry points (the reference's Maven/devtools tier, L0).
 PY ?= python
 
-.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke job-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -74,6 +74,17 @@ service-smoke:
 # service-smoke.
 coalesce-smoke:
 	$(PY) -m logparser_tpu.tools.coalesce_smoke
+
+# Fleet smoke: the replicated front tier's failover drill
+# (docs/SERVICE.md "Fleet") — a front over 3 real sidecar processes
+# must serve byte-identically to a solo sidecar, absorb a 1-of-3
+# SIGKILL under loadgen traffic with ZERO resets (structured
+# BUSY{sidecar_failover} frames only) and respawn the dead slot, and
+# complete a live rolling restart with zero failed requests — with the
+# merged fleet /metrics exposition valid.  CI runs this after
+# coalesce-smoke.
+fleet-smoke:
+	$(PY) -m logparser_tpu.tools.fleet_smoke
 
 # Job smoke: the durable batch tier's kill-drill (docs/JOBS.md) — run a
 # corpus->sharded-Arrow job, SIGKILL (-9) it mid-run from outside, and

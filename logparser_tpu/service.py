@@ -190,9 +190,10 @@ class ServiceClosedError(ParseServiceError):
 class ServiceBusyError(ParseServiceError):
     """Structured ``BUSY`` overload response (docs/PROTOCOL.md "Overload
     responses"): the request (reason ``inflight``/``backpressure``) or
-    the whole connection (reason ``sessions``/``draining``) was SHED.
-    ``retry_after_s`` is the server's backoff hint; ``structured`` is
-    False only for a BUSY-prefixed frame whose JSON failed to parse."""
+    the whole connection (reason ``sessions``/``draining``/
+    ``sidecar_failover``/``tenant_quota``) was SHED.  ``retry_after_s``
+    is the server's backoff hint; ``structured`` is False only for a
+    BUSY-prefixed frame whose JSON failed to parse."""
 
     def __init__(self, message: str, reason: str = "busy",
                  retry_after_s: float = 0.0, structured: bool = True):
@@ -200,6 +201,28 @@ class ServiceBusyError(ParseServiceError):
         self.reason = reason
         self.retry_after_s = retry_after_s
         self.structured = structured
+
+
+class ServiceUnavailableError(ParseServiceError):
+    """The client exhausted its ``max_redirect_retries`` budget on
+    connection-level sheds (``draining``/``sidecar_failover``/
+    ``sessions``): every reconnect landed on a server that refused the
+    whole connection again — the fleet (or the lone server) is
+    UNAVAILABLE and the caller should fail fast, not keep spinning
+    through reconnect/backoff cycles (docs/SERVICE.md "Client retry
+    contract")."""
+
+
+#: BUSY reasons that shed the whole CONNECTION (the server closes the
+#: socket by contract): the client must reconnect before retrying, and
+#: each one counts against ``max_redirect_retries``.  The single
+#: source of truth — the front tier and loadgen reuse it.
+#: ``tenant_quota`` is the SESSION-level tenant shed; the front's
+#: request-level tenant shed is the distinct reason ``tenant_inflight``
+#: (session survives, resend on the same connection) precisely so
+#: clients never have to guess which kind they got.
+RECONNECT_BUSY_REASONS = ("sessions", "draining", "sidecar_failover",
+                          "tenant_quota")
 
 
 class ServiceDeadlineError(ParseServiceError):
@@ -1548,8 +1571,18 @@ class ParseServiceClient:
       connect, with exponential backoff + full jitter.
     - ``busy_retries``: :meth:`parse` retries after a structured ``BUSY``
       response, honoring the server's retry-after hint as the backoff
-      floor.  Session-level sheds (reason ``sessions``/``draining``)
-      reconnect first — the server closed that connection by contract.
+      floor.  Session-level sheds (reason ``sessions``/``draining``/
+      ``sidecar_failover``) reconnect first — the server closed that
+      connection by contract; behind a front tier the reconnect is what
+      lands the session on a LIVE sidecar (docs/SERVICE.md "Fleet").
+    - ``max_redirect_retries``: per-:meth:`parse` bound on those
+      connection-level sheds specifically — a DYING fleet (every
+      reconnect shed again) fails fast with
+      :class:`ServiceUnavailableError` instead of burning the whole
+      (possibly large) ``busy_retries`` budget on reconnect loops.
+    - ``tenant``: optional tenant identity carried in the CONFIG frame
+      (the front tier's fairness quotas key on it; a plain sidecar
+      ignores it).
     - ``timeout``: socket timeout for connect/send/recv (None = block).
     """
 
@@ -1564,14 +1597,17 @@ class ParseServiceClient:
         feeder_workers: Optional[int] = None,
         connect_retries: int = 0,
         busy_retries: int = 0,
+        max_redirect_retries: int = 8,
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
         timeout: Optional[float] = None,
+        tenant: Optional[str] = None,
     ):
         self._addr = (host, port)
         self._stats = bool(stats)
         self._connect_retries = int(connect_retries)
         self._busy_retries = int(busy_retries)
+        self._max_redirect_retries = int(max_redirect_retries)
         self._backoff_base_s = float(backoff_base_s)
         self._backoff_max_s = float(backoff_max_s)
         self._timeout = timeout
@@ -1588,6 +1624,11 @@ class ParseServiceClient:
             # Optional sharded-feeder framing for big batches
             # (docs/FEEDER.md); a v1 server ignores unknown keys.
             config["feeder_workers"] = int(feeder_workers)
+        if tenant:
+            # Tenant identity for the front tier's fairness quotas
+            # (docs/SERVICE.md "Fleet"); a plain sidecar ignores it —
+            # it is not part of the parser cache key.
+            config["tenant"] = str(tenant)
         if stats:
             # Only stats sessions carry the key: a v1 server ignores it,
             # but omitting it keeps this client byte-exact v1 by default.
@@ -1653,6 +1694,7 @@ class ParseServiceClient:
                     "loglines cannot contain '\\n'; split them before parse()"
                 )
         payload = struct.pack(">I", len(encoded)) + b"\n".join(encoded)
+        redirects = 0
         for attempt in range(self._busy_retries + 1):
             try:
                 return self._roundtrip(payload)
@@ -1660,11 +1702,25 @@ class ParseServiceClient:
                 self.busy_seen += 1
                 if attempt >= self._busy_retries:
                     raise
-                self._backoff_sleep(attempt, floor_s=e.retry_after_s)
-                if e.reason in ("sessions", "draining"):
+                if e.reason in RECONNECT_BUSY_REASONS:
                     # Connection-level shed: the server closed this
-                    # socket by contract — reconnect before retrying.
+                    # socket by contract — reconnect (after honoring
+                    # the retry hint) before retrying.  A separate,
+                    # tighter budget bounds these: a fleet where EVERY
+                    # reconnect sheds again (rolling restart gone bad,
+                    # cascading sidecar failures) must fail fast, not
+                    # spin through busy_retries reconnect cycles.
+                    redirects += 1
+                    if redirects > self._max_redirect_retries:
+                        raise ServiceUnavailableError(
+                            f"{redirects} consecutive connection-level "
+                            f"sheds (last: {e.reason!r}) — service "
+                            "unavailable"
+                        ) from e
+                    self._backoff_sleep(attempt, floor_s=e.retry_after_s)
                     self._reconnect()
+                else:
+                    self._backoff_sleep(attempt, floor_s=e.retry_after_s)
         raise AssertionError("unreachable")  # pragma: no cover
 
     def _roundtrip(self, payload: bytes):
@@ -1717,11 +1773,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     ap = argparse.ArgumentParser(description=main.__doc__)
     ap.add_argument("--host", default="127.0.0.1")
-    ap.add_argument("--port", type=int, default=8123)
+    ap.add_argument("--port", type=int, default=None,
+                    help="listen port (default 8123; --sidecar defaults "
+                         "to 0 = ephemeral)")
     ap.add_argument(
-        "--metrics-port", type=int,
-        default=_env_int("LOGPARSER_TPU_METRICS_PORT"),
-        help="Prometheus /metrics HTTP port (0 = ephemeral; omit to disable)",
+        "--sidecar", action="store_true",
+        help="supervised-sidecar run mode (docs/SERVICE.md \"Fleet\"): "
+             "bind ephemeral service + metrics ports and print one "
+             "machine-readable SIDECAR_READY JSON line on stdout so a "
+             "front tier (logparser_tpu/front.py) can adopt, health-"
+             "probe, and route to this process",
+    )
+    ap.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="Prometheus /metrics HTTP port (0 = ephemeral; omit to "
+             "disable; env fallback LOGPARSER_TPU_METRICS_PORT — "
+             "ignored under --sidecar, where every fleet member must "
+             "bind its own ephemeral port)",
     )
     ap.add_argument(
         "--stats-interval", type=float,
@@ -1785,9 +1853,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         level=getattr(logging, str(args.log_level).upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    port = args.port if args.port is not None else (
+        0 if args.sidecar else 8123)
+    metrics_port = args.metrics_port
+    if args.sidecar:
+        # A sidecar without /readyz cannot be health-probed or drained
+        # by the front tier: the metrics endpoint is mandatory — and
+        # the env fallback is deliberately NOT consulted here (an
+        # exported LOGPARSER_TPU_METRICS_PORT is inherited by every
+        # spawned fleet member; a fixed port would EADDRINUSE all but
+        # the first).  An explicit --metrics-port flag still wins.
+        if metrics_port is None:
+            metrics_port = 0
+    elif metrics_port is None:
+        metrics_port = _env_int("LOGPARSER_TPU_METRICS_PORT")
     svc = ParseService(
-        args.host, args.port,
-        metrics_port=args.metrics_port,
+        args.host, port,
+        metrics_port=metrics_port,
         stats_interval=args.stats_interval,
         max_sessions=args.max_sessions,
         max_inflight=args.max_inflight,
@@ -1810,6 +1892,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     signal.signal(signal.SIGTERM, _on_sigterm)
     LOG.info("parse service listening on %s:%d", svc.host, svc.port)
+    if args.sidecar:
+        # The adoption handshake (docs/SERVICE.md "Fleet"): exactly one
+        # line, flushed, so the spawning front tier can read the bound
+        # ephemeral ports without racing the listen() — both sockets
+        # are already bound by construction above.
+        print("SIDECAR_READY " + json.dumps({
+            "port": svc.port,
+            "metrics_port": svc.metrics_port,
+            "pid": os.getpid(),
+        }, sort_keys=True), flush=True)
     try:
         svc.serve_forever()
     except KeyboardInterrupt:

@@ -47,6 +47,16 @@ replacing it):
   formation and the session answers a structured ``DEADLINE`` frame; an
   entry already claimed into an in-flight batch delivers normally and
   the late result is discarded by the session's deadline machinery;
+- an ABANDONED in-flight batch (every claimed entry's deadline expired
+  while the shared parse is still running — a wedged or pathologically
+  slow parse) RECYCLES the lane (round 15): the dispatcher epoch is
+  bumped, a fresh dispatcher takes over the submission queue, and the
+  stale dispatcher delivers its doomed batch in the background and
+  exits — one wedged parse no longer head-of-line-blocks every session
+  on that format key (``service_coalesce_lane_recycles_total``).  The
+  abandoned requests' worker threads still hold their in-flight slots
+  until the wedged parse truly stops, so the admission backpressure a
+  wedge is supposed to exert is preserved;
 - drain-safety: queued entries belong to admitted sessions, so a
   graceful drain's session wait inherently waits for the coalescer to
   finish them; :meth:`BatchCoalescer.shutdown` runs after the session
@@ -116,7 +126,7 @@ class _Entry:
     shutdown); CLAIMED entries always get ``result`` or ``error``."""
 
     __slots__ = ("blob", "count", "enq_t", "deadline_t", "event", "state",
-                 "result", "error")
+                 "result", "error", "abandoned")
 
     PENDING, CLAIMED, CANCELLED = range(3)
 
@@ -130,6 +140,11 @@ class _Entry:
         self.state = _Entry.PENDING
         self.result: Any = None
         self.error: Optional[BaseException] = None
+        # CLAIMED entry whose waiter's deadline expired mid-flight: the
+        # session already answered DEADLINE and will discard the late
+        # result.  When EVERY in-flight entry is abandoned the lane
+        # recycles (head-of-line-blocking fix, round 15).
+        self.abandoned = False
 
 
 class _FormedBatch:
@@ -181,6 +196,14 @@ class _KeyBatcher:
         self.thread: Optional[threading.Thread] = None
         self.stopped = False
         self.last_used = time.monotonic()
+        # Dispatcher ownership epoch: bumped by a lane recycle (every
+        # in-flight entry abandoned).  A dispatcher whose captured epoch
+        # is stale delivers its already-claimed batches and exits — it
+        # must never claim fresh queue entries again.
+        self.epoch = 0
+        # Entries CLAIMED but not yet resolved, in claim order (the
+        # recycle trigger reads it; guarded by ``lock``).
+        self.inflight: List[_Entry] = []
 
     # -- submit side (session threads) ---------------------------------
 
@@ -207,9 +230,12 @@ class _KeyBatcher:
     def wait(self, entry: _Entry, deadline_s: Optional[float]):
         """Block the session thread until the entry's result/error.  On
         deadline: cancel if still PENDING (the batch is not poisoned);
-        if already CLAIMED the batch is in flight — wait it out, the
-        session's own deadline machinery answers the client and discards
-        this late result."""
+        if already CLAIMED the batch is in flight — mark the entry
+        ABANDONED (recycling the lane once the whole in-flight batch
+        is), then wait it out: the session's own deadline machinery
+        answers the client and discards this late result, and this
+        worker thread keeps its in-flight slot until the parse truly
+        stops (wedge -> backpressure, docs/SERVICE.md)."""
         if not entry.event.wait(deadline_s):
             with self.lock:
                 if entry.state == _Entry.PENDING:
@@ -220,6 +246,7 @@ class _KeyBatcher:
                     metrics().increment("service_coalesce_expired_total")
                     metrics().gauge_add("service_coalesce_queue_depth", -1)
                     raise entry.error
+            self._note_abandoned(entry)
             entry.event.wait()
         if entry.error is not None:
             raise entry.error
@@ -230,24 +257,61 @@ class _KeyBatcher:
     def _ensure_thread_locked(self) -> None:
         if self.thread is None or not self.thread.is_alive():
             self.thread = threading.Thread(
-                target=self._run, name=f"svc-coalesce-{self.seq}",
-                daemon=True,
+                target=self._run, args=(self.epoch,),
+                name=f"svc-coalesce-{self.seq}", daemon=True,
             )
             self.thread.start()
 
-    def _run(self) -> None:
+    def _note_abandoned(self, entry: _Entry) -> None:
+        """A waiter's deadline expired on a CLAIMED entry.  When that
+        leaves the ENTIRE in-flight population abandoned, nobody is
+        waiting for the batch the dispatcher is stuck on — recycle the
+        lane: bump the epoch (the stale dispatcher delivers its doomed
+        batches and exits without ever touching the queue again) and
+        hand the submission queue to a fresh dispatcher, so one wedged
+        parse cannot stall every session on this format key."""
+        recycled = False
+        with self.lock:
+            entry.abandoned = True
+            if self.stopped or entry.event.is_set():
+                return
+            if not self.inflight or not all(
+                e.abandoned or e.event.is_set() for e in self.inflight
+            ):
+                return
+            self.epoch += 1
+            self.thread = None
+            if self.queue:
+                self._ensure_thread_locked()
+            self.cond.notify_all()
+            recycled = True
+        if recycled:
+            metrics().increment("service_coalesce_lane_recycles_total")
+            log_warning_once(
+                LOG,
+                "coalesce lane recycled around an abandoned in-flight "
+                "batch (every waiter's deadline expired; the wedged "
+                "parse finishes in the background)",
+            )
+
+    def _run(self, my_epoch: int) -> None:
         try:
             while True:
                 with self.lock:
+                    if self.epoch != my_epoch:
+                        return  # recycled: a fresh dispatcher owns the queue
                     while not self.queue and not self.stopped:
                         if not self.cond.wait(timeout=_IDLE_EXIT_S):
-                            if not self.queue and not self.stopped:
+                            if not self.queue and not self.stopped \
+                                    and self.epoch == my_epoch:
                                 # Idle exit: a later submit restarts one.
                                 self.thread = None
                                 return
+                        if self.epoch != my_epoch:
+                            return
                     if self.stopped and not self.queue:
                         return
-                self._burst()
+                self._burst(my_epoch)
         except Exception as e:  # noqa: BLE001 — a lane must fail loudly
             # A dispatcher crash outside _burst's per-batch handling:
             # fail every queued entry (waiters get the error frame, not
@@ -261,6 +325,12 @@ class _KeyBatcher:
             LOG.debug("coalesce dispatcher fault on key %r", self.key,
                       exc_info=True)
             with self.lock:
+                if self.epoch != my_epoch:
+                    # Recycled mid-crash: the queue belongs to the new
+                    # dispatcher — only this incarnation's own claimed
+                    # entries (already resolved by _burst's handlers)
+                    # were affected.
+                    return
                 drained = list(self.queue)
                 self.queue.clear()
                 self.thread = None
@@ -305,6 +375,7 @@ class _KeyBatcher:
                 break  # keep the batch inside the configured geometry
             self.queue.popleft()
             e.state = _Entry.CLAIMED
+            self.inflight.append(e)
             claimed.append(e)
             total += e.count
             reg.observe("service_coalesce_wait_seconds", now - e.enq_t,
@@ -312,7 +383,7 @@ class _KeyBatcher:
             reg.gauge_add("service_coalesce_queue_depth", -1)
         return total
 
-    def _form(self) -> Optional[_FormedBatch]:
+    def _form(self, my_epoch: int) -> Optional[_FormedBatch]:
         """Form the next batch from the queue: claim what is there, then
         wait up to the coalesce window for stragglers (only when more
         than one session is live — a lone client must not pay the
@@ -322,9 +393,12 @@ class _KeyBatcher:
         batch k computes costs nothing and roughly doubles occupancy
         (measured 2.2 -> 3.9 sessions/batch at 8 clients on the 2-core
         container, 1.37x -> 2.1x goodput over per-session dispatch).
-        None (empty queue after the wait) ends the burst."""
+        None (empty queue after the wait, or a stale dispatcher epoch —
+        the lane was recycled) ends the burst."""
         claimed: List[_Entry] = []
         with self.lock:
+            if self.epoch != my_epoch:
+                return None
             total = self._claim_locked(claimed, time.monotonic())
             if (
                 claimed and not self.stopped
@@ -338,14 +412,14 @@ class _KeyBatcher:
                     if remaining <= 0:
                         break
                     self.cond.wait(remaining)
-                    total = self._claim_locked(claimed, time.monotonic())
-                    if self.stopped:
+                    if self.stopped or self.epoch != my_epoch:
                         break
+                    total = self._claim_locked(claimed, time.monotonic())
         if not claimed:
             return None
         return _FormedBatch(claimed)
 
-    def _burst(self) -> None:
+    def _burst(self, my_epoch: int) -> None:
         """Drain the backlog as one stream of formed batches: ONE device
         parse per formed batch, back-to-back batches overlapping upload
         with compute via ``parse_batch_stream``'s staged-H2D edge.
@@ -354,21 +428,21 @@ class _KeyBatcher:
         parser = self.parser
         if not (hasattr(parser, "parse_batch_stream")
                 and hasattr(parser, "parse_encoded")):
-            fb = self._form()
+            fb = self._form(my_epoch)
             while fb is not None:
                 try:
                     self._scatter(fb, parser.parse_blob(
                         fb.blob(), emit_views=False))
                 except Exception as e:  # noqa: BLE001 — relayed per entry
                     self._fail(fb, e)
-                fb = self._form()
+                fb = self._form(my_epoch)
             return
 
         formed: "deque[_FormedBatch]" = deque()
 
         def gen():
             while True:
-                fb = self._form()
+                fb = self._form(my_epoch)
                 if fb is None:
                     return
                 formed.append(fb)
@@ -406,6 +480,14 @@ class _KeyBatcher:
             return
         entry.result = result
         entry.error = error
+        with self.lock:
+            # Off the recycle trigger's ledger BEFORE the event flips:
+            # a resolved entry must never count toward "the whole
+            # in-flight batch is abandoned".
+            try:
+                self.inflight.remove(entry)
+            except ValueError:
+                pass
         entry.event.set()
 
     def _fail(self, fb: _FormedBatch, error: BaseException) -> None:

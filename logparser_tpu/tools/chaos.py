@@ -52,6 +52,23 @@ they never reach feeder workers):
 (and stay uncommitted in the manifest) while the job completes its
 other shards: the "sticky-per-shard" drill.
 
+Front-tier fault primitives (round 15: armed by
+``FrontTier(chaos=...)`` or the env var; they drive the sidecar-fleet
+supervision in ``logparser_tpu/front.py`` and never reach feeder
+workers or the job writer):
+
+- ``kill_sidecar:index=N[:after=S]`` — hard-kill sidecar N right after
+  its S-th routed session (default 0 = the first) lands on it: the
+  crash-failover drill (in-flight sessions must get structured
+  ``BUSY{"reason":"sidecar_failover"}`` frames, never resets).
+- ``wedge_sidecar:index=N[:after=S][:seconds=X]`` — SIGSTOP sidecar N
+  after its S-th routed session (SIGCONT after X seconds; default
+  stays stopped): alive but silent, the shape the heartbeat deadline
+  must catch and kill.
+- ``flap_sidecar:index=N[:count=M]`` — kill sidecar N the moment it
+  (re)reports ready, M times (default 3): the crash loop the circuit
+  breaker must open around.
+
 ``worker=W`` restricts a worker fault to one worker id (default: all).
 ``sticky=1`` makes a fault survive respawns/retries (default only for
 ``poison_shard``); everything else fires ``count`` times (worker faults:
@@ -80,11 +97,16 @@ _KNOWN = {
     "kill_worker", "poison_shard", "corrupt_descriptor",
     "slot_overflow", "drop_done", "delay_put",
     "io_error", "enospc",
+    "kill_sidecar", "wedge_sidecar", "flap_sidecar",
 }
 
 #: Consumer-side fault kinds: armed by the durable-job writer, inert in
 #: feeder workers (WorkerChaos hooks filter by kind and never match).
 IO_FAULTS = {"io_error", "enospc"}
+
+#: Front-tier fault kinds: armed by logparser_tpu/front.py's fleet
+#: supervision, inert everywhere else.
+FRONT_FAULTS = {"kill_sidecar", "wedge_sidecar", "flap_sidecar"}
 
 
 class _ChaosHardExit(BaseException):
@@ -230,6 +252,64 @@ class WorkerChaos:
         for f in self.faults:
             if f.kind == "drop_done" and \
                     f.param("shard", shard_index) == shard_index:
+                return True
+        return False
+
+
+class FrontChaos:
+    """Front-tier fault injection (``logparser_tpu/front.py``): the
+    fleet consults :meth:`on_routed` after every routed session and
+    :meth:`on_ready` when a sidecar (re)reports ready.  Every hook is a
+    no-op when the spec carries no front faults."""
+
+    def __init__(self, spec: ChaosSpec):
+        self.faults = [f for f in spec.faults if f.kind in FRONT_FAULTS]
+        self.routed_to: Dict[int, int] = {}
+        self._fired: set = set()
+        self._flaps: Dict[int, int] = {}
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def on_routed(self, sidecar: int) -> Optional[str]:
+        """One session landed on ``sidecar``; returns the injected
+        action — ``"kill"`` / ``"wedge"`` — or None.  ``after=S`` fires
+        right after the sidecar's S-th routed session; each fault fires
+        once."""
+        n = self.routed_to[sidecar] = self.routed_to.get(sidecar, 0) + 1
+        for idx, f in enumerate(self.faults):
+            if idx in self._fired:
+                continue
+            if f.kind not in ("kill_sidecar", "wedge_sidecar"):
+                continue
+            if int(f.param("index", sidecar)) != sidecar:
+                continue
+            if n > int(f.param("after", 0)):
+                self._fired.add(idx)
+                return "kill" if f.kind == "kill_sidecar" else "wedge"
+        return None
+
+    def wedge_seconds(self, sidecar: int) -> Optional[float]:
+        """The SIGCONT delay of the wedge aimed at ``sidecar`` (None =
+        stay stopped until the supervisor kills it)."""
+        for f in self.faults:
+            if f.kind == "wedge_sidecar" and \
+                    int(f.param("index", sidecar)) == sidecar:
+                sec = f.param("seconds")
+                return float(sec) if sec is not None else None
+        return None
+
+    def on_ready(self, sidecar: int) -> bool:
+        """Whether a flap fault wants this freshly-ready sidecar killed
+        again (``count`` bounds the loop so drills terminate)."""
+        for idx, f in enumerate(self.faults):
+            if f.kind != "flap_sidecar":
+                continue
+            if int(f.param("index", sidecar)) != sidecar:
+                continue
+            n = self._flaps.get(idx, 0)
+            if n < int(f.param("count", 3)):
+                self._flaps[idx] = n + 1
                 return True
         return False
 

@@ -1,0 +1,293 @@
+"""CI fleet smoke: sidecar hard-kill failover + live rolling restart
+under loadgen traffic (docs/SERVICE.md "Fleet" acceptance drill).
+
+Boots a :class:`~logparser_tpu.front.FrontTier` over THREE real sidecar
+processes (``python -m logparser_tpu.service --sidecar``), warms the
+drill formats on every sidecar, then asserts:
+
+1. **Byte parity** — a session served THROUGH the front returns ARROW
+   payloads byte-identical to the same frames served by a solo sidecar
+   directly (the front is a pure relay; affinity routing must be
+   wire-invisible).
+2. **1-of-3 hard kill under load** — ``tools/loadgen.py`` (skewed
+   ``--tenants`` identities riding the CONFIG frames) drives the front
+   while the sidecar OWNING the hottest key is SIGKILLed mid-window:
+   zero TCP resets and zero unstructured sheds (in-flight sessions on
+   the dead sidecar get structured ``BUSY{"reason":"sidecar_failover"}``
+   frames; retrying clients land on live sidecars), goodput keeps
+   flowing, ``front_failovers_total`` moves, and the supervisor
+   respawns the dead slot.
+3. **Zero-downtime rolling restart** — a second loadgen window triggers
+   :meth:`FrontTier.roll` mid-run (the loadgen ``--roll`` hook): every
+   sidecar is drained + replaced one at a time while the rest absorb
+   its keys; the window must end with zero resets AND zero error
+   frames (busy sheds are allowed — they are the structured contract),
+   the roll must complete, and every slot's generation must advance.
+4. **Fleet exposition** — the front's merged ``/metrics`` is
+   structurally valid (`metrics_smoke.validate_exposition`), carries
+   the ``front_*`` families, and labels sidecar series with
+   ``sidecar="sc<i>"``.
+
+Usage::
+
+    make fleet-smoke
+    python -m logparser_tpu.tools.fleet_smoke
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+DRILL_FIELDS = ["IP:connection.client.host", "STRING:request.status.last"]
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _session_payloads(host: str, port: int, config: bytes,
+                      payloads: List[bytes]) -> List[Tuple[str, bytes]]:
+    sock = socket.create_connection((host, port))
+    try:
+        sock.settimeout(120)
+        _send_frame(sock, config)
+        got: List[Tuple[str, bytes]] = []
+        for payload in payloads:
+            _send_frame(sock, payload)
+            header = _recv_exact(sock, 4)
+            if header is None:
+                got.append(("reset", b""))
+                continue
+            (n,) = struct.unpack(">I", header)
+            if n == 0xFFFFFFFF:
+                (m,) = struct.unpack(">I", _recv_exact(sock, 4) or b"\0" * 4)
+                got.append(("error", _recv_exact(sock, m) or b""))
+            else:
+                got.append(("arrow", _recv_exact(sock, n) or b""))
+        sock.sendall(struct.pack(">I", 0))
+        return got
+    finally:
+        sock.close()
+
+
+def _scrape(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _family_total(text: str, family: str) -> float:
+    import re
+
+    pat = re.compile(
+        r"^" + re.escape(family) + r"(?:\{[^}]*\})? (\S+)$", re.M)
+    return sum(float(v) for v in pat.findall(text))
+
+
+def main() -> int:
+    # Fleet supervision smoke, not a perf run: never acquire a TPU, and
+    # make sure every spawned sidecar inherits the same platform.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from logparser_tpu.front import FrontPolicy, FrontTier, key_label
+    from logparser_tpu.service import ParseServiceClient, _ParserCache
+    from logparser_tpu.tools.loadgen import make_lines, run_loadgen
+    from logparser_tpu.tools.metrics_smoke import validate_exposition
+
+    problems: List[str] = []
+    t_all = time.monotonic()
+    policy = FrontPolicy(
+        heartbeat_interval_s=0.25,
+        # Generous on the shared CI box: a sidecar mid-parse can starve
+        # its HTTP thread for seconds without being wedged.
+        heartbeat_deadline_s=15.0,
+        backoff_base_s=0.1,
+        busy_retry_after_s=0.05,
+        drain_timeout_s=8.0,
+    )
+    lines = make_lines("combined", 64, seed=11)
+    common_lines = make_lines("common", 64, seed=11)
+
+    def warmup(handle) -> None:
+        # Both drill formats compile BEFORE a sidecar joins (or
+        # rejoins) the rotation: a cold XLA compile inside a drill
+        # window would measure the compiler, and any sidecar may absorb
+        # a key after the kill / during the roll.
+        with ParseServiceClient(handle.host, handle.port, "combined",
+                                DRILL_FIELDS, timeout=120.0) as warm:
+            warm.parse(lines)
+        with ParseServiceClient(
+            handle.host, handle.port, '%h %l %u %t "%r" %>s %b',
+            ["IP:connection.client.host", "BYTES:response.body.bytes"],
+            timeout=120.0,
+        ) as warm:
+            warm.parse(common_lines)
+
+    with FrontTier(
+        n_sidecars=3,
+        metrics_port=0,
+        policy=policy,
+        sidecar_args=["--drain-deadline", "5", "--max-sessions", "32"],
+        warmup_fn=warmup,
+    ) as front:
+        print(f"fleet-smoke: 3 sidecars up + warm "
+              f"({time.monotonic() - t_all:.0f}s)")
+
+        # 1) Byte parity: via the front vs a solo sidecar directly.
+        config = json.dumps({
+            "log_format": "combined", "fields": DRILL_FIELDS,
+            "timestamp_format": None,
+        }).encode()
+        payloads = [
+            struct.pack(">I", n) + "\n".join(lines[:n]).encode()
+            for n in (1, 17, 64)
+        ]
+        _sc_name, sc_host, sc_port, _mp = front.sidecars()[0]
+        solo = _session_payloads(sc_host, sc_port, config, payloads)
+        fronted = _session_payloads(front.host, front.port, config,
+                                    payloads)
+        for i, (ref, got) in enumerate(zip(solo, fronted)):
+            if got[0] != "arrow":
+                problems.append(f"parity round {i}: {got[0]} via front")
+            elif got[1] != ref[1]:
+                problems.append(
+                    f"parity round {i}: front bytes differ from solo "
+                    "sidecar"
+                )
+
+        metrics_url = f"http://{front.host}:{front.metrics_port}/metrics"
+        before = _scrape(metrics_url)
+
+        # 2) 1-of-3 hard kill mid-window, aimed at the sidecar OWNING
+        # the combined key (so live sessions are guaranteed on it).
+        key = _ParserCache.key_of(json.loads(config))
+        order = front.router.order(key_label(key), front._slots)
+        victim = order[0]
+        victim_pid = victim.handle.pid
+
+        def hard_kill() -> None:
+            print(f"fleet-smoke: SIGKILL sidecar {victim.name} "
+                  f"(pid {victim_pid})")
+            victim.handle.kill()
+
+        record = run_loadgen(
+            front.host, front.port, clients=6, duration_s=8.0,
+            batch_lines=64, burst=2, interval_s=0.05, tenants=3,
+            mid_run_fn=hard_kill, mid_run_at_s=3.0,
+        )
+        if record["resets"]:
+            problems.append(
+                f"{record['resets']} connection resets across the "
+                "1-of-3 kill drill (every failover must be a "
+                "structured BUSY frame)"
+            )
+        if record["busy_unstructured"]:
+            problems.append(
+                f"{record['busy_unstructured']} unparseable BUSY frames "
+                "during the kill drill"
+            )
+        if record["ok"] == 0:
+            problems.append("no request succeeded during the kill drill")
+        if not record.get("mid_run", {}).get("completed"):
+            problems.append("the kill trigger never fired")
+        after = _scrape(metrics_url)
+        failovers = (_family_total(after, "logparser_tpu_front_failovers_total")
+                     - _family_total(before,
+                                     "logparser_tpu_front_failovers_total"))
+        if failovers < 1:
+            problems.append(
+                "front_failovers_total never moved across a hard kill "
+                "with sessions in flight"
+            )
+        # The supervisor must respawn the dead slot (cold jax boot).
+        end = time.monotonic() + 90.0
+        while time.monotonic() < end:
+            if all(s.ready and s.handle is not None and s.handle.alive()
+                   for s in front._slots):
+                break
+            time.sleep(0.25)
+        else:
+            problems.append("the killed sidecar was never respawned")
+        if front.supervisor.total_restarts < 1:
+            problems.append("supervisor recorded no executed respawn")
+        print(f"fleet-smoke: kill drill done — ok={record['ok']} "
+              f"busy={record['busy']} ({record['busy_reasons']}) "
+              f"resets={record['resets']} failovers={failovers:.0f}")
+
+        # 3) Live rolling restart under load: zero failed requests.
+        gens = [s.generation for s in front._slots]
+        record2 = run_loadgen(
+            front.host, front.port, clients=4, duration_s=10.0,
+            batch_lines=64, burst=2, interval_s=0.05, tenants=3,
+            mid_run_fn=lambda: front.roll(drain_timeout_s=6.0),
+            mid_run_at_s=2.0,
+        )
+        if record2["resets"]:
+            problems.append(
+                f"{record2['resets']} resets during the rolling restart"
+            )
+        if record2["errors"]:
+            problems.append(
+                f"{record2['errors']} error frames during the rolling "
+                "restart (zero failed requests required)"
+            )
+        if record2["ok"] == 0:
+            problems.append("no request succeeded during the roll")
+        if not record2.get("mid_run", {}).get("completed"):
+            problems.append(
+                "the rolling restart never completed: "
+                f"{record2.get('mid_run')}"
+            )
+        rolled = [s.generation for s in front._slots]
+        if not all(b > a for a, b in zip(gens, rolled)):
+            problems.append(
+                f"roll did not advance every sidecar generation "
+                f"({gens} -> {rolled})"
+            )
+        print(f"fleet-smoke: roll done — ok={record2['ok']} "
+              f"busy={record2['busy']} ({record2['busy_reasons']}) "
+              f"errors={record2['errors']} resets={record2['resets']} "
+              f"generations {gens} -> {rolled}")
+
+        # 4) Merged fleet exposition.
+        text = _scrape(metrics_url)
+        problems.extend(validate_exposition(text))
+        for needle in (
+            "logparser_tpu_front_sessions_routed_total",
+            "logparser_tpu_front_failovers_total",
+            "logparser_tpu_front_restarts_total",
+            'sidecar="sc0"',
+        ):
+            if needle not in text:
+                problems.append(f"fleet exposition missing: {needle}")
+
+    if problems:
+        print(f"fleet-smoke: FAIL ({len(problems)} problems)")
+        for p in problems:
+            print(" -", p)
+        return 1
+    print(
+        "fleet-smoke: OK — front byte-identical to solo sidecar; "
+        "1-of-3 SIGKILL absorbed with structured failovers + respawn; "
+        "rolling restart under load with zero failed requests; merged "
+        f"fleet exposition valid ({time.monotonic() - t_all:.0f}s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..service import (
+    RECONNECT_BUSY_REASONS,
     ParseServiceClient,
     ParseServiceError,
     ServiceBusyError,
@@ -79,6 +80,7 @@ class _ClientStats:
     resets: int = 0
     connect_errors: int = 0
     lines_ok: int = 0
+    tenant: Optional[str] = None
     busy_reasons: Dict[str, int] = field(default_factory=dict)
     latencies: List[float] = field(default_factory=list)
 
@@ -228,7 +230,8 @@ def _drive(host: str, port: int, cfg: Tuple[str, str, List[str]],
         if client is None:
             try:
                 client = ParseServiceClient(
-                    host, port, log_format, fields, timeout=timeout_s
+                    host, port, log_format, fields, timeout=timeout_s,
+                    tenant=stats.tenant,
                 )
             except OSError:
                 stats.connect_errors += 1
@@ -248,10 +251,13 @@ def _drive(host: str, port: int, cfg: Tuple[str, str, List[str]],
                 stats.busy_reasons[e.reason] = (
                     stats.busy_reasons.get(e.reason, 0) + 1
                 )
-                if e.reason in ("sessions", "draining"):
-                    # Connection-level shed: the server closes this socket
-                    # by contract — reconnect (after the hint) to keep the
-                    # overload pressure standing.
+                if e.reason in RECONNECT_BUSY_REASONS:
+                    # Connection-level shed: the server closes this
+                    # socket by contract — reconnect (after the hint)
+                    # to keep the overload pressure standing.  A
+                    # failover reconnect is what lands the session on a
+                    # LIVE sidecar behind a front tier (docs/SERVICE.md
+                    # "Fleet").
                     _quiet_close(client)
                     client = None
                 time.sleep(max(e.retry_after_s, 0.01) * rng.uniform(0.5, 1.5))
@@ -283,13 +289,28 @@ def _drive(host: str, port: int, cfg: Tuple[str, str, List[str]],
     _quiet_close(client)
 
 
+def tenant_of(client_index: int, tenants: int) -> Optional[str]:
+    """Skewed tenant assignment for the fairness drills: tenant ``t0``
+    is the NOISY one (every even client), the rest share the odd
+    clients round-robin — so quota enforcement visibly protects the
+    quiet tenants from the loud one."""
+    if tenants <= 0:
+        return None
+    if tenants == 1 or client_index % 2 == 0:
+        return "t0"
+    return f"t{1 + (client_index // 2) % (tenants - 1)}"
+
+
 def run_loadgen(host: str, port: int, *, clients: int = 8,
                 duration_s: float = 3.0, batch_lines: int = 128,
                 burst: int = 4, interval_s: float = 0.05,
                 formats: Optional[Sequence[Tuple[str, str, List[str]]]] = None,
                 seed: int = 7, timeout_s: float = 30.0,
                 metrics_url: Optional[str] = None,
-                native: bool = False) -> Dict[str, Any]:
+                native: bool = False,
+                tenants: int = 0,
+                mid_run_fn: Optional[Any] = None,
+                mid_run_at_s: Optional[float] = None) -> Dict[str, Any]:
     """Drive the service at ``host:port`` and return the SLO record:
     outcome counts, ok-request p50/p99 (ms), and goodput
     (ok lines per wall second).
@@ -302,11 +323,21 @@ def run_loadgen(host: str, port: int, *, clients: int = 8,
     each client through the compiled C++ protocol client
     (native/svc_client.cc) instead of the Python one — closed-loop
     back-to-back requests, no burst pacing — falling back to the Python
-    driver when no toolchain is available."""
+    driver when no toolchain is available.
+
+    ``tenants`` > 0 assigns every client a tenant identity with SKEWED
+    load (:func:`tenant_of`; the CONFIG ``tenant`` key the front
+    tier's fairness quotas act on), and the record grows a per-tenant
+    outcome table.  ``mid_run_fn`` runs ONCE on a helper thread at
+    ``mid_run_at_s`` (default mid-window) — the rolling-restart-under-
+    load trigger ``make fleet-smoke`` uses — and the record notes
+    whether it completed inside the window."""
     fmts = list(formats or DEFAULT_FORMATS)
     corpora = {name: make_lines(name, batch_lines, seed=seed)
                for name, _lf, _f in fmts}
-    per_client = [_ClientStats() for _ in range(clients)]
+    per_client = [
+        _ClientStats(tenant=tenant_of(i, tenants)) for i in range(clients)
+    ]
     native_exe = None
     workdir = None
     if native:
@@ -320,6 +351,24 @@ def run_loadgen(host: str, port: int, *, clients: int = 8,
     before = scrape_metrics(metrics_url) if metrics_url else None
     t_start = time.monotonic()
     stop_at = t_start + duration_s
+    mid_run: Optional[Dict[str, Any]] = None
+    mid_timer: Optional[threading.Timer] = None
+    if mid_run_fn is not None:
+        at_s = (mid_run_at_s if mid_run_at_s is not None
+                else duration_s / 2.0)
+        mid_run = {"at_s": round(at_s, 3), "completed": False,
+                   "error": None}
+
+        def fire() -> None:
+            try:
+                mid_run_fn()
+                mid_run["completed"] = True
+            except Exception as e:  # noqa: BLE001 — recorded, not fatal
+                mid_run["error"] = f"{type(e).__name__}: {e}"
+
+        mid_timer = threading.Timer(at_s, fire)
+        mid_timer.daemon = True
+        mid_timer.start()
     threads = []
     for i in range(clients):
         cfg = fmts[i % len(fmts)]
@@ -345,6 +394,11 @@ def run_loadgen(host: str, port: int, *, clients: int = 8,
         # that request (bounded by the socket timeout) before exiting.
         t.join(timeout=duration_s + timeout_s + 10.0)
     wall_s = time.monotonic() - t_start
+    if mid_timer is not None:
+        # Generous: a blocking mid-run action (a full fleet roll with
+        # per-sidecar warmups) may legitimately outlive the window; the
+        # join only lasts as long as the action actually takes.
+        mid_timer.join(timeout=timeout_s + 600.0)
     total = _ClientStats()
     for s in per_client:
         total.merge(s)
@@ -353,6 +407,22 @@ def run_loadgen(host: str, port: int, *, clients: int = 8,
 
         shutil.rmtree(workdir, ignore_errors=True)
     extra: Dict[str, Any] = {}
+    if mid_run is not None:
+        extra["mid_run"] = mid_run
+    if tenants > 0:
+        by_tenant: Dict[str, Dict[str, int]] = {}
+        for s in per_client:
+            t = by_tenant.setdefault(s.tenant or "default", {
+                "clients": 0, "requests": 0, "ok": 0, "busy": 0,
+                "tenant_quota_sheds": 0,
+            })
+            t["clients"] += 1
+            t["requests"] += s.requests
+            t["ok"] += s.ok
+            t["busy"] += s.busy
+            t["tenant_quota_sheds"] += s.busy_reasons.get(
+                "tenant_quota", 0)
+        extra["tenants"] = {k: by_tenant[k] for k in sorted(by_tenant)}
     if before is not None:
         extra["coalesce"] = coalesce_report(
             before, scrape_metrics(metrics_url))
@@ -417,7 +487,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
              "(native/svc_client.cc); falls back to the Python client "
              "when no toolchain is available",
     )
+    ap.add_argument(
+        "--tenants", type=int, default=0,
+        help="assign clients skewed tenant identities (t0 = the noisy "
+             "tenant); the record grows a per-tenant outcome table — "
+             "the front tier's fairness-quota drill (docs/SERVICE.md "
+             "\"Fleet\")",
+    )
+    ap.add_argument(
+        "--roll", action="store_true",
+        help="mid-run rolling-restart trigger: POST /rollz on "
+             "--metrics-port (a front tier's fleet endpoint) at half "
+             "the window — the zero-downtime restart-under-load drill",
+    )
     args = ap.parse_args(argv)
+    mid_run_fn = None
+    if args.roll:
+        if not args.metrics_port:
+            ap.error("--roll needs --metrics-port (the front tier's "
+                     "fleet endpoint serving POST /rollz)")
+
+        def mid_run_fn() -> None:
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"http://{args.host}:{args.metrics_port}/rollz",
+                method="POST", data=b"",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+
     record = run_loadgen(
         args.host, args.port, clients=args.clients,
         duration_s=args.duration, batch_lines=args.batch_lines,
@@ -428,6 +527,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if args.metrics_port else None
         ),
         native=args.native,
+        tenants=args.tenants,
+        mid_run_fn=mid_run_fn,
     )
     print(json.dumps(record, indent=1, sort_keys=True))
     return 0
