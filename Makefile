@@ -1,7 +1,7 @@
 # Dev entry points (the reference's Maven/devtools tier, L0).
 PY ?= python
 
-.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke pod-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -94,6 +94,17 @@ fleet-smoke:
 # runs this after service-smoke.
 job-smoke:
 	$(PY) -m logparser_tpu.tools.job_smoke
+
+# Pod smoke: the pod-scale fabric's kill drill (docs/JOBS.md "Pod
+# jobs") — a 2-host pod (each host a real subprocess of the per-host
+# jobs CLI, parsing data-parallel over a forced multi-device mesh via
+# XLA_FLAGS) must survive a SIGKILL of one host mid-run: partial merge
+# legal, lost host resumed with committed shards never re-parsed, and
+# the final merged output byte-identical to a single-host run — with
+# the pod_* metric families live and zero leaked shm/tmp.  CI runs
+# this after job-smoke.
+pod-smoke:
+	$(PY) -m logparser_tpu.tools.pod_smoke
 
 lint:
 	$(PY) -m ruff check logparser_tpu tests

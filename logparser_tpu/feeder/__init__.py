@@ -55,8 +55,10 @@ from .shards import (  # noqa: F401
     Shard,
     healed_payload,
     healed_range,
+    host_shard_range,
     line_start_at_or_after,
     normalize_sources,
     plan_shards,
+    shards_for_host,
 )
 from .worker import EncodedBatch, split_batches  # noqa: F401

@@ -96,6 +96,43 @@ def plan_shards(
 
 
 # ---------------------------------------------------------------------------
+# pod-scale plan subsetting: disjoint per-host shard ranges
+# ---------------------------------------------------------------------------
+
+
+def host_shard_range(n_shards: int, n_hosts: int,
+                     host_index: int) -> Tuple[int, int]:
+    """The contiguous ``[start, end)`` slice of global shard indices that
+    host ``host_index`` of an ``n_hosts`` pod owns — balanced (sizes
+    differ by at most one), disjoint, and tiling ``range(n_shards)``
+    exactly.  Contiguous ranges (not strided) keep each host's reads
+    sequential within a source file and make a dead host's unfinished
+    work one run of consecutive uncommitted shards (docs/JOBS.md "Pod
+    jobs")."""
+    if n_hosts <= 0:
+        raise ValueError(f"n_hosts must be positive, got {n_hosts}")
+    if not 0 <= host_index < n_hosts:
+        raise ValueError(
+            f"host_index {host_index} outside [0, {n_hosts})"
+        )
+    base, rem = divmod(n_shards, n_hosts)
+    start = host_index * base + min(host_index, rem)
+    end = start + base + (1 if host_index < rem else 0)
+    return start, end
+
+
+def shards_for_host(plan: Sequence[Shard], n_hosts: int,
+                    host_index: int) -> List[Shard]:
+    """The subset of a global shard plan one pod host owns (see
+    :func:`host_shard_range`).  Shards keep their GLOBAL indices — the
+    job runner renumbers for the feeder pool and maps back at commit
+    time, so every host's manifest speaks the same global shard
+    vocabulary and the manifests merge without translation."""
+    start, end = host_shard_range(len(plan), n_hosts, host_index)
+    return [s for s in plan if start <= s.index < end]
+
+
+# ---------------------------------------------------------------------------
 # healing: raw range -> owned line range
 # ---------------------------------------------------------------------------
 

@@ -471,6 +471,89 @@ class LocalSidecar:
         self._svc.shutdown()
 
 
+def parse_sidecar_address(address: str) -> Tuple[str, int, int]:
+    """``host:port:metrics_port`` -> its parts.  The metrics port is
+    REQUIRED: the supervisor's health probes (readyz + occupancy
+    scrape) are the only liveness signal the front has for a process it
+    does not own."""
+    parts = str(address).rsplit(":", 2)
+    if len(parts) != 3:
+        raise ValueError(
+            f"sidecar address {address!r} must be host:port:metrics_port"
+        )
+    host, port_s, mport_s = parts
+    try:
+        port, mport = int(port_s), int(mport_s)
+    except ValueError:
+        raise ValueError(
+            f"sidecar address {address!r}: ports must be integers"
+        ) from None
+    if not host or not (0 < port < 65536 and 0 < mport < 65536):
+        raise ValueError(f"sidecar address {address!r} out of range")
+    return host, port, mport
+
+
+class AdoptedSidecar:
+    """A sidecar this front did NOT spawn: an already-running
+    ``--sidecar`` service at ``host:port:metrics_port`` — possibly on
+    another machine (ROADMAP 2c: the per-host-front seam the pod story
+    composes with).  Adoption sits behind the exact supervisor probes a
+    spawned child gets: readyz + /metrics scrape each heartbeat,
+    wedge/fault detection, and "respawn" = RE-ADOPT (the constructor
+    re-probes the address; while the remote is down the respawn fails
+    and the prober keeps re-deciding — when the remote operator brings
+    it back, the slot rejoins warm).
+
+    Process-control primitives are no-ops by design: the front does not
+    own the remote process, so ``kill``/``terminate``/``suspend`` do
+    nothing, ``alive()`` is always True (scrape silence, not waitpid,
+    is the death signal), and a roll of an adopted slot is just a
+    re-probe — rolling the actual process belongs to its own host's
+    operator."""
+
+    def __init__(self, index: int, address: str,
+                 connect_timeout_s: float = 3.0):
+        self.index = index
+        self.address_spec = str(address)
+        self.host, self.port, self.metrics_port = parse_sidecar_address(
+            address)
+        # Reachability probe — adopt-or-fail, mirroring spawn-or-fail:
+        # a clean connect + close (no CONFIG frame; the service reads a
+        # zero-length session, which its accept loop treats as EOF).
+        try:
+            probe = socket.create_connection(
+                (self.host, self.port), timeout=connect_timeout_s)
+            probe.close()
+        except OSError as e:
+            raise SidecarSpawnError(
+                f"sidecar {index}: cannot adopt {self.address_spec} "
+                f"({e})"
+            ) from e
+        metrics().increment("front_sidecar_adoptions_total")
+
+    @property
+    def pid(self) -> int:
+        return -1  # not ours; there is no local pid
+
+    def alive(self) -> bool:
+        return True
+
+    def kill(self) -> None:
+        pass
+
+    def terminate(self) -> None:
+        pass
+
+    def suspend(self, seconds: Optional[float] = None) -> None:
+        pass
+
+    def wait(self, timeout_s: float) -> bool:
+        return True
+
+    def close(self) -> None:
+        pass
+
+
 # ---------------------------------------------------------------------------
 # /metrics aggregation
 # ---------------------------------------------------------------------------
@@ -770,9 +853,21 @@ class FrontTier:
                  policy: Optional[FrontPolicy] = None,
                  spawner: Optional[Callable[[int], Any]] = None,
                  sidecar_args: Sequence[str] = (),
+                 sidecar_addresses: Sequence[str] = (),
                  warmup_fn: Optional[Callable[[Any], None]] = None,
                  chaos: Optional[Any] = None):
         self.policy = policy or FrontPolicy()
+        # Remote sidecar ADOPTION (ROADMAP 2c): ``host:port:metrics_port``
+        # addresses occupy the first len() slots (validated now, so a
+        # typo fails construction, not a boot thread); any remaining
+        # slots up to n_sidecars spawn local children as before.  The
+        # supervisor treats both identically — probes, faults, circuit
+        # breaking — except that "respawn" of an adopted slot re-probes
+        # the address instead of forking a process.
+        self._sidecar_addresses = [str(a) for a in sidecar_addresses]
+        for a in self._sidecar_addresses:
+            parse_sidecar_address(a)
+        n_sidecars = max(n_sidecars, len(self._sidecar_addresses))
         self.supervisor = FrontSupervisor(self.policy, n_sidecars)
         # The supervisor is a PURE machine; the fleet serializes every
         # consultation (session threads + the prober race otherwise —
@@ -868,7 +963,12 @@ class FrontTier:
     def _tenant_label(self, tenant: str) -> str:
         return self._bounded_label(self._tenant_label_set, tenant)
 
-    def _default_spawner(self, index: int) -> ProcessSidecar:
+    def _default_spawner(self, index: int) -> Any:
+        if index < len(self._sidecar_addresses):
+            return AdoptedSidecar(
+                index, self._sidecar_addresses[index],
+                connect_timeout_s=self.policy.connect_timeout_s,
+            )
         return ProcessSidecar(
             index, host=self._host, extra_args=self._sidecar_args,
             ready_timeout_s=self.policy.ready_timeout_s,
@@ -1577,6 +1677,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="fleet /metrics + /readyz + POST /rollz port")
     ap.add_argument("--sidecars", type=int, default=2)
+    ap.add_argument("--adopt", action="append", default=[],
+                    metavar="HOST:PORT:METRICS_PORT",
+                    help="adopt an already-running sidecar at this "
+                         "address instead of spawning one (repeatable; "
+                         "adopted addresses fill the first slots, "
+                         "--sidecars still spawns the rest)")
     ap.add_argument("--tenant-max-sessions", type=int, default=0)
     ap.add_argument("--tenant-max-inflight-lines", type=int, default=0)
     ap.add_argument("--spill-occupancy", type=float, default=0.5)
@@ -1601,6 +1707,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         n_sidecars=args.sidecars, host=args.host, port=args.port,
         metrics_port=args.metrics_port, policy=policy,
         sidecar_args=args.sidecar_args,
+        sidecar_addresses=args.adopt,
     )
     signal.signal(signal.SIGHUP,
                   lambda *_: threading.Thread(target=front.roll,

@@ -16,6 +16,10 @@ from .manifest import (  # noqa: F401
     JobManifest,
     ManifestError,
     ShardRecord,
+    committed_anywhere,
+    host_manifest_name,
+    list_host_manifests,
+    merge_manifests,
 )
 from .runner import (  # noqa: F401
     JobPolicy,
@@ -30,4 +34,5 @@ from .writer import (  # noqa: F401
     leaked_temp_files,
     merged_hash,
     reject_schema,
+    sweepable_temp_files,
 )

@@ -22,7 +22,7 @@ import argparse
 import json
 import sys
 
-from .manifest import ManifestError
+from .manifest import ManifestError, merge_manifests
 from .runner import (
     DEFAULT_JOB_BATCH_LINES,
     JobPolicy,
@@ -61,6 +61,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="refuse to continue an existing manifest "
                          "(default: resume it)")
     ap.add_argument("--io-retries", type=int, default=3)
+    ap.add_argument("--hosts", type=int, default=1,
+                    help="pod size: partition the shard plan over this "
+                         "many hosts (docs/JOBS.md 'Pod jobs')")
+    ap.add_argument("--host-index", type=int, default=0,
+                    help="which pod host THIS run is (0-based; commits "
+                         "into manifest.host-NNN.json)")
+    ap.add_argument("--merge", action="store_true",
+                    help="after this host's share completes, merge all "
+                         "per-host manifests into manifest.json "
+                         "(run standalone with --merge-only)")
+    ap.add_argument("--merge-only", action="store_true",
+                    help="only merge per-host manifests into "
+                         "manifest.json; parse nothing")
+    ap.add_argument("--data-parallel", type=int, default=None,
+                    help="lay the device parse over N local chips "
+                         "(jax.sharding mesh; default: single device)")
     ap.add_argument("--stop-after-shards", type=int, default=None,
                     help=argparse.SUPPRESS)  # crash-drill hook (smoke)
     return ap
@@ -78,12 +94,28 @@ def main(argv=None) -> int:
         workers=args.workers,
         use_processes=False if args.threads else None,
         transport=args.transport,
+        n_hosts=args.hosts,
+        host_index=args.host_index,
+        data_parallel=args.data_parallel,
     )
     policy = JobPolicy(io_retries=args.io_retries,
                        stop_after_shards=args.stop_after_shards)
     try:
+        if args.merge_only:
+            merged = merge_manifests(args.out_dir)
+            print(json.dumps({
+                "out_dir": args.out_dir,
+                "merged_shards": len(merged.shards),
+            }))
+            return 0
         report = run_job(spec, resume=not args.no_resume, policy=policy)
-    except ManifestError as e:
+        if args.merge and report.complete:
+            merged = merge_manifests(args.out_dir)
+            d = report.as_dict()
+            d["merged_shards"] = len(merged.shards)
+            print(json.dumps(d))
+            return 0  # complete implies no failed shards
+    except (ManifestError, ValueError) as e:
         print(json.dumps({"error": str(e)}), file=sys.stderr)
         return 2
     print(json.dumps(report.as_dict()))

@@ -25,18 +25,58 @@ against a manifest whose fingerprint disagrees is REFUSED — silently
 mixing two configurations' shards would corrupt the corpus without any
 crash at all.
 
-Everything here is stdlib-only (json/os/hashlib) and jax-free.
+Pod jobs (docs/JOBS.md "Pod jobs") stack one level on top: each host of
+an N-host pod commits its own shard subset into a PER-HOST manifest
+(``manifest.host-NNN.json`` — same schema, same fingerprint block, same
+atomic rewrite), and :func:`merge_manifests` folds every host's commit
+log into the single top-level ``manifest.json`` — after which the pod
+directory is indistinguishable from a single-host job's: ``merged_hash``
+reads it, resume skips its shards, and a dead host's unfinished range is
+just a run of uncommitted shards.  The merge REFUSES fingerprint
+divergence across hosts (two configurations' shards must never mix) and
+refuses conflicting duplicate commits (two hosts claiming one shard with
+different content hashes); identical duplicates — a shard re-run by a
+rebalanced host assignment — deduplicate cleanly because parse and
+framing are deterministic.
+
+Everything here is stdlib-only (json/os/hashlib/re) and jax-free.
 """
 from __future__ import annotations
 
 import json
 import os
+import re
 import time
 from dataclasses import asdict, dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 MANIFEST_NAME = "manifest.json"
 MANIFEST_VERSION = 1
+HOST_MANIFEST_FMT = "manifest.host-{index:03d}.json"
+# 3+ digits: host_manifest_name's {index:03d} WIDENS past 999, and a
+# pod of 1000+ hosts must not have its tail's commit logs silently
+# invisible to merge/resume.
+_HOST_MANIFEST_RE = re.compile(r"^manifest\.host-(\d{3,})\.json$")
+
+
+def host_manifest_name(host_index: int) -> str:
+    """The per-host commit-log filename of pod host ``host_index``."""
+    return HOST_MANIFEST_FMT.format(index=int(host_index))
+
+
+def list_host_manifests(out_dir: str) -> List[Tuple[int, str]]:
+    """``(host_index, filename)`` for every per-host manifest present in
+    ``out_dir``, sorted by host index."""
+    try:
+        names = os.listdir(out_dir)
+    except FileNotFoundError:
+        return []
+    out = []
+    for n in names:
+        m = _HOST_MANIFEST_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), n))
+    return sorted(out)
 
 
 class ManifestError(RuntimeError):
@@ -68,6 +108,23 @@ class ShardRecord:
         return cls(**{k: d.get(k) for k in cls.__dataclass_fields__})
 
 
+def host_token() -> str:
+    """This machine's identity as embedded in temp-file names
+    (sanitized to the temp-name alphabet so parsing stays
+    unambiguous)."""
+    return re.sub(r"[^A-Za-z0-9_-]", "_", os.uname().nodename) or "host"
+
+
+def temp_suffix() -> str:
+    """The durable-write temp-file suffix: ``.<host>.<pid>.tmp`` —
+    enough identity that a (re)starting pod host can tell in-flight
+    writes from crash debris without any coordination: a LOCAL pid is
+    checkable with ``os.kill(pid, 0)``, a FOREIGN host's temp is only
+    debris once it has sat untouched for a long stale window
+    (``jobs.writer.sweepable_temp_files``)."""
+    return f".{host_token()}.{os.getpid()}.tmp"
+
+
 def fsync_dir(path: str) -> None:
     """fsync a DIRECTORY so a just-renamed entry survives a power cut
     (rename is atomic but not durable until the directory metadata is
@@ -88,8 +145,11 @@ def fsync_dir(path: str) -> None:
 def atomic_write_bytes(path: str, data: bytes) -> None:
     """tmp -> flush -> fsync -> rename -> dir fsync.  The reader either
     sees the whole previous version or the whole new one, never a
-    torn write."""
-    tmp = path + ".tmp"
+    torn write.  The temp name embeds the writer's host + pid so a
+    concurrently (re)starting pod host's debris sweep (dead LOCAL pids
+    only; foreign-host temps only after a long stale window) can never
+    unlink an in-flight write — local or remote."""
+    tmp = path + temp_suffix()
     try:
         with open(tmp, "wb") as f:
             f.write(data)
@@ -121,13 +181,15 @@ class JobManifest:
         return cls(job=dict(fingerprint), created_at=time.time())
 
     @classmethod
-    def load(cls, out_dir: str) -> Optional["JobManifest"]:
-        """The manifest of ``out_dir``, or None when none exists.
-        Raises :class:`ManifestError` on a corrupt/foreign file — a
-        half-written manifest cannot exist under the atomic-write
-        protocol, so corruption means outside interference and must not
-        be silently treated as 'no job here'."""
-        path = os.path.join(out_dir, MANIFEST_NAME)
+    def load(cls, out_dir: str,
+             name: str = MANIFEST_NAME) -> Optional["JobManifest"]:
+        """The manifest of ``out_dir`` (by default the top-level one;
+        ``name`` selects a per-host commit log), or None when none
+        exists.  Raises :class:`ManifestError` on a corrupt/foreign
+        file — a half-written manifest cannot exist under the
+        atomic-write protocol, so corruption means outside interference
+        and must not be silently treated as 'no job here'."""
+        path = os.path.join(out_dir, name)
         if not os.path.exists(path):
             return None
         try:
@@ -165,30 +227,33 @@ class JobManifest:
         }
         return json.dumps(payload, indent=1, sort_keys=True).encode("utf-8")
 
-    def save(self, out_dir: str) -> None:
+    def save(self, out_dir: str, name: str = MANIFEST_NAME) -> None:
         atomic_write_bytes(
-            os.path.join(out_dir, MANIFEST_NAME), self.serialize()
+            os.path.join(out_dir, name), self.serialize()
         )
 
     # -- commit log -----------------------------------------------------
 
     def commit(self, out_dir: str, record: ShardRecord,
-               write_bytes=None) -> None:
+               write_bytes=None, name: str = MANIFEST_NAME) -> None:
         """Record one shard as durably written — THE single commit
         path.  The caller has already renamed the shard's files into
         place; once the manifest rewrite lands, resume skips the shard
         forever.  ``write_bytes(name, data)`` overrides the write (the
         job runner routes it through its retrying
-        :class:`~logparser_tpu.jobs.writer.JobWriter`); on ANY write
-        failure the record is rolled back out of the in-memory map so
-        the manifest object still mirrors the disk truth."""
+        :class:`~logparser_tpu.jobs.writer.JobWriter`); ``name`` selects
+        the on-disk commit log (a pod host commits into ITS host
+        manifest, never the shared top-level one — the merge step owns
+        that).  On ANY write failure the record is rolled back out of
+        the in-memory map so the manifest object still mirrors the disk
+        truth."""
         record.committed_at = time.time()
         self.shards[record.shard] = record
         try:
             if write_bytes is not None:
-                write_bytes(MANIFEST_NAME, self.serialize())
+                write_bytes(name, self.serialize())
             else:
-                self.save(out_dir)
+                self.save(out_dir, name)
         except BaseException:
             del self.shards[record.shard]
             raise
@@ -207,3 +272,133 @@ class JobManifest:
             if a != b:
                 return f"{key}: manifest has {a!r}, job has {b!r}"
         return None
+
+
+# ---------------------------------------------------------------------------
+# pod-level manifest MERGE
+# ---------------------------------------------------------------------------
+
+
+def _records_equal(a: ShardRecord, b: ShardRecord) -> bool:
+    """Output identity of two commit records: everything except the
+    commit wall-clock (deterministic replay of one shard by two hosts
+    produces identical records apart from ``committed_at``)."""
+    da, db = asdict(a), asdict(b)
+    da.pop("committed_at", None)
+    db.pop("committed_at", None)
+    return da == db
+
+
+def _fold_shards(out_dir: str,
+                 sources: List[Tuple[str, JobManifest]]
+                 ) -> Dict[int, ShardRecord]:
+    """THE one duplicate-commit policy, shared by merge and resume: fold
+    every source's shard records into one map — identical duplicate
+    records dedupe (deterministic replay under a changed host
+    assignment), a conflicting pair is a :class:`ManifestError` (the
+    on-disk shard files can match at most one of them)."""
+    out: Dict[int, ShardRecord] = {}
+    owner: Dict[int, str] = {}
+    for name, m in sources:
+        for idx, rec in m.shards.items():
+            prev = out.get(idx)
+            if prev is None:
+                out[idx] = rec
+                owner[idx] = name
+            elif not _records_equal(prev, rec):
+                raise ManifestError(
+                    f"refusing {out_dir}: shard {idx} committed by "
+                    f"both {owner[idx]} and {name} with DIVERGING "
+                    "records — the on-disk shard files can match at "
+                    "most one of them"
+                )
+    return out
+
+
+def merge_manifests(out_dir: str, write_bytes=None) -> JobManifest:
+    """Fold every per-host commit log (plus any existing top-level
+    manifest) of a pod job directory into ONE merged ``manifest.json``
+    — the step that makes a pod job resume exactly like a single-host
+    one (docs/JOBS.md "Pod jobs").
+
+    Safety rules (each a :class:`ManifestError`):
+
+    - every manifest's ``job`` fingerprint block must be identical —
+      shards of two configurations must never mix (the cross-host twin
+      of the single-host resume refusal);
+    - a shard committed by MORE than one manifest must carry identical
+      records (content hashes included).  Identical duplicates dedupe
+      (deterministic replay under a changed host assignment); a
+      conflicting pair is refused loudly — one of the two output files
+      was overwritten and the survivor can only match one record.
+
+    Partial merges are the NORMAL case mid-pod (a dead host's range is
+    simply absent) and the merge is idempotent: re-running it over the
+    same directory, with or without new host commits, converges.  The
+    merged manifest is written atomically via ``write_bytes(name,
+    data)`` when given (the pod runner routes it through a retrying
+    writer), else :func:`atomic_write_bytes`.  Host manifests are left
+    in place — they are each host's durable truth and re-merging is
+    free."""
+    sources: List[Tuple[str, JobManifest]] = []
+    top = JobManifest.load(out_dir)
+    if top is not None:
+        sources.append((MANIFEST_NAME, top))
+    for _, name in list_host_manifests(out_dir):
+        m = JobManifest.load(out_dir, name)
+        if m is not None:
+            sources.append((name, m))
+    if not sources:
+        raise ManifestError(f"{out_dir}: no manifest to merge")
+    ref_name, ref = sources[0]
+    for name, m in sources[1:]:
+        diff = ref.mismatch(m.job)
+        if diff:
+            raise ManifestError(
+                f"refusing to merge {out_dir}: {name} belongs to a "
+                f"different job than {ref_name} ({diff})"
+            )
+    merged = JobManifest(
+        job=dict(ref.job),
+        created_at=min(m.created_at for _, m in sources
+                       if m.created_at) if any(
+            m.created_at for _, m in sources) else ref.created_at,
+        shards=_fold_shards(out_dir, sources),
+    )
+    data = merged.serialize()
+    if write_bytes is not None:
+        write_bytes(MANIFEST_NAME, data)
+    else:
+        atomic_write_bytes(os.path.join(out_dir, MANIFEST_NAME), data)
+    return merged
+
+
+def committed_anywhere(out_dir: str,
+                       fingerprint: Optional[Dict[str, Any]] = None,
+                       preloaded: Optional[Dict[str, JobManifest]] = None
+                       ) -> Dict[int, ShardRecord]:
+    """The union of committed shard records across the top-level
+    manifest AND every per-host manifest — what a (re)starting host must
+    skip, whether or not a merge has run yet.  With ``fingerprint``,
+    every manifest found is checked against it first (a foreign commit
+    log in the directory is refused, mirroring the resume refusal).
+    ``preloaded`` (name -> manifest) supplies commit logs the caller
+    already holds, which are folded without a redundant disk read —
+    resume of a many-thousand-shard job must not parse its own O(shards)
+    JSON twice."""
+    preloaded = preloaded or {}
+    sources: List[Tuple[str, JobManifest]] = []
+    names = [MANIFEST_NAME] + [n for _, n in list_host_manifests(out_dir)]
+    for name in names:
+        m = preloaded.get(name) or JobManifest.load(out_dir, name)
+        if m is None:
+            continue
+        if fingerprint is not None:
+            diff = m.mismatch(fingerprint)
+            if diff:
+                raise ManifestError(
+                    f"refusing to resume {out_dir}: {name} belongs to "
+                    f"a different job ({diff})"
+                )
+        sources.append((name, m))
+    return _fold_shards(out_dir, sources)

@@ -47,10 +47,17 @@ from ..feeder.shards import (
     SourceT,
     normalize_sources,
     plan_shards,
+    shards_for_host,
 )
 from ..observability import log_warning_once, metrics
-from .manifest import JobManifest, ManifestError
-from .writer import JobWriter, ShardWriteError, leaked_temp_files
+from .manifest import (
+    MANIFEST_NAME,
+    JobManifest,
+    ManifestError,
+    committed_anywhere,
+    host_manifest_name,
+)
+from .writer import JobWriter, ShardWriteError, sweepable_temp_files
 
 LOG = logging.getLogger(__name__)
 
@@ -73,6 +80,18 @@ class JobSpec:
     workers: Optional[int] = None
     use_processes: Optional[bool] = None
     transport: Optional[str] = None
+    # Pod placement (docs/JOBS.md "Pod jobs"): this run owns host
+    # ``host_index``'s contiguous slice of the GLOBAL shard plan and
+    # commits into its per-host manifest.  Execution-only — the shard
+    # plan, and therefore the merged output bytes, are identical for
+    # every n_hosts, which is exactly what makes an N-host pod's merged
+    # output byte-comparable to a single-host run's.
+    n_hosts: int = 1
+    host_index: int = 0
+    # Device-side data parallelism: lay the parse step over this many
+    # local devices (``TpuBatchParser(data_parallel=...)``); None = the
+    # parser default (single device).
+    data_parallel: Optional[int] = None
 
     def fingerprint(self, sources_norm) -> Dict[str, Any]:
         """The manifest's job block: resume refuses when any of this
@@ -139,6 +158,8 @@ class JobReport:
     payload_bytes: int = 0
     wall_s: float = 0.0
     stopped_early: bool = False  # JobPolicy.stop_after_shards tripped
+    n_hosts: int = 1             # pod placement (1 = single-host job)
+    host_index: int = 0
 
     @property
     def complete(self) -> bool:
@@ -165,6 +186,8 @@ class JobReport:
             "bytes_per_sec": round(self.bytes_per_sec, 1),
             "complete": self.complete,
             "stopped_early": self.stopped_early,
+            **({"n_hosts": self.n_hosts, "host_index": self.host_index}
+               if self.n_hosts > 1 else {}),
         }
 
 
@@ -226,12 +249,20 @@ def run_job(
     policy = policy or JobPolicy()
     t_start = time.perf_counter()
     reg = metrics()
+    if spec.n_hosts < 1 or not 0 <= spec.host_index < spec.n_hosts:
+        raise ValueError(
+            f"bad pod placement: host {spec.host_index} of "
+            f"{spec.n_hosts}"
+        )
+    pod = spec.n_hosts > 1
+    own_name = (host_manifest_name(spec.host_index) if pod
+                else MANIFEST_NAME)
     sources_norm = normalize_sources(spec.sources)
     plan = plan_shards(sources_norm, spec.shard_bytes)
     out_dir = spec.out_dir
     os.makedirs(out_dir, exist_ok=True)
     fingerprint = spec.fingerprint(sources_norm)
-    manifest = JobManifest.load(out_dir)
+    manifest = JobManifest.load(out_dir, own_name)
     if manifest is not None:
         if not resume:
             raise ManifestError(
@@ -246,22 +277,33 @@ def run_job(
             )
     else:
         manifest = JobManifest.fresh(fingerprint)
-        manifest.save(out_dir)
+        manifest.save(out_dir, own_name)
     # Crash debris: tmp files can only be leftovers of an interrupted,
     # uncommitted write — safe to sweep (committed files were renamed).
-    for name in leaked_temp_files(out_dir):
+    # Pod-safe: only debris whose writer pid is dead is swept — another
+    # live host's mid-write temp file must not be yanked out from under
+    # its fsync (sweepable_temp_files applies the dead-pid rule).
+    for name in sweepable_temp_files(out_dir):
         try:
             os.unlink(os.path.join(out_dir, name))
             reg.increment("job_temp_files_swept_total")
         except OSError as e:
             log_warning_once(LOG, f"job: could not sweep {name}: {e}")
 
-    committed_before = set(manifest.shards)
-    remaining = [s for s in plan if s.index not in committed_before]
-    report = JobReport(out_dir=out_dir, shards_total=len(plan),
-                       skipped=len(committed_before))
-    if committed_before:
-        reg.increment("job_shards_skipped_total", len(committed_before))
+    # What to skip: every shard durably committed by ANYONE — this
+    # host's earlier runs, the merged top-level manifest, and (pod) the
+    # other hosts' manifests.  A fingerprint divergence in any commit
+    # log refuses the run, exactly like the single-manifest resume.
+    committed_before = set(committed_anywhere(
+        out_dir, fingerprint, preloaded={own_name: manifest}))
+    owned = (shards_for_host(plan, spec.n_hosts, spec.host_index)
+             if pod else plan)
+    remaining = [s for s in owned if s.index not in committed_before]
+    report = JobReport(out_dir=out_dir, shards_total=len(owned),
+                       skipped=len(owned) - len(remaining),
+                       n_hosts=spec.n_hosts, host_index=spec.host_index)
+    if report.skipped:
+        reg.increment("job_shards_skipped_total", report.skipped)
     pool_chaos, writer_chaos = _split_chaos(chaos)
     writer = JobWriter(out_dir, retries=policy.io_retries,
                        backoff_base_s=policy.io_backoff_s,
@@ -277,8 +319,11 @@ def run_job(
 
         # Jobs deliver copy-mode IPC tables, never string_view columns:
         # device view emission would be pure kernel + D2H waste here.
+        # data_parallel lays the fused parse over this host's local
+        # chips (jax.sharding mesh; docs/JOBS.md "Pod jobs").
         parser = TpuBatchParser(
             spec.log_format, list(spec.fields), view_fields=(),
+            data_parallel=spec.data_parallel,
         )
 
     # The pool runs over a RENUMBERED plan (FeederPool requires index ==
@@ -338,6 +383,7 @@ def run_job(
                 write_bytes=lambda name, data: writer.write_file(
                     name, data, shard.index
                 ),
+                name=own_name,
             )
         except ShardWriteError as e:
             fail(e)

@@ -26,11 +26,18 @@ from __future__ import annotations
 import hashlib
 import logging
 import os
+import re
 import time
 from typing import Any, List, Optional, Tuple
 
 from ..observability import log_warning_once, metrics, observe_stage
-from .manifest import JobManifest, ShardRecord, fsync_dir
+from .manifest import (
+    JobManifest,
+    ShardRecord,
+    fsync_dir,
+    host_token,
+    temp_suffix,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -102,7 +109,7 @@ class JobWriter:
         """One write->fsync->rename pass with chaos injection at each
         op.  Any OSError propagates to the retry ladder."""
         chaos = self.chaos
-        tmp = f"{path}.{os.getpid()}.tmp"
+        tmp = path + temp_suffix()
         try:
             if chaos:
                 chaos.check("write", shard)
@@ -233,3 +240,53 @@ def leaked_temp_files(out_dir: str) -> List[str]:
         )
     except FileNotFoundError:
         return []
+
+
+_TMP_RE = re.compile(r"\.(?:([A-Za-z0-9_-]+)\.)?(\d+)\.tmp$")
+
+#: A FOREIGN host's temp file (pod over a shared filesystem: its pid is
+#: meaningless here) is only swept once it has sat untouched this long —
+#: in-flight writes live milliseconds to seconds, so anything this old
+#: is crash debris from a machine that went away.
+FOREIGN_TMP_STALE_S = 900.0
+
+
+def sweepable_temp_files(out_dir: str) -> List[str]:
+    """The subset of :func:`leaked_temp_files` a (re)starting run may
+    safely unlink.  In a POD directory a temp file can belong to
+    another host's IN-FLIGHT write, so the rules are:
+
+    - SAME machine (or a legacy name with no host token): sweep only
+      when the embedded pid is dead — a live pid is a concurrent local
+      host mid-write;
+    - FOREIGN machine (shared-filesystem pod: the pid is meaningless
+      here): sweep only when the file has sat untouched past
+      :data:`FOREIGN_TMP_STALE_S` — a remote host's in-flight write is
+      always fresh;
+    - no parseable identity at all: legacy debris, sweepable."""
+    local = host_token()
+    out = []
+    now = time.time()
+    for name in leaked_temp_files(out_dir):
+        m = _TMP_RE.search(name)
+        if m:
+            host, pid = m.group(1), int(m.group(2))
+            if host is None or host == local:
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    pass        # dead local writer: crash debris
+                except OSError:
+                    continue    # unknowable: leave it alone
+                else:
+                    continue    # alive: a concurrent local host, or us
+            else:
+                try:
+                    age = now - os.stat(
+                        os.path.join(out_dir, name)).st_mtime
+                except OSError:
+                    continue    # vanished mid-scan: its owner is live
+                if age < FOREIGN_TMP_STALE_S:
+                    continue    # a remote host may be mid-write
+        out.append(name)
+    return out

@@ -3,6 +3,8 @@ from .mesh import (
     aggregate_counters,
     batch_parallel_runner,
     data_parallel_runner,
+    dp_device_count,
+    dp_shardings,
     make_mesh,
     sequence_parallel_runner,
 )
@@ -11,6 +13,8 @@ __all__ = [
     "make_mesh",
     "batch_parallel_runner",
     "data_parallel_runner",
+    "dp_device_count",
+    "dp_shardings",
     "sequence_parallel_runner",
     "aggregate_counters",
 ]

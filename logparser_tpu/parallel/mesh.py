@@ -46,6 +46,39 @@ def make_mesh(
     return Mesh(dev_array, axis_names=("data", "seq"))
 
 
+def dp_device_count(requested: Optional[int] = None) -> int:
+    """The data-parallel width a parser mesh should use: the largest
+    power of two <= min(requested, local device count).  Power-of-two
+    widths always divide the power-of-two batch buckets the parser pads
+    to, so the sharded batch axis never needs uneven-shard handling in
+    the hot path; a leftover odd device idles rather than forcing a
+    repad (document, don't surprise)."""
+    avail = len(jax.devices())
+    n = avail if requested is None else min(int(requested), avail)
+    if n < 1:
+        return 1
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def dp_shardings(mesh: Mesh):
+    """The ONE definition of the fused parse step's data-parallel
+    layout: inputs ``(buf [B, L], lengths [B])`` sharded over the
+    ``data`` axis, packed output ``[K, B]`` sharded on its batch
+    column axis.  Shared by :func:`batch_parallel_runner` (the dryrun /
+    test harness) and ``TpuBatchParser(data_parallel=...)`` (the
+    product hot path) so the two can never drift."""
+    return (
+        (
+            NamedSharding(mesh, P("data", None)),
+            NamedSharding(mesh, P("data")),
+        ),
+        NamedSharding(mesh, P(None, "data")),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Data-parallel execution: shard B, replicate the program.
 # ---------------------------------------------------------------------------
@@ -78,11 +111,7 @@ def batch_parallel_runner(units, mesh: Mesh, view_specs=None):
     # The same executor body TpuBatchParser jits.
     fn = units_views_fn(units, view_specs) if view_specs else units_fn(units)
 
-    in_shardings = (
-        NamedSharding(mesh, P("data", None)),
-        NamedSharding(mesh, P("data")),
-    )
-    out_shardings = NamedSharding(mesh, P(None, "data"))
+    in_shardings, out_shardings = dp_shardings(mesh)
     return jax.jit(fn, in_shardings=in_shardings, out_shardings=out_shardings)
 
 

@@ -1,0 +1,26 @@
+"""Pod-scale parse fabric: multi-host, multi-chip batch jobs.
+
+The composition layer ROADMAP direction 1 names (docs/JOBS.md "Pod
+jobs"): :func:`run_pod` partitions a corpus's shard plan into disjoint
+contiguous per-host ranges (``feeder.shards.shards_for_host``), runs one
+supervised single-host job per pod host — each host's feeder ring feeds
+its local chips, with the device parse optionally laid out data-parallel
+over a ``jax.sharding.Mesh`` (``TpuBatchParser(data_parallel=N)``) —
+and folds the per-host commit logs into one merged manifest
+(:func:`~logparser_tpu.jobs.manifest.merge_manifests`), after which the
+pod directory is indistinguishable from a single-host job's: same
+files, same ``merged_hash``, same resume semantics.  A dead host's
+range is just a run of uncommitted shards; relaunching (or resuming)
+re-parses exactly that run and nothing else.
+
+CLI: ``python -m logparser_tpu.pod`` (simulated pod: every host a local
+subprocess) or ``python -m logparser_tpu.jobs --hosts N --host-index i``
+per real host, plus ``--merge-only`` once all hosts report complete.
+"""
+from .runner import (  # noqa: F401
+    HostResult,
+    PodPolicy,
+    PodReport,
+    PodSpec,
+    run_pod,
+)

@@ -1,0 +1,104 @@
+"""CLI for the pod job runner: ``python -m logparser_tpu.pod``.
+
+Runs an N-host pod job on THIS machine (each host a subprocess of the
+single-host jobs CLI — the simulated-pod shape; on a real pod run the
+printed per-host command on each host instead) and merges the per-host
+manifests.  Resumable exactly like the single-host CLI: rerunning the
+same command after any crash/kill skips every committed shard.
+
+Example::
+
+    python -m logparser_tpu.pod access.log \\
+        --format '%h %l %u %t "%r" %>s %b' \\
+        --field IP:connection.client.host \\
+        --field STRING:request.status.last \\
+        --out /data/podjob --hosts 2
+
+Exit codes: 0 = pod complete (all shards merged); 1 = one or more
+hosts/shards failed (rerun resumes them); 2 = configuration error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import sys
+
+from ..feeder.shards import DEFAULT_SHARD_BYTES
+from ..jobs.manifest import ManifestError
+from ..jobs.runner import DEFAULT_JOB_BATCH_LINES
+from .runner import PodPolicy, PodSpec, host_argv, run_pod
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m logparser_tpu.pod",
+        description="Pod-scale corpus -> sharded-Arrow parse job "
+                    "(docs/JOBS.md 'Pod jobs')",
+    )
+    ap.add_argument("sources", nargs="+",
+                    help="input log files, in corpus order")
+    ap.add_argument("--format", required=True, dest="log_format")
+    ap.add_argument("--field", action="append", required=True,
+                    dest="fields", metavar="TYPE:path")
+    ap.add_argument("--out", required=True, dest="out_dir")
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--shard-bytes", type=int,
+                    default=DEFAULT_SHARD_BYTES)
+    ap.add_argument("--batch-lines", type=int,
+                    default=DEFAULT_JOB_BATCH_LINES)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="feeder workers per host (default: auto)")
+    ap.add_argument("--transport", choices=("ring", "pickle", "inline"),
+                    default=None)
+    ap.add_argument("--data-parallel", type=int, default=None,
+                    help="chips per host for the device mesh")
+    ap.add_argument("--host-retries", type=int, default=1)
+    ap.add_argument("--host-timeout", type=float, default=3600.0)
+    ap.add_argument("--print-host-commands", action="store_true",
+                    help="print the per-host CLI lines (for a REAL "
+                         "multi-host pod over a shared filesystem) and "
+                         "exit")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    spec = PodSpec(
+        sources=list(args.sources),
+        log_format=args.log_format,
+        fields=list(args.fields),
+        out_dir=args.out_dir,
+        n_hosts=args.hosts,
+        shard_bytes=args.shard_bytes,
+        batch_lines=args.batch_lines,
+        workers=args.workers,
+        transport=args.transport,
+        data_parallel=args.data_parallel,
+    )
+    policy = PodPolicy(host_retries=args.host_retries,
+                       host_timeout_s=args.host_timeout)
+    if args.print_host_commands:
+        # shlex-quoted: LogFormat strings carry spaces, quotes and `%>s`
+        # (a shell redirection if pasted unquoted).
+        for i in range(spec.n_hosts):
+            print(shlex.join(host_argv(spec, i, policy)))
+        merge_argv = [sys.executable, "-m", "logparser_tpu.jobs",
+                      *args.sources, "--format", args.log_format,
+                      "--out", args.out_dir, "--merge-only"]
+        for f in args.fields:
+            merge_argv += ["--field", f]
+        print("# then, once every host reports complete:")
+        print(shlex.join(merge_argv))
+        return 0
+    try:
+        report = run_pod(spec, policy=policy)
+    except (ManifestError, ValueError) as e:
+        print(json.dumps({"error": str(e)}), file=sys.stderr)
+        return 2
+    print(json.dumps(report.as_dict()))
+    return 0 if report.complete else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,318 @@
+"""Pod job runner: N per-host jobs + manifest merge (docs/JOBS.md).
+
+``run_pod(PodSpec(...))`` drives one pod-level job:
+
+1. the GLOBAL shard plan is computed once (``feeder/shards.py`` — the
+   same plan every host computes independently from the same spec, so
+   no plan ever travels over a wire);
+2. each host runs its contiguous disjoint slice of that plan as an
+   ordinary single-host job (``jobs/runner.py`` with
+   ``n_hosts``/``host_index`` set), committing into its per-host
+   manifest — subprocesses by default (the simulated-pod shape: real
+   deployments run the same CLI on real hosts against a shared
+   filesystem), or inline in-process for tests and the bench;
+3. a host that dies or fails is relaunched up to
+   ``PodPolicy.host_retries`` times — resume semantics make this free
+   (its committed shards are skipped; only the uncommitted tail of its
+   range replays);
+4. the per-host manifests merge into the top-level ``manifest.json``
+   (fingerprint-checked, duplicate-commit-checked), leaving a directory
+   byte-indistinguishable from a single-host run over the same spec.
+
+The kill-drill invariant, one level up from the single-host one: SIGKILL
+any host mid-job, rerun ``run_pod`` (or resume the one host), and the
+merged output is byte-identical to an undisturbed single-host run, with
+committed shards never re-parsed — drilled live in
+``tools/pod_smoke.py`` and gated in bench's ``pod`` section.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..feeder.shards import (
+    DEFAULT_SHARD_BYTES,
+    SourceT,
+    normalize_sources,
+    plan_shards,
+)
+from ..jobs.manifest import ManifestError, merge_manifests
+from ..jobs.runner import (
+    DEFAULT_JOB_BATCH_LINES,
+    JobPolicy,
+    JobSpec,
+    run_job,
+)
+from ..observability import metrics
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclass
+class PodSpec:
+    """One pod job: the output-determining geometry (identical to
+    :class:`~logparser_tpu.jobs.runner.JobSpec`'s — n_hosts is
+    EXECUTION-only, which is what makes an N-host merge byte-comparable
+    to a 1-host run) plus pod execution knobs."""
+
+    sources: Sequence[SourceT]
+    log_format: str
+    fields: Sequence[str]
+    out_dir: str
+    n_hosts: int = 2
+    shard_bytes: int = DEFAULT_SHARD_BYTES
+    batch_lines: int = DEFAULT_JOB_BATCH_LINES
+    # Execution-only:
+    workers: Optional[int] = None          # feeder workers per host
+    use_processes: Optional[bool] = None
+    transport: Optional[str] = None
+    data_parallel: Optional[int] = None    # chips per host (mesh DP)
+    host_env: Optional[Dict[str, str]] = None  # extra env per subprocess
+
+    def host_job_spec(self, host_index: int) -> JobSpec:
+        return JobSpec(
+            sources=list(self.sources),
+            log_format=self.log_format,
+            fields=list(self.fields),
+            out_dir=self.out_dir,
+            shard_bytes=self.shard_bytes,
+            batch_lines=self.batch_lines,
+            workers=self.workers,
+            use_processes=self.use_processes,
+            transport=self.transport,
+            n_hosts=self.n_hosts,
+            host_index=host_index,
+            data_parallel=self.data_parallel,
+        )
+
+
+@dataclass
+class PodPolicy:
+    """Pod runner tunables."""
+
+    host_retries: int = 1        # relaunches per dead/failed host
+    host_timeout_s: float = 3600.0
+    io_retries: int = 3          # per-host writer retry ladder
+    inline: bool = False         # run hosts sequentially in-process
+    merge: bool = True           # merge manifests after the host wave
+
+
+@dataclass
+class HostResult:
+    """One host's outcome across its launches."""
+
+    host_index: int
+    launches: int = 0
+    returncode: Optional[int] = None
+    report: Optional[Dict[str, Any]] = None  # the host job's as_dict()
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return (self.returncode == 0 and self.report is not None
+                and self.report.get("complete", False))
+
+
+@dataclass
+class PodReport:
+    """What one ``run_pod`` call did."""
+
+    out_dir: str
+    n_hosts: int
+    shards_total: int = 0
+    merged_shards: int = 0
+    hosts: List[HostResult] = field(default_factory=list)
+    wall_s: float = 0.0
+    merge_error: Optional[str] = None
+
+    @property
+    def complete(self) -> bool:
+        return (self.merge_error is None
+                and self.merged_shards == self.shards_total
+                and all(h.ok for h in self.hosts))
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "out_dir": self.out_dir,
+            "n_hosts": self.n_hosts,
+            "shards_total": self.shards_total,
+            "merged_shards": self.merged_shards,
+            "complete": self.complete,
+            "wall_s": round(self.wall_s, 4),
+            **({"merge_error": self.merge_error}
+               if self.merge_error else {}),
+            "hosts": [
+                {
+                    "host": h.host_index,
+                    "launches": h.launches,
+                    "returncode": h.returncode,
+                    "ok": h.ok,
+                    **({"error": h.error} if h.error else {}),
+                    **({"committed": h.report.get("committed"),
+                        "skipped": h.report.get("skipped"),
+                        "rejects": h.report.get("rejects")}
+                       if h.report else {}),
+                }
+                for h in self.hosts
+            ],
+        }
+
+
+def host_argv(spec: PodSpec, host_index: int,
+              policy: PodPolicy) -> List[str]:
+    """The per-host CLI line — exactly what an operator runs on each
+    real host of a shared-filesystem pod (the subprocess path and the
+    documentation are the same command)."""
+    argv = [sys.executable, "-m", "logparser_tpu.jobs",
+            *[os.fspath(s) for s in spec.sources],
+            "--format", spec.log_format,
+            "--out", spec.out_dir,
+            "--shard-bytes", str(spec.shard_bytes),
+            "--batch-lines", str(spec.batch_lines),
+            "--hosts", str(spec.n_hosts),
+            "--host-index", str(host_index),
+            "--io-retries", str(policy.io_retries)]
+    for f in spec.fields:
+        argv += ["--field", f]
+    if spec.workers:
+        argv += ["--workers", str(spec.workers)]
+    if spec.use_processes is False:
+        argv += ["--threads"]
+    if spec.transport:
+        argv += ["--transport", spec.transport]
+    if spec.data_parallel:
+        argv += ["--data-parallel", str(spec.data_parallel)]
+    return argv
+
+
+def _launch_host(spec: PodSpec, host_index: int,
+                 policy: PodPolicy) -> subprocess.Popen:
+    env = dict(os.environ)
+    if spec.host_env:
+        env.update(spec.host_env)
+    return subprocess.Popen(
+        host_argv(spec, host_index, policy),
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, text=True, start_new_session=True,
+    )
+
+
+def _host_report_from_stdout(text: str) -> Optional[Dict[str, Any]]:
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                return None
+    return None
+
+
+def _run_host_inline(spec: PodSpec, host_index: int,
+                     policy: PodPolicy, parser: Any) -> HostResult:
+    hr = HostResult(host_index=host_index, launches=1)
+    try:
+        report = run_job(
+            spec.host_job_spec(host_index), parser=parser,
+            policy=JobPolicy(io_retries=policy.io_retries),
+        )
+        hr.report = report.as_dict()
+        hr.returncode = 0 if not report.failed else 1
+    except (ManifestError, ValueError) as e:
+        hr.returncode = 2
+        hr.error = str(e)
+    return hr
+
+
+def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
+            parser: Any = None) -> PodReport:
+    """Run (or resume) one pod job end to end: host wave, bounded
+    relaunch of dead/failed hosts, manifest merge.  ``parser`` is only
+    legal inline (subprocess hosts build their own); see module
+    docstring."""
+    policy = policy or PodPolicy()
+    if spec.n_hosts < 1:
+        raise ValueError(f"n_hosts must be positive, got {spec.n_hosts}")
+    t0 = time.perf_counter()
+    reg = metrics()
+    reg.increment("pod_runs_total")
+    plan = plan_shards(normalize_sources(spec.sources), spec.shard_bytes)
+    report = PodReport(out_dir=spec.out_dir, n_hosts=spec.n_hosts,
+                       shards_total=len(plan))
+    results = [HostResult(host_index=i) for i in range(spec.n_hosts)]
+    report.hosts = results
+
+    if policy.inline:
+        for i in range(spec.n_hosts):
+            hr = _run_host_inline(spec, i, policy, parser)
+            # Each failed LAUNCH counts once; a config refusal (rc 2)
+            # never retries — resuming it would refuse identically.
+            while (not hr.ok and hr.returncode != 2
+                   and hr.launches <= policy.host_retries):
+                reg.increment("pod_host_failures_total")
+                retry = _run_host_inline(spec, i, policy, parser)
+                retry.launches = hr.launches + 1
+                hr = retry
+            if not hr.ok:
+                reg.increment("pod_host_failures_total")
+            results[i] = hr
+    else:
+        if parser is not None:
+            raise ValueError("parser reuse requires PodPolicy(inline=True)")
+        pending = list(range(spec.n_hosts))
+        attempt = 0
+        while pending and attempt <= policy.host_retries:
+            procs = {}
+            for i in pending:
+                results[i].launches += 1
+                reg.increment("pod_hosts_launched_total")
+                procs[i] = _launch_host(spec, i, policy)
+            reg.gauge_set("pod_hosts_alive", len(procs))
+            deadline = time.monotonic() + policy.host_timeout_s
+            for i, p in procs.items():
+                budget = max(0.0, deadline - time.monotonic())
+                try:
+                    out, _ = p.communicate(timeout=budget)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    out, _ = p.communicate()
+                    results[i].error = (
+                        f"host {i} exceeded its "
+                        f"{policy.host_timeout_s:.0f}s budget (killed)"
+                    )
+                results[i].returncode = p.returncode
+                results[i].report = _host_report_from_stdout(out)
+                reg.gauge_set(
+                    "pod_hosts_alive",
+                    sum(1 for q in procs.values() if q.poll() is None),
+                )
+            failed = [i for i in pending if not results[i].ok
+                      and results[i].returncode != 2]
+            for i in failed:
+                reg.increment("pod_host_failures_total")
+                LOG.warning("pod: host %d failed (rc=%s)%s", i,
+                            results[i].returncode,
+                            " — relaunching (resume skips its committed "
+                            "shards)" if attempt < policy.host_retries
+                            else "")
+            pending = failed
+            attempt += 1
+        reg.gauge_set("pod_hosts_alive", 0)
+
+    if policy.merge:
+        try:
+            merged = merge_manifests(spec.out_dir)
+            report.merged_shards = len(merged.shards)
+            reg.increment("pod_merge_runs_total")
+            reg.increment("pod_merged_shards_total", len(merged.shards))
+        except ManifestError as e:
+            report.merge_error = str(e)
+            reg.increment("pod_merge_refusals_total")
+    report.wall_s = time.perf_counter() - t0
+    return report

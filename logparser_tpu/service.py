@@ -761,6 +761,20 @@ class _SessionHandler(socketserver.BaseRequestHandler):
             # state — not part of the cache key either.
             if isinstance(config, dict) and config.get("feeder_workers"):
                 feeder_workers = int(config["feeder_workers"])
+            # Client batching hint (PROTOCOL.md "coalesce_wait_ms"): a
+            # latency-critical session caps the coalescer's straggler
+            # window for ITS requests (0 = dispatch immediately once
+            # claimed).  Session behavior only — results are
+            # byte-identical, so not part of the cache key either.
+            coalesce_wait_s: Optional[float] = None
+            if isinstance(config, dict) \
+                    and config.get("coalesce_wait_ms") is not None:
+                coalesce_wait_s = float(config["coalesce_wait_ms"]) / 1e3
+                if coalesce_wait_s < 0:
+                    raise ValueError(
+                        "coalesce_wait_ms must be >= 0, got "
+                        f"{config['coalesce_wait_ms']!r}"
+                    )
             parser = self.server.parser_cache.get(config)
             metrics().increment("service_sessions_total")
         except Exception as e:  # noqa: BLE001 — relay config errors to client
@@ -772,7 +786,8 @@ class _SessionHandler(socketserver.BaseRequestHandler):
         except Exception:  # noqa: BLE001 — doubles may bypass the schema
             parser_key = repr(config)
         state = {"feeder_workers": feeder_workers,
-                 "parser_key": parser_key}
+                 "parser_key": parser_key,
+                 "coalesce_wait_s": coalesce_wait_s}
         # Per-key session registry: the coalescer skips its straggler
         # window when this session is the key's only one.
         self.server.key_session_enter(parser_key)
@@ -1052,6 +1067,7 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 result = coalescer.parse(
                     state["parser_key"], parser, bytes(blob), count,
                     deadline_s=self.server.limits.request_deadline_s,
+                    max_wait_s=state.get("coalesce_wait_s"),
                 )
             elif blob_shape:
                 # (an empty blob is one empty LINE per the

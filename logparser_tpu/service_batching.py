@@ -125,17 +125,24 @@ class _Entry:
     took it into a formed batch) or PENDING -> CANCELLED (deadline /
     shutdown); CLAIMED entries always get ``result`` or ``error``."""
 
-    __slots__ = ("blob", "count", "enq_t", "deadline_t", "event", "state",
-                 "result", "error", "abandoned")
+    __slots__ = ("blob", "count", "enq_t", "deadline_t", "max_wait_t",
+                 "event", "state", "result", "error", "abandoned")
 
     PENDING, CLAIMED, CANCELLED = range(3)
 
     def __init__(self, blob: bytes, count: int,
-                 deadline_t: Optional[float]):
+                 deadline_t: Optional[float],
+                 max_wait_t: Optional[float] = None):
         self.blob = blob
         self.count = count
         self.enq_t = time.monotonic()
         self.deadline_t = deadline_t
+        # Client batching hint (PROTOCOL.md "coalesce_wait_ms"): the
+        # absolute time by which a forming batch holding this entry must
+        # stop waiting for stragglers — a latency-critical session caps
+        # the straggler window it is willing to pay, without changing
+        # parsing, sharing, shedding, or result bytes.
+        self.max_wait_t = max_wait_t
         self.event = threading.Event()
         self.state = _Entry.PENDING
         self.result: Any = None
@@ -208,10 +215,13 @@ class _KeyBatcher:
     # -- submit side (session threads) ---------------------------------
 
     def submit(self, blob: bytes, count: int,
-               deadline_s: Optional[float]) -> _Entry:
+               deadline_s: Optional[float],
+               max_wait_s: Optional[float] = None) -> _Entry:
         now = time.monotonic()
         entry = _Entry(blob, count,
-                       now + deadline_s if deadline_s else None)
+                       now + deadline_s if deadline_s else None,
+                       now + max_wait_s if max_wait_s is not None
+                       else None)
         with self.lock:
             if self.stopped:
                 raise CoalesceShutdown("service is shutting down")
@@ -406,7 +416,9 @@ class _KeyBatcher:
                 and total < self.co.max_lines
                 and self.co.should_wait(self.key)
             ):
-                end = time.monotonic() + self.co.window_s
+                end = self._window_end(
+                    claimed, time.monotonic() + self.co.window_s
+                )
                 while total < self.co.max_lines:
                     remaining = end - time.monotonic()
                     if remaining <= 0:
@@ -415,9 +427,25 @@ class _KeyBatcher:
                     if self.stopped or self.epoch != my_epoch:
                         break
                     total = self._claim_locked(claimed, time.monotonic())
+                    # A newly claimed entry may carry a TIGHTER
+                    # per-session wait cap (coalesce_wait_ms): the
+                    # formation window shrinks to the strictest member.
+                    end = min(end, self._window_end(claimed, end))
         if not claimed:
             return None
         return _FormedBatch(claimed)
+
+    @staticmethod
+    def _window_end(claimed: List[_Entry], default_end: float) -> float:
+        """When the straggler wait over ``claimed`` must stop: the
+        configured window end, clamped by every member's own
+        ``coalesce_wait_ms`` cap (the strictest session in the batch
+        decides — a 0 ms hint dispatches the batch immediately)."""
+        end = default_end
+        for e in claimed:
+            if e.max_wait_t is not None and e.max_wait_t < end:
+                end = e.max_wait_t
+        return end
 
     def _burst(self, my_epoch: int) -> None:
         """Drain the backlog as one stream of formed batches: ONE device
@@ -579,18 +607,24 @@ class BatchCoalescer:
     # -- the request path ----------------------------------------------
 
     def parse(self, key: Any, parser: Any, blob: bytes, count: int,
-              deadline_s: Optional[float] = None):
+              deadline_s: Optional[float] = None,
+              max_wait_s: Optional[float] = None):
         """Coalesce one request's payload into the key's shared batch
         stream; returns the session's own
         :class:`~logparser_tpu.tpu.batch.BatchResult` window (byte-
-        identical to a solo parse of ``blob``).  Raises
-        :class:`CoalesceQueueFull` (shed), :class:`CoalesceDeadline`
-        (expired while queued), :class:`CoalesceShutdown`, or whatever
-        the shared parse raised."""
+        identical to a solo parse of ``blob``).  ``max_wait_s`` is the
+        session's ``coalesce_wait_ms`` hint: a cap on the straggler
+        window any batch holding this request may pay (0 = dispatch as
+        soon as claimed); parsing, queue bounds, and shed behavior are
+        untouched.  Raises :class:`CoalesceQueueFull` (shed),
+        :class:`CoalesceDeadline` (expired while queued),
+        :class:`CoalesceShutdown`, or whatever the shared parse
+        raised."""
         for _ in range(2):
             batcher = self._batcher(key, parser)
             try:
-                entry = batcher.submit(blob, count, deadline_s)
+                entry = batcher.submit(blob, count, deadline_s,
+                                       max_wait_s)
             except CoalesceShutdown:
                 if self._closed:
                     raise

@@ -1,0 +1,263 @@
+"""CI pod smoke: the pod-level kill drill on REAL host subprocesses.
+
+Drills the pod-scale parse fabric (docs/JOBS.md "Pod jobs") end to end
+and fails (exit 1) unless:
+
+- a single-host reference job over a garbage-bearing corpus completes
+  (the reject channel is live) and records the reference content hash;
+- a 2-host pod — each host a REAL subprocess of the per-host CLI
+  (``python -m logparser_tpu.jobs --hosts 2 --host-index i``), running
+  multi-device data-parallel dissection over a virtual mesh
+  (``XLA_FLAGS=--xla_force_host_platform_device_count``) — survives a
+  SIGKILL (-9) of one host mid-run: the survivor completes its share,
+  the dead host's range is exactly its uncommitted shards, a PARTIAL
+  merge is legal, and resuming the lost host + final merge yields a
+  merged output (data + reject tables, global shard order)
+  BYTE-IDENTICAL to the single-host reference — with the shards
+  committed before the kill never re-parsed;
+- a full ``run_pod`` pass over the finished directory is a no-op that
+  still exercises the pod metric families (``pod_*`` on /metrics);
+- no ``*.tmp`` debris and no shared-memory segment survives.
+
+Usage::
+
+    make pod-smoke
+    python -m logparser_tpu.tools.pod_smoke
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+N_LINES = 24000
+GARBAGE_EVERY = 997          # ~24 reject lines across the corpus
+SHARD_BYTES = 48 << 10       # ~25 shards -> ~12 per host: a wide kill window
+BATCH_LINES = 1024
+KILL_POLL_S = 0.2
+KILL_TIMEOUT_S = 300.0
+HOST_TIMEOUT_S = 300.0
+DATA_PARALLEL = 2            # virtual 2-device mesh per host
+SHM_DIR = "/dev/shm"
+
+FMT = "%h %u %>s"
+FIELDS = ["IP:connection.client.host", "STRING:request.status.last"]
+
+
+def _corpus(path: str) -> None:
+    with open(path, "w") as f:
+        for i in range(N_LINES):
+            if i % GARBAGE_EVERY == 7:
+                f.write(f"?? broken line {i} !! ::\n")
+            else:
+                f.write(f"10.0.{(i >> 8) % 256}.{i % 256} u{i} "
+                        f"{200 + i % 7}\n")
+
+
+def _ring_segments():
+    from logparser_tpu.feeder import RING_NAME_PREFIX
+
+    if not os.path.isdir(SHM_DIR):
+        return None
+    return sorted(
+        f for f in os.listdir(SHM_DIR) if f.startswith(RING_NAME_PREFIX)
+    )
+
+
+def _committed(out_dir: str, name: str) -> int:
+    try:
+        with open(os.path.join(out_dir, name), "rb") as f:
+            return len(json.loads(f.read().decode()).get("shards", {}))
+    except (OSError, ValueError):
+        return 0
+
+
+def main() -> int:
+    from logparser_tpu.jobs import (
+        JobManifest,
+        JobSpec,
+        host_manifest_name,
+        leaked_temp_files,
+        merge_manifests,
+        merged_hash,
+        run_job,
+    )
+    from logparser_tpu.observability import metrics
+    from logparser_tpu.pod import PodPolicy, PodSpec, run_pod
+    from logparser_tpu.pod.runner import host_argv
+    from logparser_tpu.tools.metrics_smoke import validate_exposition
+
+    failures = []
+    segments_before = _ring_segments()
+    tmp = tempfile.mkdtemp(prefix="logparser-pod-smoke-")
+    corpus = os.path.join(tmp, "corpus.log")
+    _corpus(corpus)
+
+    # ---- single-host reference (in-process, single device) -----------
+    ref_spec = JobSpec([corpus], FMT, FIELDS,
+                       os.path.join(tmp, "single-host"),
+                       shard_bytes=SHARD_BYTES, batch_lines=BATCH_LINES)
+    t0 = time.perf_counter()
+    ref = run_job(ref_spec)
+    ref_wall = time.perf_counter() - t0
+    if not ref.complete:
+        failures.append(f"reference run incomplete: {ref.as_dict()}")
+    if not ref.rejects:
+        failures.append("reference run saw no rejects (corpus has "
+                        "garbage lines — the reject channel is dark)")
+    ref_hash = merged_hash(ref_spec.out_dir,
+                           JobManifest.load(ref_spec.out_dir))
+    print(f"pod-smoke: reference {ref.shards_total} shards, "
+          f"{ref.rows} rows, {ref.rejects} rejects, "
+          f"{ref.payload_bytes / max(ref_wall, 1e-9) / 1e6:.1f} MB/s")
+
+    # ---- the pod: 2 real host subprocesses, kill host 1 mid-run ------
+    pod_dir = os.path.join(tmp, "pod")
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # The multi-device leg: each host lays its device parse over a
+    # forced 2-device CPU mesh (the TPU build box swaps in real chips).
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={DATA_PARALLEL}"
+    )
+    env["PYTHONPATH"] = (
+        repo_root + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH") else repo_root
+    )
+    spec = PodSpec([corpus], FMT, FIELDS, pod_dir, n_hosts=2,
+                   shard_bytes=SHARD_BYTES, batch_lines=BATCH_LINES,
+                   data_parallel=DATA_PARALLEL)
+    policy = PodPolicy(host_timeout_s=HOST_TIMEOUT_S)
+    procs = [
+        subprocess.Popen(host_argv(spec, i, policy), env=env,
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL,
+                         start_new_session=True)
+        for i in (0, 1)
+    ]
+    victim_manifest = host_manifest_name(1)
+    committed_at_kill = 0
+    deadline = time.monotonic() + KILL_TIMEOUT_S
+    while time.monotonic() < deadline:
+        committed_at_kill = _committed(pod_dir, victim_manifest)
+        if committed_at_kill >= 1 or procs[1].poll() is not None:
+            break
+        time.sleep(KILL_POLL_S)
+    if procs[1].poll() is None:
+        os.kill(procs[1].pid, signal.SIGKILL)
+        procs[1].wait(timeout=30)
+        print("pod-smoke: SIGKILLed host 1 mid-run")
+    else:
+        print("pod-smoke: WARNING host 1 finished before the kill "
+              "window (fast host) — resume still asserted below")
+    try:
+        procs[0].wait(timeout=HOST_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        failures.append("host 0 never finished inside its budget")
+    if procs[0].returncode != 0:
+        failures.append(f"survivor host 0 failed (rc={procs[0].returncode})")
+    committed_at_kill = _committed(pod_dir, victim_manifest)
+    print(f"pod-smoke: host 1 died with {committed_at_kill} of its "
+          f"shards committed; host 0 rc={procs[0].returncode}")
+
+    # A PARTIAL merge mid-loss is legal: the dead host's unfinished
+    # range is simply absent from the merged manifest.
+    try:
+        partial = merge_manifests(pod_dir)
+        if len(partial.shards) >= ref.shards_total and \
+                procs[1].returncode == -9:
+            failures.append("kill drill never landed mid-run")
+        print(f"pod-smoke: partial merge holds {len(partial.shards)} of "
+              f"{ref.shards_total} shards")
+    except Exception as e:  # noqa: BLE001 — a refusal here is a failure
+        failures.append(f"partial merge refused: {e}")
+
+    # Orphaned feeder workers of the killed host must self-terminate.
+    time.sleep(2.0)
+
+    # ---- resume the lost host (in-process), final merge --------------
+    t0 = time.perf_counter()
+    revived = run_job(JobSpec(
+        [corpus], FMT, FIELDS, pod_dir,
+        shard_bytes=SHARD_BYTES, batch_lines=BATCH_LINES,
+        n_hosts=2, host_index=1,
+    ))
+    resume_wall = time.perf_counter() - t0
+    if not revived.complete:
+        failures.append(f"host 1 resume incomplete: {revived.as_dict()}")
+    if revived.skipped != committed_at_kill:
+        failures.append(
+            f"resume re-parsed committed work: skipped "
+            f"{revived.skipped}, manifest had {committed_at_kill} at kill"
+        )
+    try:
+        merged = merge_manifests(pod_dir)
+        if len(merged.shards) != ref.shards_total:
+            failures.append(
+                f"final merge holds {len(merged.shards)} shards, "
+                f"expected {ref.shards_total}"
+            )
+        pod_hash = merged_hash(pod_dir, JobManifest.load(pod_dir))
+        if pod_hash != ref_hash:
+            failures.append(
+                "pod output is NOT byte-identical to the single-host "
+                f"reference ({pod_hash[:16]} != {ref_hash[:16]})"
+            )
+        else:
+            print(f"pod-smoke: kill+resume+merge byte-identical "
+                  f"({pod_hash[:16]}), resume wall {resume_wall:.2f}s, "
+                  f"skipped {revived.skipped} committed shards")
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"final merge failed: {e}")
+
+    # ---- run_pod no-op pass: pod metric families in THIS process -----
+    report = run_pod(spec, policy=PodPolicy(
+        host_timeout_s=HOST_TIMEOUT_S,
+        host_retries=0))
+    if not report.complete:
+        failures.append(f"no-op run_pod incomplete: {report.as_dict()}")
+    if any(h.report and h.report.get("committed") for h in report.hosts):
+        failures.append("no-op run_pod re-parsed committed shards")
+
+    # ---- hygiene ------------------------------------------------------
+    for d in (ref_spec.out_dir, pod_dir):
+        debris = leaked_temp_files(d)
+        if debris:
+            failures.append(f"{d}: leaked temp files {debris}")
+    segments_after = _ring_segments()
+    if segments_before is not None and segments_after is not None:
+        leaked = sorted(set(segments_after) - set(segments_before))
+        if leaked:
+            failures.append(f"leaked shared-memory segments: {leaked}")
+
+    # ---- telemetry ----------------------------------------------------
+    text = metrics().prometheus_text()
+    for needle in ("logparser_tpu_pod_runs_total",
+                   "logparser_tpu_pod_hosts_launched_total",
+                   "logparser_tpu_pod_merge_runs_total",
+                   "logparser_tpu_job_shards_committed_total"):
+        if needle not in text:
+            failures.append(f"/metrics exposition missing: {needle}")
+    failures.extend(validate_exposition(text))
+
+    if failures:
+        print("POD SMOKE FAILURES:")
+        for f in failures:
+            print(" -", f)
+        return 1
+    print("pod-smoke OK: 2-host pod with a mid-run host SIGKILL "
+          "resumed + merged byte-identical to single-host, committed "
+          "shards never re-parsed, multi-device mesh per host, "
+          "pod_* families live, no leaked temp files or shm segments")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -885,8 +885,19 @@ class TpuBatchParser:
         locale: Optional[str] = None,
         view_fields: Optional[Sequence[str]] = None,
         assembly_workers: Optional[int] = None,
+        data_parallel: Optional[int] = None,
     ):
         self.log_format = log_format
+        # Device-side data parallelism (docs/JOBS.md "Pod jobs"): lay
+        # the fused parse over up to ``data_parallel`` local devices via
+        # a jax.sharding Mesh ('data' axis; NamedSharding in/out) — the
+        # dryrun_multichip idiom on the product hot path.  None/<=1 (or
+        # a single-device host) keeps the unsharded executor.  The
+        # effective width is the largest power of two that fits
+        # (parallel.mesh.dp_device_count), so the power-of-two batch
+        # buckets always divide evenly across devices.
+        self.data_parallel = data_parallel
+        self._mesh = self._build_mesh(data_parallel)
         self.requested = [cleanup_field_value(f) for f in fields]
         # Demand-driven view emission: the device emits Arrow view rows
         # only for span fields the consumer will actually deliver as
@@ -1024,13 +1035,43 @@ class TpuBatchParser:
         self._jitted = self._build_jitted()
         self._jitted_views = None  # lazily built by device_views_fn()
 
+    @staticmethod
+    def _build_mesh(data_parallel: Optional[int]):
+        """The 'data'-axis mesh a data_parallel request resolves to on
+        THIS host, or None for the unsharded executor (no request, one
+        device, or a 1-wide resolution)."""
+        if not data_parallel or int(data_parallel) <= 1:
+            return None
+        from ..observability import metrics
+        from ..parallel.mesh import dp_device_count, make_mesh
+
+        n = dp_device_count(int(data_parallel))
+        if n <= 1:
+            return None
+        metrics().gauge_set("device_mesh_devices", n)
+        return make_mesh(n_data=n)
+
+    @property
+    def mesh_devices(self) -> int:
+        """How many devices the executor is laid out over (1 = no mesh)."""
+        return self._mesh.devices.size if self._mesh is not None else 1
+
+    def _bucket(self, b: int) -> int:
+        """The padded batch size of a ``b``-line batch: the power-of-two
+        bucket, floored at the mesh width so a sharded batch axis always
+        divides evenly across devices."""
+        size = _bucket_batch(b)
+        if self._mesh is not None:
+            size = max(size, self._mesh.devices.size)
+        return size
+
     def _build_jitted(self):
         # No point running the device programs when every field is host-only.
         any_device_field = any(
             p.kind != "host" for u in self.units for p in u.plans
         )
         if self.units and any_device_field:
-            return build_units_jnp_fn(self.units)
+            return build_units_jnp_fn(self.units, mesh=self._mesh)
         return None
 
     def assembly_pool(self):
@@ -1078,7 +1119,9 @@ class TpuBatchParser:
                 self._jitted_views = self._jitted
                 self._views_fields = []
             else:
-                self._jitted_views = build_units_jnp_fn(self.units, specs)
+                self._jitted_views = build_units_jnp_fn(
+                    self.units, specs, mesh=self._mesh
+                )
                 self._views_fields = [fid for fid, _ in specs]
         return self._jitted_views
 
@@ -1583,7 +1626,7 @@ class TpuBatchParser:
             buf, lengths, overflow = encode_blob(data)
         if buf.shape[0] != B:  # framer/view disagreement: authoritative path
             return self.parse_batch(list(lines), emit_views=emit_views)
-        padded_b = _bucket_batch(B)
+        padded_b = self._bucket(B)
         if padded_b != B:
             buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
             lengths = np.pad(lengths, (0, padded_b - B))
@@ -1635,7 +1678,7 @@ class TpuBatchParser:
         with pipeline_stage("encode", items=0):
             # Adoption cost only (row padding / lease copy): the real
             # encode ran in the feeder worker under feeder_encode.
-            padded_b = _bucket_batch(B)
+            padded_b = self._bucket(B)
             if padded_b != B:
                 buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
                 lengths = np.pad(lengths, (0, padded_b - B))
@@ -1746,7 +1789,19 @@ class TpuBatchParser:
             return enc
         lines, buf, lengths, overflow, B, padded_b = enc[:6]
         t0 = time.perf_counter()
-        staged = (jax.device_put(buf), jax.device_put(lengths))
+        if self._mesh is not None:
+            # Per-device input sharding ON the H2D edge: each device
+            # receives only its batch slice, so the upload fans out
+            # across the mesh instead of landing whole on device 0 and
+            # resharding inside the jit (the dryrun_multichip feeder
+            # idiom promoted to the hot path).
+            from ..parallel.mesh import dp_shardings
+
+            (buf_sh, len_sh), _ = dp_shardings(self._mesh)
+            staged = (jax.device_put(buf, buf_sh),
+                      jax.device_put(lengths, len_sh))
+        else:
+            staged = (jax.device_put(buf), jax.device_put(lengths))
         observe_stage("h2d_stage", time.perf_counter() - t0, items=B)
         metrics().increment(
             "h2d_staged_bytes_total", int(buf.nbytes + lengths.nbytes)
@@ -1773,7 +1828,7 @@ class TpuBatchParser:
         with pipeline_stage("encode", items=B):
             buf, lengths, overflow = encode_batch(lines)
         # Pad the batch dimension to a bucket so jit recompiles stay bounded.
-        padded_b = _bucket_batch(B)
+        padded_b = self._bucket(B)
         if padded_b != B:
             buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
             lengths = np.pad(lengths, (0, padded_b - B))
@@ -3070,6 +3125,10 @@ class TpuBatchParser:
         state["_jitted_views"] = None
         state["_oracle_pool"] = None  # worker pools never ship in artifacts
         state["_assembly_pool"] = None  # rebuilt lazily from the knob
+        # Device handles never ship: the mesh is re-resolved on the
+        # LOADING host from the pickled data_parallel request (a
+        # different host may have a different chip count).
+        state["_mesh"] = None
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -3097,6 +3156,11 @@ class TpuBatchParser:
             self.assembly_workers = None
         if "_overflow_delivery" not in state:  # pre-round-9 artifacts
             self._overflow_delivery = self._build_overflow_delivery()
+        if "data_parallel" not in state:  # pre-pod artifacts
+            self.data_parallel = None
+        # Re-resolve the mesh on THIS host (never pickled; the loading
+        # host's device count decides the effective width).
+        self._mesh = self._build_mesh(self.data_parallel)
         # Pre-widening artifacts packed 18-digit limb layouts (no d18/big
         # aux slots).  Layouts are deterministic functions of the plans +
         # slot count, so rebuild them to the current frame format.

@@ -1352,14 +1352,35 @@ EXEC_TILE_B = 16384
 def build_units_jnp_fn(
     units: Sequence[FormatUnit],
     view_specs: Optional[Sequence[Tuple[str, Sequence[int]]]] = None,
+    mesh=None,
 ):
     """Plain-XLA executor over all formats:
     (buf [B,L] uint8, lengths [B]) -> [sum K_i, B] int32 (plus 4 trailing
-    device-view rows per span field when ``view_specs`` is given)."""
+    device-view rows per span field when ``view_specs`` is given).
+
+    ``mesh`` (a ``jax.sharding.Mesh`` with a ``data`` axis) lays the
+    batch dimension out data-parallel over the mesh's devices via
+    ``NamedSharding``/``PartitionSpec`` — the dryrun_multichip /
+    batch_parallel_runner machinery promoted to the product hot path.
+    The per-line computation has no cross-line dependency, so XLA
+    partitions it with zero collectives; output stays the packed
+    ``[K, B]`` with the batch column axis sharded, bit-identical to the
+    single-device executor (tests/test_parallel.py).  The compile-memory
+    tiling below is skipped under a mesh: each device already sees only
+    ``B / n_data`` rows, and reshaping a sharded batch axis into tiles
+    would force cross-device resharding."""
     fn = (
         units_views_fn(units, view_specs) if view_specs
         else units_fn(units)
     )
+
+    if mesh is not None:
+        from ..parallel.mesh import dp_shardings
+
+        in_shardings, out_shardings = dp_shardings(mesh)
+        return jax.jit(
+            fn, in_shardings=in_shardings, out_shardings=out_shardings
+        )
 
     def tiled(buf: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
         B = buf.shape[0]

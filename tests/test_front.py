@@ -25,6 +25,7 @@ from logparser_tpu.front import (
 from logparser_tpu.observability import metrics
 from logparser_tpu.service import (
     ParseServiceClient,
+    ParseServiceError,
     ServiceBusyError,
     ServiceUnavailableError,
     _ParserCache,
@@ -547,3 +548,114 @@ def test_fleet_parity_bench_configs():
             got = run_session(front.host, front.port, config_payload,
                               payloads)
         assert got == ref, f"{name}: fleet bytes differ from solo"
+
+
+# ---------------------------------------------------------------------------
+# remote sidecar ADOPTION (ROADMAP 2c): host:port:metrics_port slots
+# behind the same supervisor probes as spawned children.
+# ---------------------------------------------------------------------------
+
+
+class TestAdoptedSidecar:
+    def test_address_parsing(self):
+        from logparser_tpu.front import parse_sidecar_address
+
+        assert parse_sidecar_address("10.0.0.5:8123:9100") == \
+            ("10.0.0.5", 8123, 9100)
+        for bad in ("nope", "host:1", "host:0:9", "host:1:99999",
+                    "host:x:y", ":1:2"):
+            with pytest.raises(ValueError):
+                parse_sidecar_address(bad)
+
+    def test_adopt_probes_reachability(self):
+        from logparser_tpu.front import AdoptedSidecar, SidecarSpawnError
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        host, port = srv.getsockname()
+        try:
+            sc = AdoptedSidecar(0, f"{host}:{port}:9100")
+            # Process control is deliberately inert: the front does not
+            # own the remote process.
+            assert sc.alive() and sc.wait(0.0) and sc.pid == -1
+            sc.kill(), sc.terminate(), sc.suspend(), sc.close()
+            assert sc.alive()
+        finally:
+            srv.close()
+        with pytest.raises(SidecarSpawnError):
+            AdoptedSidecar(0, f"{host}:{port}:9100",
+                           connect_timeout_s=0.2)
+
+    def test_front_validates_addresses_at_construction(self):
+        with pytest.raises(ValueError):
+            FrontTier(n_sidecars=1, sidecar_addresses=["garbage"])
+
+    def test_router_and_supervisor_treat_adopted_slot_normally(self):
+        """An adopted handle sits in a _Slot exactly like a spawned one:
+        routable while ready, faultable, circuit-breakable — the
+        supervisor machine never looks at the handle type."""
+        from logparser_tpu.front import AdoptedSidecar
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        host, port = srv.getsockname()
+        try:
+            slot = _Slot(0)
+            slot.handle = AdoptedSidecar(0, f"{host}:{port}:9100")
+            slot.ready = True
+            sup = FrontSupervisor(_policy(), 1)
+            assert sup.routable(0, now=0.0)
+            assert slot.handle.alive()
+            d = sup.on_fault(0, now=1.0)
+            assert d.action == "respawn"
+            sup.on_success(0, now=2.0)
+            assert sup.routable(0, now=2.1)
+        finally:
+            srv.close()
+
+
+@pytest.mark.slow
+def test_adopted_sidecar_serves_and_dies_unroutable():
+    """A front over ONE adopted in-process service: sessions route and
+    parse through it (parity with the injected parser); when the remote
+    dies, the slot leaves the rotation via the probe path and a re-adopt
+    of the dead address keeps failing — new sessions get structured
+    BUSY, never a reset."""
+    from logparser_tpu.service import ParseService
+
+    svc = ParseService(metrics_port=0).start()
+    _inject(svc)
+    addr = f"{svc.host}:{svc.port}:{svc.metrics_port}"
+    adoptions0 = metrics().get("front_sidecar_adoptions_total")
+    front = FrontTier(
+        n_sidecars=1, sidecar_addresses=[addr],
+        policy=_quick_policy(heartbeat_deadline_s=0.6,
+                             connect_timeout_s=0.5),
+    ).start()
+    try:
+        assert metrics().get("front_sidecar_adoptions_total") \
+            > adoptions0
+        with ParseServiceClient(front.host, front.port, "combined",
+                                FIELDS) as c:
+            table = c.parse(LINES)
+            assert table.num_rows == 2
+        # remote dies (operator's machine went away)
+        svc.shutdown()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if not front._routable_slots(time.monotonic()):
+                break
+            time.sleep(0.1)
+        assert not front._routable_slots(time.monotonic()), \
+            "dead adopted sidecar never left the rotation"
+        with pytest.raises((ServiceBusyError, ServiceUnavailableError,
+                            ParseServiceError)):
+            with ParseServiceClient(front.host, front.port, "combined",
+                                    FIELDS, busy_retries=0,
+                                    connect_retries=0) as c:
+                c.parse(LINES)
+    finally:
+        front.shutdown()
+        svc.shutdown()

@@ -158,3 +158,73 @@ def test_full_step_batch_parallel_matches_single():
     mesh = make_mesh(n_data=8)
     dp = np.asarray(batch_parallel_runner(parser.units, mesh)(buf, lengths))
     np.testing.assert_array_equal(dp, ref)
+
+
+# ---------------------------------------------------------------------------
+# data_parallel on the PRODUCT hot path (round 16, docs/JOBS.md "Pod
+# jobs"): TpuBatchParser(data_parallel=N) lays the jitted executor over a
+# 'data'-axis mesh with NamedSharding in/out — results must be
+# byte-identical to the unsharded parser on every ingest path.
+# ---------------------------------------------------------------------------
+
+
+def test_parser_data_parallel_width_resolution():
+    from logparser_tpu.parallel import dp_device_count
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    assert dp_device_count(8) == 8
+    assert dp_device_count(5) == 4  # largest power of two that fits
+    assert dp_device_count(1) == 1
+    p = TpuBatchParser("%h %u %>s", ["IP:connection.client.host"],
+                       data_parallel=1)
+    assert p.mesh_devices == 1 and p._mesh is None  # 1-wide = no mesh
+
+
+def test_parser_data_parallel_parse_parity():
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    fields = ["IP:connection.client.host", "STRING:request.status.last"]
+    solo = TpuBatchParser("%h %u %>s", fields)
+    dp = TpuBatchParser("%h %u %>s", fields, data_parallel=8)
+    assert dp.mesh_devices == 8
+    lines = [f"1.2.3.{i % 250} u{i} {200 + i % 5}".encode()
+             for i in range(100)]
+    lines[7] = b"garbage ! line"
+    a = solo.parse_batch(lines, emit_views=False)
+    b = dp.parse_batch(lines, emit_views=False)
+    np.testing.assert_array_equal(np.asarray(a.valid), np.asarray(b.valid))
+    assert a.to_dict() == b.to_dict()
+    # blob + stream paths shard identically (the job runner's paths)
+    blob = b"\n".join(lines)
+    np.testing.assert_array_equal(
+        np.asarray(solo.parse_blob(blob, emit_views=False).valid),
+        np.asarray(dp.parse_blob(blob, emit_views=False).valid),
+    )
+    outs_a = [r.to_dict() for r in solo.parse_batch_stream(
+        [lines, lines[:33]], emit_views=False)]
+    outs_b = [r.to_dict() for r in dp.parse_batch_stream(
+        [lines, lines[:33]], emit_views=False)]
+    assert outs_a == outs_b
+
+
+@pytest.mark.slow  # combined-format compile x 2 executors
+def test_parser_data_parallel_combined_product_path():
+    """The full combined pipeline under data_parallel, device view rows
+    included (the parse_batch product path), against the unsharded
+    parser — Arrow IPC bytes identical."""
+    from logparser_tpu.tools.demolog import (
+        HEADLINE_FIELDS,
+        generate_combined_lines,
+    )
+    from logparser_tpu.tpu.arrow_bridge import table_to_ipc_bytes
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    lines = generate_combined_lines(200, seed=5, garbage_fraction=0.04)
+    solo = TpuBatchParser("combined", HEADLINE_FIELDS)
+    dp = TpuBatchParser("combined", HEADLINE_FIELDS, data_parallel=8)
+    ra, rb = solo.parse_batch(lines), dp.parse_batch(lines)
+    assert table_to_ipc_bytes(
+        ra.to_arrow(include_validity=True, strings="copy")
+    ) == table_to_ipc_bytes(
+        rb.to_arrow(include_validity=True, strings="copy")
+    )

@@ -992,3 +992,114 @@ def test_drain_completes_queued_coalesce_entries():
         assert results.get(2) == 3, results.get(2)
         c1.close()
         c2.close()
+
+
+# ---------------------------------------------------------------------------
+# client batching hints: the coalesce_wait_ms CONFIG key (round 16,
+# PROTOCOL.md) — a latency-critical session caps the straggler window
+# its requests may hold a forming batch open; parsing, queue bounds, and
+# shed behavior are untouched.
+# ---------------------------------------------------------------------------
+
+
+def test_coalesce_window_end_takes_strictest_member():
+    """Unit: the formation window is the configured end clamped by every
+    claimed entry's own cap — the strictest session decides."""
+    from logparser_tpu.service_batching import _Entry, _KeyBatcher
+
+    now = time.monotonic()
+    default_end = now + 1.0
+    free = _Entry(b"a", 1, None)                      # no hint
+    tight = _Entry(b"b", 1, None, max_wait_t=now + 0.01)
+    zero = _Entry(b"c", 1, None, max_wait_t=now)
+    assert _KeyBatcher._window_end([free], default_end) == default_end
+    assert _KeyBatcher._window_end([free, tight], default_end) \
+        == tight.max_wait_t
+    assert _KeyBatcher._window_end([free, tight, zero], default_end) == now
+
+
+def test_coalesce_hint_submit_and_queue_bound():
+    """Unit: submit() stamps the cap from max_wait_s, and the bounded
+    queue sheds identically with or without the hint."""
+    from logparser_tpu.service_batching import (
+        BatchCoalescer,
+        CoalesceQueueFull,
+        _KeyBatcher,
+    )
+
+    co = BatchCoalescer(window_s=1.0, max_lines=64, queue_depth=2)
+    try:
+        b = _KeyBatcher(co, key="k", parser=None, seq=1)
+        b._ensure_thread_locked = lambda: None  # keep entries queued
+        e1 = b.submit(b"x", 1, None, max_wait_s=0.0)
+        assert e1.max_wait_t is not None and e1.max_wait_t <= \
+            time.monotonic()
+        e2 = b.submit(b"y", 1, None)
+        assert e2.max_wait_t is None
+        with pytest.raises(CoalesceQueueFull):
+            b.submit(b"z", 1, None, max_wait_s=0.0)
+        # drain the gauge we bumped
+        b.stop()
+    finally:
+        co.shutdown()
+
+
+def test_coalesce_wait_ms_zero_skips_straggler_window():
+    """Wire: with a HUGE coalesce window and a second live session on
+    the key (so the window would otherwise be paid), a session sending
+    coalesce_wait_ms=0 gets its (byte-identical) answer without sitting
+    out the window."""
+    corpus = generate_combined_lines(48, seed=9)
+    config = {"log_format": "combined", "fields": FIELDS,
+              "timestamp_format": None}
+    payload = _lines_payload(corpus)
+    with ParseService(coalesce=False) as solo:
+        _inject_parser(solo, config)
+        out = {}
+        _raw_parity_session(solo.host, solo.port,
+                            json.dumps(config).encode(), [payload],
+                            threading.Barrier(1), out, 0)
+        ref = out[0][0]
+    window_s = 6.0
+    with ParseService(coalesce=True,
+                      coalesce_window_ms=window_s * 1000.0) as svc:
+        _inject_parser(svc, config)
+        # A second idle session on the SAME parser key: should_wait()
+        # now says the window is worth paying, so an unhinted request
+        # would stall ~window_s for stragglers.
+        idle = socket.create_connection((svc.host, svc.port))
+        try:
+            _send_frame(idle, json.dumps(config).encode())
+            hinted = dict(config, coalesce_wait_ms=0)
+            out = {}
+            t0 = time.monotonic()
+            _raw_parity_session(svc.host, svc.port,
+                                json.dumps(hinted).encode(), [payload],
+                                threading.Barrier(1), out, 0)
+            elapsed = time.monotonic() - t0
+        finally:
+            idle.close()
+    kind, body = out[0][0]
+    assert kind == "arrow"
+    assert body == ref[1], "hinted response diverged from solo parse"
+    assert elapsed < window_s / 2, (
+        f"coalesce_wait_ms=0 still paid the straggler window "
+        f"({elapsed:.2f}s of {window_s}s)"
+    )
+
+
+def test_coalesce_wait_ms_invalid_is_config_error():
+    with ParseService() as svc:
+        sock = socket.create_connection((svc.host, svc.port))
+        try:
+            _send_frame(sock, json.dumps({
+                "log_format": "%h %u %>s",
+                "fields": ["IP:connection.client.host"],
+                "coalesce_wait_ms": -5,
+            }).encode())
+            _send_frame(sock, _lines_payload(["1.2.3.4 u 200"]))
+            kind, body = _recv_response(sock)
+            assert kind == "error"
+            assert b"coalesce_wait_ms" in body
+        finally:
+            sock.close()
