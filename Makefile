@@ -1,7 +1,15 @@
 # Dev entry points (the reference's Maven/devtools tier, L0).
 PY ?= python
 
-.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke pod-smoke bench native clean
+# Hard wall-clock ceiling on every smoke drill: a wedged device (or a
+# deadlocked drill) must fail THIS step in minutes, not hang the CI job
+# until its global limit (docs/FAULTS.md).  -k 10 escalates to SIGKILL
+# when the SIGTERM grace expires — the drills' subprocess trees are
+# kill-safe by design (that is half of what they drill).
+SMOKE_TIMEOUT ?= 600
+SMOKE = timeout -k 10 $(SMOKE_TIMEOUT)
+
+.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke pod-smoke device-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -23,7 +31,7 @@ test-fast:
 # missing stage metrics (docs/OBSERVABILITY.md).  CI runs this after the
 # fast tier.
 metrics-smoke:
-	$(PY) -m logparser_tpu.tools.metrics_smoke
+	$(SMOKE) $(PY) -m logparser_tpu.tools.metrics_smoke
 
 # Feeder smoke: the sharded ingest fabric (2 workers x 2 shard sizes x
 # 2 transports — zero-copy shared-memory ring AND the pickled escape
@@ -33,7 +41,7 @@ metrics-smoke:
 # segments after pool teardown (docs/FEEDER.md).  CI runs this after
 # metrics-smoke.
 feeder-smoke:
-	$(PY) -m logparser_tpu.tools.feeder_smoke
+	$(SMOKE) $(PY) -m logparser_tpu.tools.feeder_smoke
 
 # Chaos smoke: the fault-injection matrix (every fault class in
 # tools/chaos.py x ring+pickle transports at 2 real process workers) —
@@ -43,7 +51,7 @@ feeder-smoke:
 # /dev/shm segment may leak (docs/FEEDER.md "Failure model & recovery").
 # CI runs this after feeder-smoke.
 chaos-smoke:
-	$(PY) -m logparser_tpu.tools.chaos_smoke
+	$(SMOKE) $(PY) -m logparser_tpu.tools.chaos_smoke
 
 # Rescue smoke: dirty corpus with forced ~5% device rejects — the former
 # overflow class must stay on device (full-int64 decoder), the forced
@@ -51,7 +59,7 @@ chaos-smoke:
 # a throughput floor, and /metrics must expose the per-reason
 # oracle_routed_lines_total counters.  CI runs this after feeder-smoke.
 rescue-smoke:
-	$(PY) -m logparser_tpu.tools.rescue_smoke
+	$(SMOKE) $(PY) -m logparser_tpu.tools.rescue_smoke
 
 # Service smoke: the serving-tier robustness drill (docs/SERVICE.md) —
 # a loadgen burst at 2x the admission budget against a live sidecar must
@@ -61,7 +69,7 @@ rescue-smoke:
 # admitted work, and leak no session threads.  CI runs this after
 # chaos-smoke.
 service-smoke:
-	$(PY) -m logparser_tpu.tools.service_smoke
+	$(SMOKE) $(PY) -m logparser_tpu.tools.service_smoke
 
 # Coalesce smoke: the continuous-batching drill (docs/SERVICE.md
 # "Continuous batching") — K concurrent sessions with interleaved
@@ -73,7 +81,7 @@ service-smoke:
 # byte-identical payloads and drive live requests.  CI runs this after
 # service-smoke.
 coalesce-smoke:
-	$(PY) -m logparser_tpu.tools.coalesce_smoke
+	$(SMOKE) $(PY) -m logparser_tpu.tools.coalesce_smoke
 
 # Fleet smoke: the replicated front tier's failover drill
 # (docs/SERVICE.md "Fleet") — a front over 3 real sidecar processes
@@ -84,7 +92,7 @@ coalesce-smoke:
 # merged fleet /metrics exposition valid.  CI runs this after
 # coalesce-smoke.
 fleet-smoke:
-	$(PY) -m logparser_tpu.tools.fleet_smoke
+	$(SMOKE) $(PY) -m logparser_tpu.tools.fleet_smoke
 
 # Job smoke: the durable batch tier's kill-drill (docs/JOBS.md) — run a
 # corpus->sharded-Arrow job, SIGKILL (-9) it mid-run from outside, and
@@ -93,7 +101,7 @@ fleet-smoke:
 # never be re-parsed, and no temp file or shm segment may leak.  CI
 # runs this after service-smoke.
 job-smoke:
-	$(PY) -m logparser_tpu.tools.job_smoke
+	$(SMOKE) $(PY) -m logparser_tpu.tools.job_smoke
 
 # Pod smoke: the pod-scale fabric's kill drill (docs/JOBS.md "Pod
 # jobs") — a 2-host pod (each host a real subprocess of the per-host
@@ -104,7 +112,19 @@ job-smoke:
 # the pod_* metric families live and zero leaked shm/tmp.  CI runs
 # this after job-smoke.
 pod-smoke:
-	$(PY) -m logparser_tpu.tools.pod_smoke
+	$(SMOKE) $(PY) -m logparser_tpu.tools.pod_smoke
+
+# Device smoke: the device-tier fault drills (docs/FAULTS.md) — each
+# chaos-injected device fault (RESOURCE_EXHAUSTED mid-stream, sticky
+# OOM -> bucket clamp, wedged execution under the deadline, failed jit
+# compile -> oracle demotion, byte-budget structured reject) must
+# recover with output BYTE-IDENTICAL to the undisturbed run and zero
+# aborted batches, with the same parser instance still serving every
+# ingest surface afterwards; plus the jobs CLI's SIGTERM preemption
+# drill (exit 3, resume re-parses zero committed shards).  CI runs
+# this after pod-smoke.
+device-smoke:
+	$(SMOKE) $(PY) -m logparser_tpu.tools.device_chaos_smoke
 
 lint:
 	$(PY) -m ruff check logparser_tpu tests

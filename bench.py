@@ -170,6 +170,31 @@ JOBS_BATCH_LINES = CONFIG_BATCH
 POD_SCALING_GATE = 0.8
 POD_SCALING_ITERS = 4
 POD_SCALING_PASSES = 2
+# Device-fault drill (round 17, docs/FAULTS.md): the same headline
+# corpus streamed undisturbed and again under injected device chaos —
+# one RESOURCE_EXHAUSTED on a full bucket (must bisect + retry) and one
+# wedged execution under the abandonable deadline (must expire and
+# reroute to the batched oracle) in the SAME faulted run.  Gates, all
+# in-run (container-valid): output byte-identical (content hash over
+# copy-mode Arrow IPC), zero aborted batches, throughput retention >=
+# the floor, and the recovery counters actually moved.  The
+# fail_compile leg gates byte-identity + demotion only — a demoted
+# parser's floor is the oracle rate (gated elsewhere), so its retention
+# is recorded informationally.  Interleaved best-of-N per side (the
+# ring-A/B pattern) absorbs scheduler jitter.
+DEVICE_FAULT_RETENTION_GATE = 0.70
+DEVICE_FAULT_BATCH = 4096
+# The timed stream repeats the 16-batch headline corpus so the faulted
+# run's FIXED costs (one expired deadline + one oracle-rescued batch +
+# one bisect retry, ~0.5 s on the dev container) amortize over a steady
+# window the gate can measure — the FAULT_CORPUS_SCALE reasoning one
+# tier down.  The compile drill rides a short stream (parity + demotion
+# need no steady window; a demoted run is oracle-rate by design).
+DEVICE_FAULT_STREAM_REPEATS = 6
+DEVICE_FAULT_COMPILE_BATCHES = 4
+DEVICE_FAULT_PASSES = 2
+DEVICE_WEDGE_DEADLINE_S = 0.3
+DEVICE_WEDGE_SECONDS = 1.2
 
 GEO_TEST_DATA = "/root/reference/GeoIP2-TestData/test-data"
 if not os.path.isdir(GEO_TEST_DATA):
@@ -914,9 +939,178 @@ def bench_pod(parser, lines, buf, lengths):
             "wall_single_host_s": round(single_wall, 4),
             "wall_pod_total_s": round(pod_wall, 4),
         }
+
+        # ---- (c) SIGTERM preemption leg (round 17, docs/JOBS.md
+        # "Preemption"): a host stopped CLEANLY at a commit boundary
+        # (the in-process twin of the CLI's SIGTERM handler — the same
+        # JobPolicy.stop_event the handler sets) must resume with ZERO
+        # re-parsed shards and merge byte-identical — the cheap exit
+        # the preemption notice buys over the SIGKILL crash path.
+        import threading
+
+        notice = threading.Event()
+        notice.set()  # preemption already signalled: stop at the first
+        # commit boundary this run reaches (deterministic)
+        h0p = run_job(spec("preempt", n_hosts=2, host_index=0),
+                      parser=parser)
+        pre = run_job(spec("preempt", n_hosts=2, host_index=1),
+                      parser=parser,
+                      policy=JobPolicy(stop_event=notice))
+        revived_p = run_job(spec("preempt", n_hosts=2, host_index=1),
+                            parser=parser)
+        merged_p = merge_manifests(spec("preempt").out_dir)
+        pre_hash = merged_hash(spec("preempt").out_dir,
+                               JobManifest.load(spec("preempt").out_dir))
+        section["preempt_drill"] = {
+            "preempted": pre.preempted,
+            "committed_at_preemption": pre.committed,
+            "skipped_on_resume": revived_p.skipped,
+            "committed_never_reparsed":
+                revived_p.skipped == pre.committed and pre.committed >= 1
+                and h0p.complete,
+            "merged_shards": len(merged_p.shards),
+            "byte_identical": pre_hash == ref_hash,
+        }
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
     return section
+
+
+def bench_device_faults(lines):
+    """The device-tier fault drill (round 17, docs/FAULTS.md): stream
+    the headline corpus undisturbed, then under injected device chaos
+    (an OOM that must bisect + a wedged execution that must expire on
+    the deadline and reroute to the oracle), then through a
+    compile-failure demotion — every faulted run must complete with
+    output byte-identical to the undisturbed one and zero aborts."""
+    import hashlib
+
+    from logparser_tpu.observability import metrics
+    from logparser_tpu.tpu.arrow_bridge import (
+        batch_to_arrow,
+        table_to_ipc_bytes,
+    )
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    batches = [
+        lines[i: i + DEVICE_FAULT_BATCH]
+        for i in range(0, len(lines), DEVICE_FAULT_BATCH)
+    ] * DEVICE_FAULT_STREAM_REPEATS
+    total = sum(len(b) for b in batches)
+
+    def counter(name):
+        from logparser_tpu.observability import counter_sum
+
+        return counter_sum(name)
+
+    aborted = 0
+
+    def run(parser, stream):
+        nonlocal aborted
+        h = hashlib.blake2b()
+        n = 0
+        t0 = time.perf_counter()
+        for res in parser.parse_batch_stream(stream, emit_views=False):
+            n += 1
+            h.update(table_to_ipc_bytes(
+                batch_to_arrow(res, strings="copy")))
+        # A stream that raises errors the whole section; a stream that
+        # silently DROPS a batch is the other abort class — count it.
+        aborted += len(stream) - n
+        return h.hexdigest(), time.perf_counter() - t0
+
+    # One parser for the undisturbed/oom/wedge sides: the deadline is
+    # armed on BOTH (symmetric overhead), every jit bucket warms before
+    # the first timed window — including the HALF bucket the OOM bisect
+    # executes (a cold compile inside the armed deadline would read as
+    # a wedge, the coalesce-bench precedent).  The wedge aims PAST the
+    # OOM's bisect executions via after= (batch 1 = executions 1-3 with
+    # its two retry halves; a wedge landing INSIDE the bisect would
+    # reroute the whole batch and the retry path would never complete),
+    # and the clamp threshold is lifted out of reach: one absorbed OOM
+    # per faulted pass would otherwise cross the default
+    # oom_clamp_after=2 on pass two and permanently clamp the parser
+    # mid-drill (the clamp path has its own drills in device-smoke and
+    # tests).
+    from logparser_tpu.tpu.device_faults import DeviceFaultPolicy
+
+    chaos = (
+        f"oom_batch:count=1:min_lines={DEVICE_FAULT_BATCH}"
+        f";wedge_device:count=1:seconds={DEVICE_WEDGE_SECONDS}:after=8"
+    )
+    parser = TpuBatchParser(
+        "combined", HEADLINE_FIELDS, view_fields=(),
+        execute_deadline_s=DEVICE_WEDGE_DEADLINE_S,
+        fault_policy=DeviceFaultPolicy(oom_clamp_after=10 ** 9),
+    )
+    try:
+        short = batches[:DEVICE_FAULT_COMPILE_BATCHES]
+        ref_digest, _ = run(parser, batches)  # compile + warm
+        parser.parse_batch(
+            lines[: DEVICE_FAULT_BATCH // 2], emit_views=False
+        )  # warm the bisect half-bucket
+        ref_short, _ = run(parser, short)
+        und_walls, flt_walls = [], []
+        oom_before = counter("device_oom_retries_total")
+        reroute_before = counter("device_fault_reroutes_total")
+        byte_identical = True
+        for _ in range(DEVICE_FAULT_PASSES):  # interleaved A/B
+            parser.arm_device_chaos(None)
+            d, w = run(parser, batches)
+            byte_identical &= d == ref_digest
+            und_walls.append(w)
+            parser.arm_device_chaos(chaos)  # re-arms: one oom + one wedge
+            d, w = run(parser, batches)
+            byte_identical &= d == ref_digest
+            flt_walls.append(w)
+        parser.arm_device_chaos(None)
+        oom_retries = counter("device_oom_retries_total") - oom_before
+        reroutes = counter("device_fault_reroutes_total") - reroute_before
+
+        # Compile-failure demotion on a FRESH parser (sticky by design),
+        # over the short stream: parity + demotion need no steady
+        # window — a demoted run is oracle-rate by construction.
+        comp = TpuBatchParser(
+            "combined", HEADLINE_FIELDS, view_fields=(),
+        )
+        try:
+            comp.parse_batch(short[0], emit_views=False)  # warm
+            comp.arm_device_chaos("fail_compile")
+            comp_digest, comp_wall = run(comp, short)
+            comp_drill = {
+                "byte_identical": comp_digest == ref_short,
+                "demoted": comp.device_fault_stats()["state"] == "demoted",
+                "demoted_lines_per_sec": round(
+                    sum(len(b) for b in short) / comp_wall, 1
+                ) if comp_wall else 0.0,
+            }
+        finally:
+            comp.close()
+    finally:
+        parser.close()
+
+    und_wall = min(und_walls)
+    flt_wall = min(flt_walls)
+    return {
+        "corpus_lines": total,
+        "batch_lines": DEVICE_FAULT_BATCH,
+        "execute_deadline_s": DEVICE_WEDGE_DEADLINE_S,
+        "undisturbed_lines_per_sec": round(total / und_wall, 1),
+        "faulted_lines_per_sec": round(total / flt_wall, 1),
+        "throughput_retention": round(
+            und_wall / flt_wall, 4) if flt_wall else 0.0,
+        "byte_identical": byte_identical,
+        "aborts": int(aborted),
+        "oom_retries": int(oom_retries),
+        "fault_reroutes": int(reroutes),
+        # One reroute per faulted pass = the wedge and ONLY the wedge:
+        # more means a fault escaped its recovery path (e.g. the OOM
+        # bisect failed and the whole batch fell to the oracle).
+        "expected_reroutes": DEVICE_FAULT_PASSES,
+        "compile_drill": comp_drill,
+        "wall_undisturbed_s": round(und_wall, 4),
+        "wall_faulted_s": round(flt_wall, 4),
+    }
 
 
 def multicore_host() -> bool:
@@ -1895,6 +2089,14 @@ def main():
     except Exception as e:  # noqa: BLE001 — the drill must not kill the run
         pod_section = {"error": f"{type(e).__name__}: {e}"}
 
+    # ---- device_faults: the device-tier fault drill (round 17) ----------
+    # Clean-phase (wall-clock ratios; fresh parsers compile before their
+    # timed windows).
+    try:
+        device_faults_section = bench_device_faults(lines)
+    except Exception as e:  # noqa: BLE001 — the drill must not kill the run
+        device_faults_section = {"error": f"{type(e).__name__}: {e}"}
+
     # ---- all five BASELINE configs: host-side phase ---------------------
     # Strict two-phase order: every HOST measurement (oracle, Arrow) for
     # every config BEFORE the first kernel_rate call — the xplane parse
@@ -2198,6 +2400,84 @@ def main():
                 f"scaling efficiency {pod_eff:.2f} below the "
                 f"{POD_SCALING_GATE} linear floor"
             )
+        # Round 17: the SIGTERM preemption leg — a cleanly-preempted
+        # host's resume must re-parse ZERO committed shards and the
+        # merge must stay byte-identical (always hard, in-run).
+        pd = pod_section.get("preempt_drill", {})
+        if not pd.get("preempted"):
+            gate_failures.append(
+                "pod: the preemption stop never landed (report carries "
+                "no preempted flag)"
+            )
+        if not pd.get("committed_never_reparsed"):
+            gate_failures.append(
+                "pod: preempted host's resume re-parsed committed "
+                "shards (the clean exit must be cheaper than a crash)"
+            )
+        if not pd.get("byte_identical"):
+            gate_failures.append(
+                "pod: preempted+resumed pod output not byte-identical "
+                "to the single-host run"
+            )
+    # (e4c) Device-fault gate (round 17): under injected oom_batch +
+    #       wedge_device chaos a full parse run must complete with
+    #       output BYTE-IDENTICAL to the undisturbed run, zero aborted
+    #       batches, recovery counters moved, and throughput retention
+    #       >= the floor; fail_compile must demote to the oracle and
+    #       stay byte-identical (its retention is informational — the
+    #       demoted floor is the separately-gated oracle rate).  All
+    #       ratios in-run: container-valid.
+    if "error" in device_faults_section:
+        gate_failures.append(
+            f"device_faults: {device_faults_section['error']}")
+    else:
+        if not device_faults_section.get("byte_identical"):
+            gate_failures.append(
+                "device_faults: faulted stream output not "
+                "byte-identical to the undisturbed run"
+            )
+        if device_faults_section.get("aborts", 1):
+            gate_failures.append(
+                f"device_faults: {device_faults_section.get('aborts')} "
+                "aborted batches (must be zero)"
+            )
+        dev_ret = device_faults_section.get("throughput_retention", 0.0)
+        if dev_ret < DEVICE_FAULT_RETENTION_GATE:
+            gate_failures.append(
+                f"device_faults: throughput retention {dev_ret:.2f} "
+                f"under injected oom+wedge (below "
+                f"{DEVICE_FAULT_RETENTION_GATE:.0%})"
+            )
+        if device_faults_section.get("oom_retries", 0) < 1:
+            gate_failures.append(
+                "device_faults: the injected OOM never exercised the "
+                "bisect-retry path"
+            )
+        dev_rr = device_faults_section.get("fault_reroutes", 0)
+        dev_rr_want = device_faults_section.get("expected_reroutes", 1)
+        if dev_rr < 1:
+            gate_failures.append(
+                "device_faults: no faulted batch was rerouted to the "
+                "oracle (the wedge drill went dark)"
+            )
+        elif dev_rr != dev_rr_want:
+            gate_failures.append(
+                f"device_faults: {dev_rr} oracle reroutes, expected "
+                f"exactly {dev_rr_want} (one per injected wedge) — a "
+                "fault escaped its recovery path (e.g. the OOM bisect "
+                "never completed)"
+            )
+        comp_drill = device_faults_section.get("compile_drill", {})
+        if not comp_drill.get("byte_identical"):
+            gate_failures.append(
+                "device_faults: compile-demoted output not "
+                "byte-identical"
+            )
+        if not comp_drill.get("demoted"):
+            gate_failures.append(
+                "device_faults: fail_compile never demoted the parser "
+                "to the host oracle"
+            )
     # (e5) Coalesce gate (round 14): with N concurrent small-request
     #      clients on one shared format, the cross-session coalescer
     #      must BEAT per-session dispatch by the speedup floor, with
@@ -2419,6 +2699,10 @@ def main():
         # resumed, manifest-merged byte-identical (docs/JOBS.md "Pod
         # jobs").
         "pod": pod_section,
+        # The device-tier fault drill: injected OOM/wedge/compile chaos
+        # must recover byte-identically with zero aborts and gated
+        # throughput retention (docs/FAULTS.md).
+        "device_faults": device_faults_section,
         # This round's hardware + the recorded-floor baseline's: floor
         # comparisons hard-gate only on matching hardware; otherwise
         # they land in cross_hardware_deltas (informational, per the
@@ -2567,7 +2851,8 @@ def main():
             }
         ),
         # Pod drill (round 16): scaling efficiency 1->N local devices
-        # (gateable only with real chips) + the pod kill-drill verdict.
+        # (gateable only with real chips) + the pod kill-drill verdict
+        # + (round 17) the SIGTERM preemption-leg verdict.
         "pod": (
             {"error": True} if "error" in pod_section else {
                 "eff": pod_section.get("scaling_efficiency"),
@@ -2578,6 +2863,27 @@ def main():
                         "byte_identical")
                     and pod_section.get("kill_drill", {}).get(
                         "committed_never_reparsed")
+                ),
+                "preempt_ok": bool(
+                    pod_section.get("preempt_drill", {}).get(
+                        "byte_identical")
+                    and pod_section.get("preempt_drill", {}).get(
+                        "committed_never_reparsed")
+                ),
+            }
+        ),
+        # Device-fault drill (round 17): the compact proof the device
+        # tier survives — retention under injected oom+wedge, byte
+        # parity, and the compile-demotion verdict (docs/FAULTS.md).
+        "device_faults": (
+            {"error": True} if "error" in device_faults_section else {
+                "retention":
+                    device_faults_section["throughput_retention"],
+                "identical": device_faults_section["byte_identical"],
+                "reroutes": device_faults_section["fault_reroutes"],
+                "demote_ok": bool(
+                    device_faults_section.get("compile_drill", {}).get(
+                        "demoted")
                 ),
             }
         ),

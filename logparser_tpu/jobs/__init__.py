@@ -22,6 +22,7 @@ from .manifest import (  # noqa: F401
     merge_manifests,
 )
 from .runner import (  # noqa: F401
+    EXIT_PREEMPTED,
     JobPolicy,
     JobReport,
     JobSpec,

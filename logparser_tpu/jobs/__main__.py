@@ -14,17 +14,23 @@ Examples::
 
 Exit codes: 0 = job complete; 1 = one or more shards failed durably
 (resume retries them); 2 = configuration error (manifest mismatch,
-bad arguments).
+bad arguments); 3 = preempted — SIGTERM (the cloud-TPU preemption
+notice) was honored at a shard commit boundary: the manifest resumes
+exactly, an orchestrator should simply relaunch the same command
+(docs/JOBS.md "Preemption").
 """
 from __future__ import annotations
 
 import argparse
 import json
+import signal
 import sys
+import threading
 
 from .manifest import ManifestError, merge_manifests
 from .runner import (
     DEFAULT_JOB_BATCH_LINES,
+    EXIT_PREEMPTED,
     JobPolicy,
     JobSpec,
     run_job,
@@ -84,6 +90,31 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
+    # SIGTERM = the cloud-TPU preemption notice: finish/commit the
+    # current shard boundary, exit EXIT_PREEMPTED (resumable — cheaper
+    # than the SIGKILL path by exactly one replayed shard).  An
+    # immediate stop is SIGKILL, which the manifest already survives
+    # (docs/JOBS.md "Preemption").  The previous disposition is
+    # restored on the way out — an embedding process must not keep
+    # swallowing SIGTERM into a dead Event after main() returns.
+    stop = threading.Event()
+    try:
+        prev_sigterm = signal.signal(
+            signal.SIGTERM, lambda signum, frame: stop.set()
+        )
+    except ValueError:
+        prev_sigterm = None  # not the main thread: no handler, no stop
+    try:
+        return _main(args, stop)
+    finally:
+        if prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_sigterm)
+            except (ValueError, TypeError):
+                pass
+
+
+def _main(args, stop) -> int:
     spec = JobSpec(
         sources=list(args.sources),
         log_format=args.log_format,
@@ -99,7 +130,8 @@ def main(argv=None) -> int:
         data_parallel=args.data_parallel,
     )
     policy = JobPolicy(io_retries=args.io_retries,
-                       stop_after_shards=args.stop_after_shards)
+                       stop_after_shards=args.stop_after_shards,
+                       stop_event=stop)
     try:
         if args.merge_only:
             merged = merge_manifests(args.out_dir)
@@ -119,7 +151,9 @@ def main(argv=None) -> int:
         print(json.dumps({"error": str(e)}), file=sys.stderr)
         return 2
     print(json.dumps(report.as_dict()))
-    return 0 if not report.failed else 1
+    if report.failed:
+        return 1
+    return EXIT_PREEMPTED if report.preempted else 0
 
 
 if __name__ == "__main__":

@@ -64,6 +64,19 @@ def host_manifest_name(host_index: int) -> str:
     return HOST_MANIFEST_FMT.format(index=int(host_index))
 
 
+def count_committed_shards(out_dir: str, name: str = MANIFEST_NAME) -> int:
+    """Committed-shard count per the on-disk commit log, tolerant of an
+    absent/mid-rewrite file (atomic rename makes a torn read
+    impossible; an unreadable log simply counts 0).  The one home of
+    the poll the kill/preemption drills and the pod preemption watcher
+    all run."""
+    try:
+        with open(os.path.join(out_dir, name), "rb") as f:
+            return len(json.loads(f.read().decode()).get("shards", {}))
+    except (OSError, ValueError):
+        return 0
+
+
 def list_host_manifests(out_dir: str) -> List[Tuple[int, str]]:
     """``(host_index, filename)`` for every per-host manifest present in
     ``out_dir``, sorted by host index."""

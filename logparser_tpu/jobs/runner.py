@@ -63,6 +63,14 @@ LOG = logging.getLogger(__name__)
 
 DEFAULT_JOB_BATCH_LINES = 16384
 
+#: CLI exit code for a SIGTERM-clean (preempted) run: the current shard
+#: boundary was committed and the manifest resumes exactly — the
+#: cloud-TPU preemption notice's cheap exit (docs/JOBS.md "Preemption").
+#: Distinct from 1 (failed shards) and 2 (config error): an orchestrator
+#: relaunches a 3 unconditionally, resume re-parses zero committed
+#: shards.
+EXIT_PREEMPTED = 3
+
 
 @dataclass
 class JobSpec:
@@ -139,6 +147,13 @@ class JobPolicy:
     # run — models a kill landing on a commit boundary; the real
     # SIGKILL drill lives in tools/job_smoke.py.
     stop_after_shards: Optional[int] = None
+    # Graceful preemption: an Event-like object (``is_set() -> bool``)
+    # checked at every shard commit boundary — when set, the run
+    # commits the shard in flight, marks the report ``preempted``, and
+    # returns (the CLI installs its SIGTERM handler here and exits
+    # EXIT_PREEMPTED; docs/JOBS.md "Preemption").  Cheaper than the
+    # SIGKILL path by exactly one replayed shard.
+    stop_event: Optional[Any] = None
 
 
 @dataclass
@@ -158,6 +173,7 @@ class JobReport:
     payload_bytes: int = 0
     wall_s: float = 0.0
     stopped_early: bool = False  # JobPolicy.stop_after_shards tripped
+    preempted: bool = False      # JobPolicy.stop_event fired (SIGTERM)
     n_hosts: int = 1             # pod placement (1 = single-host job)
     host_index: int = 0
 
@@ -186,6 +202,7 @@ class JobReport:
             "bytes_per_sec": round(self.bytes_per_sec, 1),
             "complete": self.complete,
             "stopped_early": self.stopped_early,
+            **({"preempted": True} if self.preempted else {}),
             **({"n_hosts": self.n_hosts, "host_index": self.host_index}
                if self.n_hosts > 1 else {}),
         }
@@ -211,10 +228,19 @@ class _ShardAccumulator:
 
 
 def _split_chaos(chaos: Any):
-    """(pool ChaosSpec or None, WriterChaos or None) from whatever the
-    caller armed: a spec object, the string grammar, or the env var.
-    Worker faults go to the feeder fabric; io faults to the writer."""
-    from ..tools.chaos import IO_FAULTS, ChaosSpec, WriterChaos
+    """(pool ChaosSpec or None, WriterChaos or None, DeviceChaos or
+    None) from whatever the caller armed: a spec object, the string
+    grammar, or the env var.  Worker faults go to the feeder fabric, io
+    faults to the writer, device faults to the parser's fault layer;
+    pod faults (``preempt_host``) are the pod runner's and inert here."""
+    from ..tools.chaos import (
+        DEVICE_FAULTS,
+        IO_FAULTS,
+        POD_FAULTS,
+        ChaosSpec,
+        DeviceChaos,
+        WriterChaos,
+    )
 
     if chaos is None:
         spec = ChaosSpec.from_env()
@@ -223,12 +249,17 @@ def _split_chaos(chaos: Any):
     else:
         spec = chaos
     if spec is None:
-        return None, None
-    pool_faults = [f for f in spec.faults if f.kind not in IO_FAULTS]
+        return None, None, None
+    pool_faults = [
+        f for f in spec.faults
+        if f.kind not in IO_FAULTS | DEVICE_FAULTS | POD_FAULTS
+    ]
     writer = WriterChaos(spec)
+    device = DeviceChaos(spec)
     return (
         ChaosSpec(pool_faults) if pool_faults else None,
         writer if writer else None,
+        device if device else None,
     )
 
 
@@ -304,7 +335,7 @@ def run_job(
                        n_hosts=spec.n_hosts, host_index=spec.host_index)
     if report.skipped:
         reg.increment("job_shards_skipped_total", report.skipped)
-    pool_chaos, writer_chaos = _split_chaos(chaos)
+    pool_chaos, writer_chaos, device_chaos = _split_chaos(chaos)
     writer = JobWriter(out_dir, retries=policy.io_retries,
                        backoff_base_s=policy.io_backoff_s,
                        chaos=writer_chaos)
@@ -314,6 +345,15 @@ def run_job(
         return report
 
     own_parser = parser is None
+    # A caller-supplied parser joins the drill too (device faults belong
+    # to the parse step wherever the parser came from) but is handed
+    # back with its PRIOR arming restored in the finally below — a
+    # caller mid-drill of its own must not find its injections wiped.
+    armed_caller_parser = (not own_parser) and device_chaos is not None
+    prior_device_chaos = (
+        getattr(parser, "_device_chaos", None) if armed_caller_parser
+        else None
+    )
     if own_parser:
         from ..tpu.batch import TpuBatchParser
 
@@ -324,7 +364,15 @@ def run_job(
         parser = TpuBatchParser(
             spec.log_format, list(spec.fields), view_fields=(),
             data_parallel=spec.data_parallel,
+            device_chaos=device_chaos,
         )
+        if chaos is not None and device_chaos is None:
+            # An EXPLICIT chaos arg with no device faults must override
+            # the constructor's env fallback — the caller already chose
+            # this run's whole fault plan.
+            parser.arm_device_chaos(None)
+    elif armed_caller_parser:
+        parser.arm_device_chaos(device_chaos)
 
     # The pool runs over a RENUMBERED plan (FeederPool requires index ==
     # position); remaining[pool_index] maps back to the global shard.
@@ -411,7 +459,10 @@ def run_job(
     def _advance_to(pool_idx: Optional[int]) -> bool:
         """Commit the current shard and any EMPTY shards (no batches)
         between it and ``pool_idx`` (None = end of stream).  Returns
-        False when the stop_after_shards budget ran out."""
+        False when the stop_after_shards budget ran out or the
+        preemption stop_event fired — every commit boundary is a legal
+        stopping point (the shard just committed stays committed; the
+        manifest resumes exactly)."""
         nonlocal current, acc, commits_this_run
         end = pool_idx if pool_idx is not None else len(pool_shards)
         while current is not None and current < end:
@@ -420,6 +471,21 @@ def run_job(
             commits_this_run += 1
             if (policy.stop_after_shards is not None
                     and commits_this_run >= policy.stop_after_shards):
+                return False
+            if (policy.stop_event is not None
+                    and policy.stop_event.is_set()
+                    # Only with work still pending: a notice landing on
+                    # the FINAL commit must not turn a finished run
+                    # into a preempted one (the relaunch would be a
+                    # pure no-op and the report would read incomplete).
+                    and (pool_idx is not None or current + 1 < end)):
+                report.preempted = True
+                reg.increment("job_preempted_total")
+                LOG.warning(
+                    "job: preemption stop (SIGTERM) honored at the "
+                    "shard %d commit boundary — resume re-parses "
+                    "nothing committed", remaining[current].index,
+                )
                 return False
             current += 1
         current = end if pool_idx is not None else None
@@ -445,6 +511,14 @@ def run_job(
             return report
     finally:
         pool.close()
+        if armed_caller_parser:
+            # Hand the caller's parser back as received: the job's
+            # injections must not outlive it, and a chaos plan the
+            # caller had armed BEFORE the job must survive it.
+            try:
+                parser.arm_device_chaos(prior_device_chaos)
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                log_warning_once(LOG, f"job: chaos disarm failed: {e}")
         if own_parser:
             # A parser built here is ours to release: its oracle worker
             # pool / assembly threads must not outlive the job (a

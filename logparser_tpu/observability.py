@@ -546,6 +546,16 @@ def metrics() -> MetricsRegistry:
     return _GLOBAL_METRICS
 
 
+def counter_sum(name: str) -> float:
+    """Total of one counter family across all label sets — the drills'
+    "did this family move" helper (bench/smoke/tests share it so the
+    formatted-series key shape has one consumer-side home)."""
+    return sum(
+        v for k, v in _GLOBAL_METRICS.as_dict().items()
+        if k == name or k.startswith(name + "{")
+    )
+
+
 # ---------------------------------------------------------------------------
 # pipeline-stage instrumentation: registry (always) + tracer (when enabled)
 # + jax.profiler trace annotation (when enabled)

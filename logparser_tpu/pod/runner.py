@@ -32,6 +32,7 @@ import logging
 import os
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -42,9 +43,10 @@ from ..feeder.shards import (
     normalize_sources,
     plan_shards,
 )
-from ..jobs.manifest import ManifestError, merge_manifests
+from ..jobs.manifest import ManifestError, host_manifest_name, merge_manifests
 from ..jobs.runner import (
     DEFAULT_JOB_BATCH_LINES,
+    EXIT_PREEMPTED,
     JobPolicy,
     JobSpec,
     run_job,
@@ -112,6 +114,7 @@ class HostResult:
     returncode: Optional[int] = None
     report: Optional[Dict[str, Any]] = None  # the host job's as_dict()
     error: Optional[str] = None
+    preempted: bool = False      # a launch exited EXIT_PREEMPTED
 
     @property
     def ok(self) -> bool:
@@ -153,6 +156,7 @@ class PodReport:
                     "launches": h.launches,
                     "returncode": h.returncode,
                     "ok": h.ok,
+                    **({"preempted": True} if h.preempted else {}),
                     **({"error": h.error} if h.error else {}),
                     **({"committed": h.report.get("committed"),
                         "skipped": h.report.get("skipped"),
@@ -203,6 +207,31 @@ def _launch_host(spec: PodSpec, host_index: int,
     )
 
 
+def _committed_in_host_manifest(out_dir: str, host_index: int) -> int:
+    """Committed-shard count per the host's on-disk commit log."""
+    from ..jobs.manifest import count_committed_shards
+
+    return count_committed_shards(out_dir, host_manifest_name(host_index))
+
+
+def _preemption_watcher(out_dir: str, host_index: int, after: int,
+                        proc: subprocess.Popen,
+                        poll_s: float = 0.05) -> None:
+    """The ``preempt_host`` chaos drill: SIGTERM the host's jobs CLI
+    once its commit log holds ``after`` shards — the CLI must finish
+    the shard boundary in flight and exit EXIT_PREEMPTED, and the
+    relaunch must resume with zero re-parsed shards (docs/JOBS.md
+    "Preemption")."""
+    while proc.poll() is None:
+        if _committed_in_host_manifest(out_dir, host_index) >= after:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+            return
+        time.sleep(poll_s)
+
+
 def _host_report_from_stdout(text: str) -> Optional[Dict[str, Any]]:
     for line in reversed((text or "").splitlines()):
         line = line.strip()
@@ -231,14 +260,28 @@ def _run_host_inline(spec: PodSpec, host_index: int,
 
 
 def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
-            parser: Any = None) -> PodReport:
+            parser: Any = None, chaos: Any = None) -> PodReport:
     """Run (or resume) one pod job end to end: host wave, bounded
-    relaunch of dead/failed hosts, manifest merge.  ``parser`` is only
-    legal inline (subprocess hosts build their own); see module
-    docstring."""
+    relaunch of dead/failed/preempted hosts, manifest merge.  ``parser``
+    is only legal inline (subprocess hosts build their own).  ``chaos``
+    arms pod-tier fault injection (``preempt_host`` — subprocess mode
+    only; ChaosSpec / grammar string, default the LOGPARSER_TPU_CHAOS
+    env var); see module docstring."""
     policy = policy or PodPolicy()
     if spec.n_hosts < 1:
         raise ValueError(f"n_hosts must be positive, got {spec.n_hosts}")
+    from ..tools.chaos import ChaosSpec, PodChaos
+
+    if chaos is None:
+        chaos_spec = ChaosSpec.from_env()
+    elif isinstance(chaos, str):
+        chaos_spec = ChaosSpec.parse(chaos)
+    else:
+        chaos_spec = chaos
+    pod_chaos = PodChaos(chaos_spec) if chaos_spec is not None else None
+    # host -> committed-shard trigger; popped as each fires (once per
+    # pod run, so the relaunch completes clean — the recovery drill).
+    preempt_plan = pod_chaos.preempt_plan() if pod_chaos else {}
     t0 = time.perf_counter()
     reg = metrics()
     reg.increment("pod_runs_total")
@@ -273,6 +316,13 @@ def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
                 results[i].launches += 1
                 reg.increment("pod_hosts_launched_total")
                 procs[i] = _launch_host(spec, i, policy)
+                after = preempt_plan.pop(i, None)
+                if after is not None:
+                    threading.Thread(
+                        target=_preemption_watcher,
+                        args=(spec.out_dir, i, after, procs[i]),
+                        name=f"pod-preempt-{i}", daemon=True,
+                    ).start()
             reg.gauge_set("pod_hosts_alive", len(procs))
             deadline = time.monotonic() + policy.host_timeout_s
             for i, p in procs.items():
@@ -295,6 +345,21 @@ def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
             failed = [i for i in pending if not results[i].ok
                       and results[i].returncode != 2]
             for i in failed:
+                if results[i].returncode == EXIT_PREEMPTED:
+                    # The clean preemption exit: the host honored
+                    # SIGTERM at a commit boundary — a resume is free
+                    # (zero re-parsed shards), so a relaunch is the
+                    # whole recovery.
+                    results[i].preempted = True
+                    reg.increment("pod_host_preemptions_total")
+                    LOG.warning(
+                        "pod: host %d preempted (clean SIGTERM exit)%s",
+                        i,
+                        " — relaunching (resume re-parses zero "
+                        "committed shards)"
+                        if attempt < policy.host_retries else "",
+                    )
+                    continue
                 reg.increment("pod_host_failures_total")
                 LOG.warning("pod: host %d failed (rc=%s)%s", i,
                             results[i].returncode,

@@ -897,6 +897,23 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 except OSError:
                     return False
                 return True
+            from .tpu.device_faults import DeviceBudgetError
+
+            if isinstance(outcome, DeviceBudgetError):
+                # Pre-allocation device-byte ceiling (docs/FAULTS.md):
+                # the batch was refused BEFORE any device_put — a
+                # structured reject like the frame ceilings, not an
+                # opaque parse failure (the session survives; the
+                # client should split its payload).
+                reg.increment("service_rejected_frames_total",
+                              labels={"reason": "device_budget"})
+                LOG.warning("sess=%d request rejected (device_budget): "
+                            "%s", self.sid, outcome)
+                try:
+                    write_error(sock, f"parse failed: {outcome}")
+                except OSError:
+                    return False
+                return True
             LOG.error("sess=%d parse failed", self.sid, exc_info=outcome)
             reg.increment("service_request_errors_total")
             try:

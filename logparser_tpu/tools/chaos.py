@@ -69,6 +69,36 @@ workers or the job writer):
   (re)reports ready, M times (default 3): the crash loop the circuit
   breaker must open around.
 
+Device-tier fault primitives (armed by ``TpuBatchParser`` — from the
+env var at construction or ``arm_device_chaos`` — and consulted once
+per device execution; inert everywhere else, docs/FAULTS.md):
+
+- ``oom_batch[:count=M][:min_lines=N][:after=K][:sticky=1]`` — raise
+  an injected ``RESOURCE_EXHAUSTED`` (:class:`DeviceOomError`) from
+  executions of >= N lines (default 0 = every execution).  With
+  ``min_lines`` set, bisected halves below the threshold SUCCEED —
+  the OOM-recovery drill; ``sticky=1`` keeps firing (the bucket-clamp
+  drill).
+- ``wedge_device[:count=M][:seconds=X][:after=K]`` — the execution
+  sleeps X seconds (default 30) before fetching: with the parser's
+  execution deadline armed, the batch expires and reroutes to the
+  oracle.
+- ``fail_compile[:count=M][:after=K]`` — raise an injected compile
+  failure (:class:`DeviceCompileError`): the parser key must demote to
+  the host oracle (warn-once + counter), never raise out of the parse.
+
+``after=K`` arms a device fault only from the K+1-th device execution
+on (0 = immediately; bisect retry chunks count as executions too — a
+drill that must not land inside another fault's recovery aims past it).
+
+Pod-tier fault primitive (armed by ``pod.run_pod`` in subprocess mode;
+the cloud-TPU preemption notice drill, docs/JOBS.md):
+
+- ``preempt_host:host=H[:after=N]`` — SIGTERM host H's jobs CLI once
+  its per-host manifest holds N committed shards (default 1): the CLI
+  must finish the current shard boundary and exit with the resumable
+  preemption code; the relaunch resumes with zero re-parsed shards.
+
 ``worker=W`` restricts a worker fault to one worker id (default: all).
 ``sticky=1`` makes a fault survive respawns/retries (default only for
 ``poison_shard``); everything else fires ``count`` times (worker faults:
@@ -98,6 +128,8 @@ _KNOWN = {
     "slot_overflow", "drop_done", "delay_put",
     "io_error", "enospc",
     "kill_sidecar", "wedge_sidecar", "flap_sidecar",
+    "oom_batch", "wedge_device", "fail_compile",
+    "preempt_host",
 }
 
 #: Consumer-side fault kinds: armed by the durable-job writer, inert in
@@ -107,6 +139,14 @@ IO_FAULTS = {"io_error", "enospc"}
 #: Front-tier fault kinds: armed by logparser_tpu/front.py's fleet
 #: supervision, inert everywhere else.
 FRONT_FAULTS = {"kill_sidecar", "wedge_sidecar", "flap_sidecar"}
+
+#: Device-tier fault kinds: armed by TpuBatchParser's fault layer
+#: (docs/FAULTS.md), inert in feeder workers / writer / front.
+DEVICE_FAULTS = {"oom_batch", "wedge_device", "fail_compile"}
+
+#: Pod-tier fault kinds: armed by pod.run_pod's subprocess mode (the
+#: cloud-TPU preemption drill), inert everywhere else.
+POD_FAULTS = {"preempt_host"}
 
 
 class _ChaosHardExit(BaseException):
@@ -312,6 +352,88 @@ class FrontChaos:
                 self._flaps[idx] = n + 1
                 return True
         return False
+
+
+class DeviceChaos:
+    """Device-tier fault injection (``tpu/batch.py``'s fault layer,
+    docs/FAULTS.md): :meth:`on_execute` is consulted once per device
+    execution — the dispatch+fetch of one padded batch, including each
+    bisected retry chunk — and either raises an injected typed fault
+    (oom/compile) or returns seconds to wedge (the execution sleeps, so
+    an armed deadline expires exactly like a hung kernel).  Every hook
+    is a no-op when the spec carries no device faults.  jax-free: the
+    typed faults import from ``tpu.device_faults``, which never touches
+    the device runtime."""
+
+    def __init__(self, spec: ChaosSpec):
+        self.faults = [f for f in spec.faults if f.kind in DEVICE_FAULTS]
+        self._fired: Dict[int, int] = {}
+        self.executions = 0
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def fired(self, kind: Optional[str] = None) -> int:
+        """How many injections have fired (optionally of one kind) —
+        drills assert recovery stopped re-triggering faults."""
+        return sum(
+            n for idx, n in self._fired.items()
+            if kind is None or self.faults[idx].kind == kind
+        )
+
+    def on_execute(self, n_lines: int) -> Optional[float]:
+        from ..tpu.device_faults import DeviceCompileError, DeviceOomError
+
+        self.executions += 1
+        for idx, f in enumerate(self.faults):
+            fired = self._fired.get(idx, 0)
+            if not f.sticky and fired >= int(f.param("count", 1)):
+                continue
+            if self.executions <= int(f.param("after", 0)):
+                continue
+            if f.kind == "oom_batch":
+                if n_lines >= int(f.param("min_lines", 0)):
+                    self._fired[idx] = fired + 1
+                    raise DeviceOomError(
+                        "chaos: injected RESOURCE_EXHAUSTED: out of "
+                        f"memory executing a {n_lines}-line device batch"
+                    )
+            elif f.kind == "fail_compile":
+                self._fired[idx] = fired + 1
+                raise DeviceCompileError(
+                    "chaos: injected XLA compilation failure"
+                )
+            elif f.kind == "wedge_device":
+                self._fired[idx] = fired + 1
+                return float(f.param("seconds", 30.0))
+        return None
+
+
+class PodChaos:
+    """Pod-tier fault injection (``pod/runner.py`` subprocess mode):
+    :meth:`preempt_plan` maps host index -> committed-shard count after
+    which the pod runner SIGTERMs that host's jobs CLI — the cloud-TPU
+    preemption-notice drill (docs/JOBS.md "Preemption")."""
+
+    def __init__(self, spec: ChaosSpec):
+        self.faults = [f for f in spec.faults if f.kind in POD_FAULTS]
+        for f in self.faults:
+            if f.kind == "preempt_host" and f.param("host") is None:
+                # Fail LOUD at arm time: a silently-dropped fault reads
+                # as a green drill that never ran.
+                raise ValueError(
+                    "preempt_host requires host=<index> (which pod "
+                    "host to SIGTERM)"
+                )
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def preempt_plan(self) -> Dict[int, int]:
+        return {
+            int(f.param("host")): int(f.param("after", 1))
+            for f in self.faults if f.kind == "preempt_host"
+        }
 
 
 class WriterChaos:

@@ -1,0 +1,361 @@
+"""CI device smoke: the device-tier fault drills (docs/FAULTS.md).
+
+The device was the last unsupervised single point of failure (every
+process tier already drills its kills in CI); this smoke produces each
+device-fault class ON PURPOSE via the chaos grammar and fails (exit 1)
+unless the fault layer recovers with BYTE-IDENTICAL output and zero
+aborts:
+
+- ``oom_batch`` — an injected RESOURCE_EXHAUSTED mid-stream must bisect
+  and retry (``device_oom_retries_total`` moves), and the SAME parser
+  instance must keep serving ``parse_batch``/``parse_blob``/
+  ``parse_encoded`` byte-identically afterwards (no poisoned state);
+- sticky ``oom_batch`` — repeated OOMs must permanently clamp the max
+  executed bucket (``device_bucket_clamped`` gauge) so later batches
+  pre-split BEFORE any device_put (no further injections fire);
+- ``wedge_device`` + an armed execution deadline — a wedged execution
+  must expire on the abandonable worker and reroute the batch to the
+  batched oracle host path, never hang the stream;
+- ``fail_compile`` — a jit compile failure must demote the parser key
+  to the host oracle (warn-once + ``device_compile_failures_total``)
+  and the demoted parser must keep answering exactly;
+- the pre-allocation byte budget must answer a structured
+  ``DeviceBudgetError`` BEFORE any device_put (never an XLA OOM);
+- the jobs CLI must honor SIGTERM (the cloud-TPU preemption notice) at
+  a shard commit boundary: exit code 3 (resumable), resume re-parses
+  ZERO committed shards, merged output byte-identical to a single-shot
+  run — the clean-preemption twin of job_smoke's SIGKILL drill;
+- the ``device_*`` metric families land in the registry and the
+  rendered Prometheus exposition stays structurally valid.
+
+Usage::
+
+    make device-smoke
+    python -m logparser_tpu.tools.device_chaos_smoke
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+N_LINES = 6000
+BATCH = 1024
+FMT = "%h %u %>s"
+FIELDS = ["IP:connection.client.host", "STRING:request.status.last"]
+
+# SIGTERM drill geometry (the job_smoke shape, smaller; the fast poll
+# keeps the signal landing mid-run even when commits burst — the
+# job_smoke corpus-sizing note applies here too).
+JOB_LINES = 16000
+JOB_SHARD_BYTES = 48 << 10
+JOB_BATCH_LINES = 1024
+TERM_POLL_S = 0.05
+TERM_TIMEOUT_S = 300.0
+
+
+def _lines(n):
+    return [
+        b"10.0.%d.%d u%d %d" % ((i >> 8) % 256, i % 256, i, 200 + i % 7)
+        for i in range(n)
+    ]
+
+
+def _batches(lines):
+    return [lines[i: i + BATCH] for i in range(0, len(lines), BATCH)]
+
+
+def _stream_digest(parser, batches) -> str:
+    """Content hash over every batch's copy-mode Arrow IPC bytes — the
+    consumer-visible output the parity gates compare."""
+    from logparser_tpu.tpu.arrow_bridge import batch_to_arrow, table_to_ipc_bytes
+
+    h = hashlib.blake2b()
+    for result in parser.parse_batch_stream(batches, emit_views=False):
+        h.update(table_to_ipc_bytes(batch_to_arrow(result, strings="copy")))
+    return h.hexdigest()
+
+
+def _counter(name: str) -> float:
+    from logparser_tpu.observability import counter_sum
+
+    return counter_sum(name)
+
+
+def _job_corpus(path: str) -> None:
+    with open(path, "w") as f:
+        for i in range(JOB_LINES):
+            f.write(f"10.0.{(i >> 8) % 256}.{i % 256} u{i} "
+                    f"{200 + i % 7}\n")
+
+
+def _committed(out_dir: str) -> int:
+    from logparser_tpu.jobs.manifest import count_committed_shards
+
+    return count_committed_shards(out_dir)
+
+
+def _sigterm_drill(tmp: str, failures: list) -> None:
+    """SIGTERM the live jobs CLI mid-run: exit 3, resume re-parses zero
+    committed shards, merged output byte-identical to single-shot."""
+    from logparser_tpu.jobs import (
+        EXIT_PREEMPTED,
+        JobManifest,
+        JobSpec,
+        merged_hash,
+        run_job,
+    )
+
+    corpus = os.path.join(tmp, "job-corpus.log")
+    _job_corpus(corpus)
+
+    def spec(name):
+        return JobSpec([corpus], FMT, FIELDS, os.path.join(tmp, name),
+                       shard_bytes=JOB_SHARD_BYTES,
+                       batch_lines=JOB_BATCH_LINES)
+
+    ref = run_job(spec("term-ref"))
+    if not ref.complete:
+        failures.append(f"sigterm drill: reference run incomplete: "
+                        f"{ref.as_dict()}")
+        return
+    ref_hash = merged_hash(spec("term-ref").out_dir,
+                           JobManifest.load(spec("term-ref").out_dir))
+
+    term_dir = spec("termed").out_dir
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (
+        repo_root + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH") else repo_root
+    )
+    argv = [sys.executable, "-m", "logparser_tpu.jobs", corpus,
+            "--format", FMT, "--out", term_dir,
+            "--shard-bytes", str(JOB_SHARD_BYTES),
+            "--batch-lines", str(JOB_BATCH_LINES)]
+    for f in FIELDS:
+        argv += ["--field", f]
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, env=env, text=True)
+    deadline = time.monotonic() + TERM_TIMEOUT_S
+    while time.monotonic() < deadline:
+        if _committed(term_dir) >= 2 or proc.poll() is not None:
+            break
+        time.sleep(TERM_POLL_S)
+    if proc.poll() is not None:
+        failures.append("sigterm drill: CLI finished before the signal "
+                        "landed (shrink JOB_SHARD_BYTES)")
+        proc.communicate()
+        return
+    proc.send_signal(signal.SIGTERM)
+    try:
+        out, _ = proc.communicate(timeout=TERM_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        failures.append("sigterm drill: CLI never exited after SIGTERM "
+                        "(the commit-boundary stop is wedged)")
+        return
+    if proc.returncode != EXIT_PREEMPTED:
+        failures.append(
+            f"sigterm drill: exit code {proc.returncode}, expected the "
+            f"resumable EXIT_PREEMPTED ({EXIT_PREEMPTED})"
+        )
+    report = None
+    for line in reversed((out or "").splitlines()):
+        if line.strip().startswith("{"):
+            report = json.loads(line)
+            break
+    if not report or not report.get("preempted"):
+        failures.append(
+            f"sigterm drill: CLI report missing preempted flag: {report}"
+        )
+    committed_at_term = _committed(term_dir)
+    if committed_at_term < 1:
+        failures.append("sigterm drill: nothing committed before the "
+                        "preemption stop")
+    resumed = run_job(spec("termed"))
+    if not resumed.complete:
+        failures.append(f"sigterm drill: resume incomplete: "
+                        f"{resumed.as_dict()}")
+    if resumed.skipped != committed_at_term:
+        failures.append(
+            f"sigterm drill: resume re-parsed committed shards "
+            f"(skipped {resumed.skipped}, committed at preemption "
+            f"{committed_at_term})"
+        )
+    got = merged_hash(term_dir, JobManifest.load(term_dir))
+    if got != ref_hash:
+        failures.append("sigterm drill: preempted+resumed output is NOT "
+                        "byte-identical to the single-shot run")
+    print(f"device-smoke: sigterm drill rc={proc.returncode} "
+          f"committed_at_term={committed_at_term} "
+          f"skipped_on_resume={resumed.skipped} byte_identical="
+          f"{got == ref_hash}")
+
+
+def main() -> int:
+    from logparser_tpu.observability import metrics
+    from logparser_tpu.tools.metrics_smoke import validate_exposition
+    from logparser_tpu.tpu.batch import TpuBatchParser
+    from logparser_tpu.tpu.device_faults import (
+        DeviceBudgetError,
+        DeviceFaultPolicy,
+    )
+
+    failures: list = []
+    lines = _lines(N_LINES)
+    batches = _batches(lines)
+    blob = b"\n".join(lines)
+
+    parser = TpuBatchParser(FMT, FIELDS, device_chaos=None)
+    ref_digest = _stream_digest(parser, batches)
+    ref_batch = parser.parse_batch(lines[:BATCH]).to_dict()
+    ref_blob = parser.parse_blob(blob).to_dict()
+
+    # ---- oom_batch: bisect + retry, same instance keeps serving -------
+    p_oom = TpuBatchParser(
+        FMT, FIELDS,
+        device_chaos=f"oom_batch:count=1:min_lines={BATCH}",
+    )
+    before = _counter("device_oom_retries_total")
+    got = _stream_digest(p_oom, batches)
+    if got != ref_digest:
+        failures.append("oom drill: faulted stream NOT byte-identical")
+    if _counter("device_oom_retries_total") <= before:
+        failures.append("oom drill: device_oom_retries_total never moved")
+    # Parser-survives-fault: the SAME instance, every ingest surface.
+    if p_oom.parse_batch(lines[:BATCH]).to_dict() != ref_batch:
+        failures.append("oom drill: parse_batch diverged after the fault")
+    if p_oom.parse_blob(blob).to_dict() != ref_blob:
+        failures.append("oom drill: parse_blob diverged after the fault")
+    print(f"device-smoke: oom drill ok "
+          f"(retries={_counter('device_oom_retries_total'):.0f}, "
+          f"state={p_oom.device_fault_stats()['state']})")
+
+    # ---- sticky oom: the bucket clamp engages and injections stop ----
+    p_clamp = TpuBatchParser(
+        FMT, FIELDS,
+        device_chaos=f"oom_batch:sticky=1:min_lines={BATCH // 2 + 1}",
+        fault_policy=DeviceFaultPolicy(oom_clamp_after=2),
+    )
+    if _stream_digest(p_clamp, batches) != ref_digest:
+        failures.append("clamp drill: faulted stream NOT byte-identical")
+    stats = p_clamp.device_fault_stats()
+    if not stats["oom_clamp"] or stats["oom_clamp"] > BATCH // 2:
+        failures.append(f"clamp drill: bucket never clamped ({stats})")
+    fired_before = p_clamp._device_chaos.fired("oom_batch")
+    if _stream_digest(p_clamp, batches) != ref_digest:
+        failures.append("clamp drill: post-clamp stream NOT identical")
+    if p_clamp._device_chaos.fired("oom_batch") != fired_before:
+        failures.append(
+            "clamp drill: clamped batches still reached the device "
+            "above the clamp (injections kept firing)"
+        )
+    print(f"device-smoke: clamp drill ok (clamp={stats['oom_clamp']})")
+
+    # ---- wedge_device + deadline: expire and reroute, never hang -----
+    p_wedge = TpuBatchParser(
+        FMT, FIELDS, execute_deadline_s=0.5,
+        device_chaos="wedge_device:seconds=3:count=1",
+    )
+    before = _counter("device_fault_reroutes_total")
+    t0 = time.monotonic()
+    if _stream_digest(p_wedge, batches) != ref_digest:
+        failures.append("wedge drill: faulted stream NOT byte-identical")
+    wall = time.monotonic() - t0
+    if _counter("device_fault_reroutes_total") <= before:
+        failures.append("wedge drill: no oracle reroute recorded")
+    if wall > 60.0:
+        failures.append(f"wedge drill: stream took {wall:.0f}s — the "
+                        "deadline did not fire")
+    if p_wedge.parse_batch(lines[:BATCH]).to_dict() != ref_batch:
+        failures.append("wedge drill: parse_batch diverged afterwards")
+    print(f"device-smoke: wedge drill ok ({wall:.1f}s)")
+
+    # ---- fail_compile: demote to oracle, keep answering exactly ------
+    p_comp = TpuBatchParser(FMT, FIELDS, device_chaos="fail_compile")
+    before = _counter("device_compile_failures_total")
+    if _stream_digest(p_comp, batches) != ref_digest:
+        failures.append("compile drill: faulted stream NOT byte-identical")
+    if _counter("device_compile_failures_total") <= before:
+        failures.append("compile drill: failure counter never moved")
+    if p_comp.device_fault_stats()["state"] != "demoted":
+        failures.append("compile drill: parser was not demoted "
+                        f"({p_comp.device_fault_stats()})")
+    if p_comp.parse_batch(lines[:BATCH]).to_dict() != ref_batch:
+        failures.append("compile drill: demoted parse_batch diverged")
+    print("device-smoke: compile drill ok (demoted, exact)")
+
+    # ---- budget: structured reject BEFORE device_put -----------------
+    p_budget = TpuBatchParser(FMT, FIELDS, device_bytes_budget=256)
+    try:
+        p_budget.parse_batch(lines[:BATCH])
+        failures.append("budget drill: undersized budget never rejected")
+    except DeviceBudgetError as e:
+        if e.estimated_bytes <= e.budget_bytes:
+            failures.append(f"budget drill: nonsense estimate {e}")
+    p_roomy = TpuBatchParser(FMT, FIELDS, device_bytes_budget=1 << 30)
+    if p_roomy.parse_batch(lines[:BATCH]).to_dict() != ref_batch:
+        failures.append("budget drill: roomy budget changed the output")
+    print("device-smoke: budget drill ok (structured reject)")
+
+    # ---- parse_encoded survives a fault (feeder-framed surface) ------
+    from logparser_tpu.native import encode_blob
+    from logparser_tpu.feeder.worker import EncodedBatch
+
+    small = b"\n".join(lines[:BATCH])
+    buf, lens, ovf = encode_blob(small)
+    eb = EncodedBatch(shard=0, index=0, payload=small, buf=buf,
+                      lengths=lens, overflow=list(ovf),
+                      n_lines=buf.shape[0])
+    p_enc = TpuBatchParser(FMT, FIELDS, device_chaos="oom_batch:count=1")
+    if p_enc.parse_encoded(eb).to_dict() != ref_batch:
+        failures.append("encoded drill: faulted parse_encoded diverged")
+    print("device-smoke: parse_encoded drill ok")
+
+    # ---- SIGTERM preemption (jobs CLI) -------------------------------
+    tmp = tempfile.mkdtemp(prefix="logparser-device-smoke-")
+    try:
+        _sigterm_drill(tmp, failures)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # ---- exposition + family presence --------------------------------
+    text = metrics().prometheus_text()
+    problems = validate_exposition(text)
+    if problems:
+        failures.append(f"exposition invalid: {problems[:3]}")
+    for family in ("device_faults_total", "device_oom_retries_total",
+                   "device_fault_reroutes_total",
+                   "device_compile_failures_total",
+                   "device_demotions_total", "device_bucket_clamped",
+                   "device_budget_rejects_total"):
+        if family not in text:
+            failures.append(f"metric family {family} missing from "
+                            "the exposition")
+
+    parser.close()
+    for p in (p_oom, p_clamp, p_wedge, p_comp, p_budget, p_roomy, p_enc):
+        p.close()
+    if failures:
+        print("device-smoke FAILURES:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("device-smoke: all device-fault drills recovered "
+          "byte-identically with zero aborts")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

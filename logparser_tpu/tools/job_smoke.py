@@ -21,7 +21,6 @@ Usage::
 """
 from __future__ import annotations
 
-import json
 import os
 import signal
 import subprocess
@@ -29,11 +28,18 @@ import sys
 import tempfile
 import time
 
-N_LINES = 20000
-GARBAGE_EVERY = 997          # ~20 reject lines across the corpus
+# Corpus sizing vs the kill window: on a fast host the whole commit
+# loop can burst through in well under a second (startup/jit dominates
+# the run), so the corpus must be big enough that commits SPREAD over a
+# multi-second window — otherwise the poll sees "all committed" in one
+# step and the SIGKILL can only land after the last commit ("kill
+# drill never landed mid-run", observed on the round-17 container at
+# 20k lines / 0.2 s polls).
+N_LINES = 60000
+GARBAGE_EVERY = 997          # ~60 reject lines across the corpus
 SHARD_BYTES = 64 << 10       # ~20+ shards: a wide mid-run kill window
 BATCH_LINES = 1024
-KILL_POLL_S = 0.2
+KILL_POLL_S = 0.05
 KILL_TIMEOUT_S = 300.0
 SHM_DIR = "/dev/shm"
 
@@ -64,12 +70,9 @@ def _ring_segments():
 def _committed(out_dir: str) -> int:
     """Committed-shard count per the on-disk manifest (atomic rewrite:
     a mid-write read is impossible by construction)."""
-    path = os.path.join(out_dir, "manifest.json")
-    try:
-        with open(path, "rb") as f:
-            return len(json.loads(f.read().decode()).get("shards", {}))
-    except (OSError, ValueError):
-        return 0
+    from logparser_tpu.jobs.manifest import count_committed_shards
+
+    return count_committed_shards(out_dir)
 
 
 def main() -> int:

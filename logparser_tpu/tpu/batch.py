@@ -886,6 +886,10 @@ class TpuBatchParser:
         view_fields: Optional[Sequence[str]] = None,
         assembly_workers: Optional[int] = None,
         data_parallel: Optional[int] = None,
+        device_bytes_budget: Optional[int] = None,
+        execute_deadline_s: Optional[float] = None,
+        fault_policy: Optional[Any] = None,
+        device_chaos: Any = None,
     ):
         self.log_format = log_format
         # Device-side data parallelism (docs/JOBS.md "Pod jobs"): lay
@@ -1032,8 +1036,75 @@ class TpuBatchParser:
             ]
             for u in self.units
         ]
+        # Device fault layer (docs/FAULTS.md): pre-allocation byte
+        # budget, OOM bisect + bucket clamp, execution deadline on an
+        # abandonable worker, per-parser circuit breaker demoting a
+        # repeatedly-faulting kernel to the host oracle, and the chaos
+        # injection hooks that drill all of it.
+        self._init_fault_layer(
+            device_bytes_budget, execute_deadline_s, fault_policy,
+            device_chaos,
+        )
         self._jitted = self._build_jitted()
         self._jitted_views = None  # lazily built by device_views_fn()
+
+    def _init_fault_layer(self, budget, deadline, policy, chaos) -> None:
+        """Device-tier fault state — shared by ``__init__`` and
+        ``__setstate__``: artifacts never carry runtime fault state
+        (breakers, clamps, chaos) — it re-arms on the loading host from
+        the pickled knobs + the env fallbacks."""
+        from .device_faults import (
+            DeviceBreaker,
+            DeviceFaultPolicy,
+            resolve_budget,
+            resolve_deadline,
+        )
+
+        self.fault_policy = policy or DeviceFaultPolicy()
+        self.device_bytes_budget = resolve_budget(budget)
+        self.execute_deadline_s = resolve_deadline(deadline)
+        self._breaker = DeviceBreaker(
+            self.fault_policy.breaker_threshold,
+            self.fault_policy.breaker_cooloff_s,
+        )
+        self._oom_clamp: Optional[int] = None
+        self._oom_events = 0
+        self._device_chaos = None
+        self.arm_device_chaos(chaos if chaos is not None else "env")
+
+    def arm_device_chaos(self, chaos: Any) -> None:
+        """Arm (or disarm with ``None``) device-tier fault injection:
+        accepts a ``tools.chaos.DeviceChaos``, a ``ChaosSpec``, the
+        grammar string, or ``"env"`` (the ``LOGPARSER_TPU_CHAOS``
+        channel — also the construction-time default, so CLI drills arm
+        the whole stack with one env var).  A spec carrying no device
+        faults leaves the hot path untouched (no hook object at all)."""
+        if chaos is None:
+            self._device_chaos = None
+            return
+        from ..tools.chaos import ChaosSpec, DeviceChaos
+
+        if isinstance(chaos, DeviceChaos):
+            self._device_chaos = chaos or None
+            return
+        if chaos == "env":
+            spec = ChaosSpec.from_env()
+        elif isinstance(chaos, str):
+            spec = ChaosSpec.parse(chaos)
+        else:
+            spec = chaos
+        dc = DeviceChaos(spec) if spec is not None else None
+        self._device_chaos = dc or None
+
+    def device_fault_stats(self) -> Dict[str, Any]:
+        """Fault-layer introspection for drills/ops: breaker state, the
+        standing OOM clamp, and whether chaos is armed."""
+        return {
+            **self._breaker.stats(),
+            "oom_clamp": self._oom_clamp,
+            "oom_events": self._oom_events,
+            "chaos_armed": self._device_chaos is not None,
+        }
 
     @staticmethod
     def _build_mesh(data_parallel: Optional[int]):
@@ -1788,20 +1859,46 @@ class TpuBatchParser:
         if self._executor_for(emit_views) is None:
             return enc
         lines, buf, lengths, overflow, B, padded_b = enc[:6]
+        if self._oom_clamp is not None and padded_b > self._oom_clamp:
+            # Standing OOM clamp: this batch executes in clamp-sized
+            # chunks at fetch time — staging the whole oversized frame
+            # would re-create exactly the allocation the clamp forbids.
+            return enc
+        self._check_device_budget(buf, lengths, B, emit_views)
         t0 = time.perf_counter()
-        if self._mesh is not None:
-            # Per-device input sharding ON the H2D edge: each device
-            # receives only its batch slice, so the upload fans out
-            # across the mesh instead of landing whole on device 0 and
-            # resharding inside the jit (the dryrun_multichip feeder
-            # idiom promoted to the hot path).
-            from ..parallel.mesh import dp_shardings
+        try:
+            if self._mesh is not None:
+                # Per-device input sharding ON the H2D edge: each device
+                # receives only its batch slice, so the upload fans out
+                # across the mesh instead of landing whole on device 0 and
+                # resharding inside the jit (the dryrun_multichip feeder
+                # idiom promoted to the hot path).
+                from ..parallel.mesh import dp_shardings
 
-            (buf_sh, len_sh), _ = dp_shardings(self._mesh)
-            staged = (jax.device_put(buf, buf_sh),
-                      jax.device_put(lengths, len_sh))
-        else:
-            staged = (jax.device_put(buf), jax.device_put(lengths))
+                (buf_sh, len_sh), _ = dp_shardings(self._mesh)
+                staged = (jax.device_put(buf, buf_sh),
+                          jax.device_put(lengths, len_sh))
+            else:
+                staged = (jax.device_put(buf), jax.device_put(lengths))
+        except Exception as e:  # noqa: BLE001 — staging is an optimization
+            # A staging failure (device OOM mid-upload, lost device)
+            # defers placement to dispatch time, where the fault layer
+            # classifies and absorbs it — never an abort here.  Still
+            # counted + warned-once: a PERSISTENTLY failing staging
+            # path silently costs the upload overlap fleet-wide, which
+            # must not go dark (details at DEBUG).
+            from ..observability import log_warning_once
+
+            metrics().increment("device_stage_fallbacks_total")
+            log_warning_once(
+                _LOG,
+                "device: staged H2D upload failed; batches fall back "
+                "to dispatch-time placement "
+                "(device_stage_fallbacks_total counts, details at "
+                "DEBUG)",
+            )
+            _LOG.debug("staged H2D failed; deferring to dispatch: %s", e)
+            return enc
         observe_stage("h2d_stage", time.perf_counter() - t0, items=B)
         metrics().increment(
             "h2d_staged_bytes_total", int(buf.nbytes + lengths.nbytes)
@@ -1816,10 +1913,50 @@ class TpuBatchParser:
     def _executor_for(self, emit_views: Optional[bool]):
         """The executor an emit_views choice selects: the view-emitting
         product executor by default, the plain one when views are
-        disabled (per call or by an empty parser-level demand set)."""
+        disabled (per call or by an empty parser-level demand set).
+        None also when the fault layer's circuit breaker has demoted
+        the kernel (open / compile-demoted): every batch then takes the
+        batched oracle host path — the device twin of the feeder's
+        transport demotion (docs/FAULTS.md)."""
+        if not self._breaker.allow():
+            return None
         if emit_views is None or emit_views:
             return self.device_views_fn()
         return self._jitted
+
+    def _view_field_count(self, emit_views: Optional[bool]) -> int:
+        """Trailing device-view rows the chosen executor will emit / 4
+        (the budget estimator's input; 0 with views off)."""
+        if not (emit_views is None or emit_views):
+            return 0
+        fields = getattr(self, "_views_fields", None)
+        if fields is not None:
+            return len(fields)
+        return len(self._view_specs())
+
+    def _check_device_budget(self, buf, lengths, B: int,
+                             emit_views: Optional[bool]) -> None:
+        """Pre-allocation device-memory ceiling: validate the padded
+        batch's estimated footprint (staged H2D input + packed verdict
+        output, ``pipeline.estimate_device_bytes``) against the
+        configured budget BEFORE any ``device_put`` — over budget
+        answers a structured :class:`DeviceBudgetError`, never an XLA
+        RESOURCE_EXHAUSTED (the batch-tier twin of the serving tier's
+        frame ceilings; docs/FAULTS.md)."""
+        budget = self.device_bytes_budget
+        if not budget:
+            return
+        from ..observability import metrics
+        from .device_faults import DeviceBudgetError
+        from .pipeline import estimate_device_bytes
+
+        est = estimate_device_bytes(
+            self.units, self._view_field_count(emit_views),
+            buf.shape[0], buf.shape[1], lengths.dtype.itemsize,
+        )
+        if est > budget:
+            metrics().increment("device_budget_rejects_total")
+            raise DeviceBudgetError(est, budget, B)
 
     def _encode_batch(self, lines: Sequence[Union[bytes, str]]):
         from ..observability import pipeline_stage, record_batch_shape
@@ -1843,8 +1980,17 @@ class TpuBatchParser:
         lines, buf, lengths, overflow, B, padded_b = enc[:6]
         staged = enc[6] if len(enc) > 6 else None
         out = None
+        fault = None
         fn = self._executor_for(emit_views)
+        if fn is not None and self._oom_clamp is not None \
+                and padded_b > self._oom_clamp:
+            # Standing OOM clamp: never dispatch above the safe bucket —
+            # _fetch_packed executes this batch in clamp-sized chunks.
+            fn = None
         if fn is not None:
+            if staged is None:
+                # (Staged batches were validated in _stage_h2d.)
+                self._check_device_budget(buf, lengths, B, emit_views)
             # Label by the executor actually chosen, not the request: a
             # viewless parser's device_views_fn() falls back to the plain
             # executor, and that dispatch must not read as views="on".
@@ -1857,17 +2003,25 @@ class TpuBatchParser:
                 labels={"views": "on" if views_on else "off"},
             )
             with pipeline_stage("device", items=B):
-                if staged is not None:
-                    out = fn(*staged)
-                else:
-                    out = fn(jnp.asarray(buf), jnp.asarray(lengths))
-                if tracer().enabled:
-                    # Dispatch is async: make the device stage contain the
-                    # actual kernel time instead of misattributing it to
-                    # the fetch stage (only when someone is looking).
-                    out = jax.block_until_ready(out)
+                try:
+                    if staged is not None:
+                        out = fn(*staged)
+                    else:
+                        out = fn(jnp.asarray(buf), jnp.asarray(lengths))
+                    if tracer().enabled:
+                        # Dispatch is async: make the device stage contain
+                        # the actual kernel time instead of misattributing
+                        # it to the fetch stage (only when someone is
+                        # looking).
+                        out = jax.block_until_ready(out)
+                except Exception as e:  # noqa: BLE001 — absorbed at fetch
+                    # Compile failures and allocation OOMs surface HERE
+                    # (jit compiles synchronously at call); the fault
+                    # rides the state tuple to _fetch_packed's central
+                    # fault policy instead of raising out of the parse.
+                    out, fault = None, e
         return (lines, buf, lengths, overflow, B, padded_b, out,
-                self.csr_slots, emit_views)
+                self.csr_slots, emit_views, fault)
 
     def _finish_batch(self, state) -> BatchResult:
         return self._materialize_packed(self._fetch_packed(state))
@@ -1875,37 +2029,57 @@ class TpuBatchParser:
     def _fetch_packed(self, state):
         """Block on the in-flight device result: returns the fetched
         verdicts (packed rows, per-line validity/winner/plausibility)
-        ready for :meth:`_materialize_packed`."""
-        from ..observability import metrics, pipeline_stage, tracer
+        ready for :meth:`_materialize_packed`.
+
+        Every device-tier fault lands here — dispatch-time failures ride
+        the state tuple, async execution errors surface in the guarded
+        fetch — and is ABSORBED by the fault layer
+        (:meth:`_absorb_device_fault`): OOMs bisect and retry, wedged or
+        otherwise-failed executions reroute the batch to the batched
+        oracle host path, compile failures demote the parser key.  The
+        only raise left is the pre-allocation
+        :class:`~.device_faults.DeviceBudgetError` (a structured reject
+        by contract); a parse stream NEVER aborts on a device failure
+        (docs/FAULTS.md)."""
+        from ..observability import metrics, pipeline_stage
 
         (lines, buf, lengths, overflow, B, padded_b, out, out_slots,
-         emit_views) = state
+         emit_views, fault) = state
 
         from .pipeline import CSR_OVERFLOW_BIT
 
         while True:
-            # (Re-)dispatch when nothing is in flight or the in-flight
-            # result was produced under a stale CSR slot layout (another
-            # batch's materialization grew the slots mid-stream).
-            if out is None or out_slots != self.csr_slots:
-                fn = self._executor_for(emit_views)
-                if fn is None:
-                    packed = None
-                    valid = np.zeros(B, dtype=bool)
-                    winner = np.full(B, -1, dtype=np.int64)
-                    break
-                # ONE packed [sum K_i, B] int32 output -> ONE device->host
-                # fetch (transfer round-trips dominate on tunneled TPU
-                # attachments).
-                with pipeline_stage("device", items=B):
-                    out = fn(jnp.asarray(buf), jnp.asarray(lengths))
-                    if tracer().enabled:
-                        out = jax.block_until_ready(out)
-                out_slots = self.csr_slots
-            with pipeline_stage("fetch", items=B):
-                packed = np.asarray(jax.device_get(out))
-            metrics().increment("d2h_bytes_total", int(packed.nbytes))
+            packed = None
+            if fault is None:
+                try:
+                    if out is not None and out_slots == self.csr_slots:
+                        # ONE packed [sum K_i, B] int32 output -> ONE
+                        # device->host fetch (transfer round-trips
+                        # dominate on tunneled TPU attachments).
+                        with pipeline_stage("fetch", items=B):
+                            packed = self._guarded_get(out, B)
+                    else:
+                        # (Re-)dispatch: nothing in flight, a stale CSR
+                        # slot layout (another batch's materialization
+                        # grew the slots mid-stream), or a clamp/fault
+                        # retry path.
+                        packed = self._execute_packed(
+                            buf, lengths, B, emit_views
+                        )
+                except Exception as e:  # noqa: BLE001 — classified below
+                    fault = e
             out = None
+            if fault is not None:
+                packed = self._absorb_device_fault(
+                    fault, buf, lengths, B, emit_views
+                )
+                fault = None
+            if packed is None:
+                valid = np.zeros(B, dtype=bool)
+                winner = np.full(B, -1, dtype=np.int64)
+                break
+            self._breaker.record_success()
+            metrics().increment("d2h_bytes_total", int(packed.nbytes))
             # Per-line winner: first registered format whose automaton
             # accepted the line (row_offset row: bit 0 = valid, bit 1 =
             # plausible).  A line is only CLAIMED by format i when no
@@ -1950,6 +2124,227 @@ class TpuBatchParser:
             plausible_any[i] = True
         return (lines, buf, lengths, B, packed, valid, winner,
                 plausible_any, overflow)
+
+    # ------------------------------------------------------------------
+    # device fault layer (docs/FAULTS.md): guarded execution, OOM bisect
+    # + bucket clamp, wedge deadlines, compile demotion, oracle reroute.
+    # ------------------------------------------------------------------
+
+    def _run_guarded(self, work, label: str):
+        """Run one blocking device operation under the fault layer's
+        guard: the execution deadline (abandonable worker — a wedged XLA
+        call expires instead of hanging the pipeline) when armed, and
+        raw-error classification into the DeviceFault vocabulary."""
+        from .device_faults import (
+            DeviceCompileError,
+            DeviceExecutionError,
+            DeviceFault,
+            DeviceOomError,
+            classify_device_error,
+            run_with_deadline,
+        )
+
+        deadline = self.execute_deadline_s
+        try:
+            if deadline:
+                return run_with_deadline(work, deadline, label)
+            return work()
+        except DeviceFault:
+            raise
+        except Exception as e:  # noqa: BLE001 — classified
+            kind = classify_device_error(e)
+            err = {
+                "oom": DeviceOomError,
+                "compile": DeviceCompileError,
+            }.get(kind, DeviceExecutionError)
+            raise err(f"{type(e).__name__}: {e}") from e
+
+    def _guarded_get(self, out, n_lines: int):
+        """Guarded blocking fetch of an in-flight async dispatch: async
+        execution errors surface exactly here, classified like a
+        synchronous invoke's; the chaos hook fires once per execution
+        at this blocking point."""
+        chaos = self._device_chaos
+        wedge_s = chaos.on_execute(n_lines) if chaos is not None else None
+
+        def work():
+            if wedge_s:
+                time.sleep(wedge_s)
+            return np.asarray(jax.device_get(out))
+
+        return self._run_guarded(work, "fetch")
+
+    def _invoke_device(self, fn, buf, lengths, n_lines: int):
+        """ONE guarded synchronous device execution (dispatch + packed
+        fetch) of an already-padded frame.  ``n_lines`` is the REAL
+        line count — chaos thresholds key on it."""
+        chaos = self._device_chaos
+        wedge_s = chaos.on_execute(n_lines) if chaos is not None else None
+
+        def work():
+            if wedge_s:
+                time.sleep(wedge_s)
+            out = fn(jnp.asarray(buf), jnp.asarray(lengths))
+            return np.asarray(jax.device_get(out))
+
+        return self._run_guarded(work, "execute")
+
+    def _execute_packed(self, buf, lengths, B: int,
+                        emit_views: Optional[bool]):
+        """Fresh guarded execution of one encoded batch (the re-dispatch
+        path: nothing staged or in flight).  Honors a standing OOM clamp
+        by pre-splitting into safe chunks; returns None when every field
+        is host-only or the breaker has demoted the kernel.  Raises
+        classified DeviceFault errors (absorbed by the caller)."""
+        from ..observability import pipeline_stage
+
+        fn = self._executor_for(emit_views)
+        if fn is None:
+            return None
+        clamp = self._oom_clamp
+        with pipeline_stage("device", items=B):
+            if clamp is not None and B > clamp:
+                return self._execute_chunks(fn, buf, lengths, B, clamp)
+            return self._invoke_device(fn, buf, lengths, B)
+
+    def _execute_chunks(self, fn, buf, lengths, B: int, chunk: int):
+        """Execute rows [0, B) in ``chunk``-sized pieces (the standing
+        clamp path) and reassemble the packed verdict columns."""
+        parts = [
+            self._execute_range(fn, buf, lengths, lo, min(B, lo + chunk), 0)
+            for lo in range(0, B, chunk)
+        ]
+        return np.concatenate(parts, axis=1)
+
+    def _execute_range(self, fn, buf, lengths, lo: int, hi: int,
+                       depth: int):
+        """Execute rows [lo, hi) padded to their own bucket; on
+        RESOURCE_EXHAUSTED, bisect with bounded depth (each retry
+        counted on ``device_oom_retries_total``).  Raises DeviceOomError
+        when even the policy's minimum bucket OOMs — the caller then
+        reroutes the batch to the oracle.  Per-row outputs are
+        independent of batch geometry (per-line automata), so the
+        reassembled columns are bit-identical to a single-dispatch run —
+        the property the device-fault parity drills pin."""
+        from ..observability import metrics
+        from .device_faults import DeviceOomError
+
+        n = hi - lo
+        pb = self._bucket(n)
+        sub_buf = buf[lo:hi]
+        sub_len = lengths[lo:hi]
+        if pb != n:
+            sub_buf = np.pad(sub_buf, ((0, pb - n), (0, 0)))
+            sub_len = np.pad(sub_len, (0, pb - n))
+        try:
+            return self._invoke_device(fn, sub_buf, sub_len, n)[:, :n]
+        except DeviceOomError:
+            pol = self.fault_policy
+            if n <= pol.min_bucket or depth >= pol.oom_retries:
+                raise
+            metrics().increment("device_oom_retries_total")
+            self._note_oom(pb)
+            mid = lo + (n + 1) // 2
+            left = self._execute_range(fn, buf, lengths, lo, mid, depth + 1)
+            right = self._execute_range(fn, buf, lengths, mid, hi, depth + 1)
+            return np.concatenate([left, right], axis=1)
+
+    def _note_oom(self, failed_bucket: int) -> None:
+        """Clamp bookkeeping: after ``oom_clamp_after`` device OOMs the
+        parser PERMANENTLY caps its executed bucket below the failing
+        size — future batches pre-split before any device_put
+        (``device_bucket_clamped`` gauge; warn-once)."""
+        from ..observability import log_warning_once, metrics
+
+        self._oom_events += 1
+        if self._oom_events < self.fault_policy.oom_clamp_after:
+            return
+        new_clamp = max(self.fault_policy.min_bucket, failed_bucket // 2)
+        if self._oom_clamp is None or new_clamp < self._oom_clamp:
+            self._oom_clamp = new_clamp
+            metrics().gauge_set("device_bucket_clamped", new_clamp)
+            log_warning_once(
+                _LOG,
+                "device: repeated RESOURCE_EXHAUSTED — max executed "
+                "bucket permanently clamped (device_bucket_clamped "
+                "gauge; oversized batches now pre-split before "
+                "device_put)",
+            )
+
+    def _absorb_compile_fault(self, e) -> None:
+        """A deterministic compile/lowering failure: demote this parser
+        key to the host oracle PERMANENTLY (retrying the same shape
+        would fail identically), warn once, count — never raise out of
+        the parse."""
+        from ..observability import log_warning_once, metrics
+
+        reg = metrics()
+        reg.increment("device_compile_failures_total")
+        if self._breaker.record_fault(permanent=True):
+            reg.increment("device_demotions_total",
+                          labels={"reason": "compile"})
+            log_warning_once(
+                _LOG,
+                "device: executor compile failed — parser demoted to "
+                "the host oracle (results stay exact; "
+                "device_compile_failures_total counts, details at "
+                "DEBUG)",
+            )
+        _LOG.debug("device compile fault: %s", e)
+
+    def _absorb_device_fault(self, e, buf, lengths, B: int,
+                             emit_views: Optional[bool]):
+        """Central device-fault policy (docs/FAULTS.md): classify,
+        count, bisect OOMs, and score the circuit breaker — compile
+        failures demote the key permanently, repeated transient faults
+        demote it until the cool-off (the device twin of
+        ``demote_transport``).  Returns the recovered packed block, or
+        None to reroute the WHOLE batch to the batched oracle host path
+        (byte-identical output either way — the oracle is the exactness
+        referee).  Never raises: a device fault costs throughput, never
+        the batch."""
+        from ..observability import log_warning_once, metrics
+        from .device_faults import DeviceFault, classify_device_error
+
+        reg = metrics()
+        kind = classify_device_error(e)
+        reg.increment("device_faults_total", labels={"kind": kind})
+        if kind == "compile":
+            self._absorb_compile_fault(e)
+            return None
+        if kind == "oom" and B > self.fault_policy.min_bucket:
+            fn = self._executor_for(emit_views)
+            if fn is not None:
+                reg.increment("device_oom_retries_total")
+                self._note_oom(self._bucket(B))
+                try:
+                    mid = (B + 1) // 2
+                    return np.concatenate([
+                        self._execute_range(fn, buf, lengths, 0, mid, 1),
+                        self._execute_range(fn, buf, lengths, mid, B, 1),
+                    ], axis=1)
+                except DeviceFault as e2:
+                    if classify_device_error(e2) == "compile":
+                        self._absorb_compile_fault(e2)
+                        return None
+                    kind = classify_device_error(e2)
+                    e = e2  # the residual fault falls through to reroute
+        # Wedge / transient execute / OOM beyond rescue: reroute this
+        # batch to the host oracle and score the breaker.
+        reg.increment("device_fault_reroutes_total",
+                      labels={"kind": kind})
+        if self._breaker.record_fault():
+            reg.increment("device_demotions_total",
+                          labels={"reason": kind})
+            log_warning_once(
+                _LOG,
+                "device: repeated device faults — kernel demoted to the "
+                "host oracle until the breaker cool-off (results stay "
+                "exact; device_faults_total{kind} counts, details at "
+                "DEBUG)",
+            )
+        _LOG.debug("device fault rerouted to oracle (%s): %s", kind, e)
+        return None
 
     def _materialize_packed(self, fetched) -> BatchResult:
         from ..observability import metrics, observe_stage
@@ -3129,6 +3524,13 @@ class TpuBatchParser:
         # LOADING host from the pickled data_parallel request (a
         # different host may have a different chip count).
         state["_mesh"] = None
+        # Runtime fault state never ships either: a breaker/clamp
+        # learned on one host's devices means nothing on another's, and
+        # chaos re-arms from the loading process's env.
+        state["_breaker"] = None
+        state["_device_chaos"] = None
+        state["_oom_clamp"] = None
+        state["_oom_events"] = 0
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -3158,6 +3560,16 @@ class TpuBatchParser:
             self._overflow_delivery = self._build_overflow_delivery()
         if "data_parallel" not in state:  # pre-pod artifacts
             self.data_parallel = None
+        # Fault layer rebuilds fresh on the loading host: pickled knobs
+        # (budget/deadline/policy) are honored, env fallbacks re-read,
+        # breaker/clamp/chaos start clean (pre-fault-layer artifacts
+        # get the defaults).
+        self._init_fault_layer(
+            state.get("device_bytes_budget"),
+            state.get("execute_deadline_s"),
+            state.get("fault_policy"),
+            "env",
+        )
         # Re-resolve the mesh on THIS host (never pickled; the loading
         # host's device count decides the effective width).
         self._mesh = self._build_mesh(self.data_parallel)

@@ -1,0 +1,296 @@
+"""Device-tier fault layer (docs/FAULTS.md "Device failure model").
+
+Rounds 11-16 made every PROCESS tier survive crashes (supervised feeder
+workers, sidecar fleet failover, exactly-once pod jobs); the accelerator
+itself was the last unsupervised single point of failure: a device OOM
+on an oversized bucket, a wedged XLA execution, or a failed jit compile
+aborted the batch, the session, or the whole pod job.  This module holds
+the jax-free pieces of the recovery machinery ``tpu/batch.py`` composes
+around the executor:
+
+- the typed fault vocabulary (:class:`DeviceOomError` & friends) and the
+  :func:`classify_device_error` rule that maps raw XLA/jax exceptions
+  onto it;
+- :class:`DeviceFaultPolicy` — the recovery knobs (bisect depth, clamp
+  trigger, breaker threshold/cool-off);
+- :class:`DeviceBreaker` — the per-parser-key circuit breaker that
+  demotes a repeatedly-faulting compiled kernel to the host oracle (the
+  device twin of the feeder's ``demote_transport`` ladder): a pure
+  decision machine with an explicit ``now`` so tests drive it directly;
+- :func:`run_with_deadline` — the abandonable-worker idiom from the
+  serving tier's ``request_deadline_s`` (PR 7) one level down: a wedged
+  XLA execution expires instead of hanging the pipeline, and the
+  abandoned thread finishes (or not) in the background;
+- :func:`resolve_budget` / :func:`resolve_deadline` — the
+  ``LOGPARSER_TPU_DEVICE_BYTES_BUDGET`` / ``LOGPARSER_TPU_DEVICE_DEADLINE_S``
+  env fallbacks behind the ``TpuBatchParser`` kwargs.
+
+Deliberately NO jax import at module level: ``tools/chaos.py`` raises
+the typed faults from injection hooks and the service tier classifies
+:class:`DeviceBudgetError`, both in processes that must not pay (or may
+not have) a device runtime.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+#: Pre-allocation device-memory ceiling (bytes).  The batch-tier twin of
+#: the serving tier's frame ceilings: validated BEFORE ``device_put``,
+#: answering a structured :class:`DeviceBudgetError` instead of an XLA
+#: RESOURCE_EXHAUSTED abort.  Unset/0 = disabled.
+BUDGET_ENV = "LOGPARSER_TPU_DEVICE_BYTES_BUDGET"
+
+#: Per-execution deadline (seconds) for the blocking side of a device
+#: batch (dispatch + packed fetch).  Unset/0 = disabled (no worker
+#: thread on the hot path).
+DEADLINE_ENV = "LOGPARSER_TPU_DEVICE_DEADLINE_S"
+
+
+class DeviceFault(Exception):
+    """Base class of every classified device-tier fault."""
+
+
+class DeviceOomError(DeviceFault):
+    """Device RESOURCE_EXHAUSTED (allocation or execution OOM)."""
+
+
+class DeviceCompileError(DeviceFault):
+    """jit trace/lowering/compilation failed — deterministic, so the
+    parser key demotes to the host oracle permanently (warn-once)."""
+
+
+class DeviceWedgeError(DeviceFault):
+    """A device execution exceeded its deadline (wedged kernel / hung
+    transfer); the batch reroutes to the batched oracle host path."""
+
+
+class DeviceExecutionError(DeviceFault):
+    """Any other device-side runtime failure (halted device, preempted
+    slice, transfer error) — transient until the breaker says otherwise."""
+
+
+class DeviceBudgetError(DeviceFault):
+    """Structured pre-allocation reject: the batch's estimated device
+    footprint exceeds the configured byte budget.  Raised BEFORE any
+    ``device_put`` — the caller (service tier, jobs) answers it as a
+    structured reject instead of letting XLA OOM."""
+
+    def __init__(self, estimated_bytes: int, budget_bytes: int,
+                 lines: int):
+        self.estimated_bytes = int(estimated_bytes)
+        self.budget_bytes = int(budget_bytes)
+        self.lines = int(lines)
+        super().__init__(
+            f"device byte budget exceeded: batch of {lines} lines needs "
+            f"~{self.estimated_bytes} device bytes, budget is "
+            f"{self.budget_bytes} ({BUDGET_ENV} / device_bytes_budget)"
+        )
+
+
+# Message markers, lower-cased.  RESOURCE_EXHAUSTED is XLA's canonical
+# OOM status; the rest cover pjrt allocator phrasing across backends.
+_OOM_MARKERS = (
+    "resource_exhausted", "resource exhausted", "out of memory", "oom",
+    "failed to allocate",
+)
+# Deterministic compile-side failures: retrying the same shape would
+# fail identically, so these demote the key instead of rerouting once.
+# Deliberately NARROW (no bare "lowering", no INVALID_ARGUMENT): a
+# misclassified transient would latch the permanent demotion, while a
+# real compile failure misread as "execute" still demotes via the
+# breaker after `breaker_threshold` repeats — the safe direction.
+_COMPILE_MARKERS = (
+    "unimplemented", "compilation failure", "failed to compile",
+    "error during lowering", "mosaic",
+)
+
+
+def classify_device_error(e: BaseException) -> str:
+    """``"oom"`` | ``"compile"`` | ``"wedge"`` | ``"execute"`` for any
+    exception the executor path can raise.  Typed :class:`DeviceFault`
+    subclasses (including chaos-injected ones) classify by type; raw
+    XLA/jax errors by message marker, defaulting to the transient
+    ``"execute"`` class (reroute once, demote only via the breaker)."""
+    if isinstance(e, DeviceOomError):
+        return "oom"
+    if isinstance(e, DeviceCompileError):
+        return "compile"
+    if isinstance(e, DeviceWedgeError):
+        return "wedge"
+    if isinstance(e, DeviceExecutionError):
+        return "execute"
+    msg = f"{type(e).__name__}: {e}".lower()
+    if any(m in msg for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in msg for m in _COMPILE_MARKERS):
+        return "compile"
+    return "execute"
+
+
+def resolve_budget(explicit: Optional[int]) -> Optional[int]:
+    """The effective device byte budget: the explicit kwarg wins, else
+    the env var; 0/absent/garbage = disabled (None)."""
+    if explicit is not None:
+        return int(explicit) or None
+    raw = os.environ.get(BUDGET_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw) or None
+    except ValueError:
+        return None
+
+
+def resolve_deadline(explicit: Optional[float]) -> Optional[float]:
+    """The effective per-execution deadline (seconds); 0/absent =
+    disabled — the hot path then runs with no worker thread at all."""
+    if explicit is not None:
+        return float(explicit) or None
+    raw = os.environ.get(DEADLINE_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw) or None
+    except ValueError:
+        return None
+
+
+@dataclass
+class DeviceFaultPolicy:
+    """Recovery tunables (all have safe defaults)."""
+
+    #: Max bisect depth per batch on RESOURCE_EXHAUSTED: each level
+    #: halves the row range, so 4 levels retry down to B/16 before the
+    #: batch reroutes to the oracle.
+    oom_retries: int = 4
+    #: OOM events before the parser PERMANENTLY clamps its max executed
+    #: bucket below the failing size (``device_bucket_clamped`` gauge):
+    #: the first OOM is forgiven as transient; repetition is geometry.
+    oom_clamp_after: int = 2
+    #: Bisect floor — a batch that OOMs at/below this row count cannot
+    #: be saved by splitting and reroutes to the oracle.
+    min_bucket: int = 64
+    #: Consecutive non-compile device faults before the breaker opens
+    #: (kernel demoted to the host oracle).
+    breaker_threshold: int = 3
+    #: Seconds an open breaker waits before re-admitting device batches
+    #: (the half-open trial window).
+    breaker_cooloff_s: float = 30.0
+
+
+class DeviceBreaker:
+    """Per-parser-key circuit breaker over the compiled kernel — the
+    device twin of the feeder's transport-demotion ladder.
+
+    closed -> (``threshold`` consecutive faults) -> open (every batch
+    reroutes to the oracle) -> after ``cooloff_s`` device batches are
+    re-admitted; the first fault re-opens, the first success closes.
+    ``record_fault(permanent=True)`` (compile failure) latches open
+    forever — retrying a deterministic compile failure is pure waste.
+
+    Thread-safe (one lock; the serving tier shares a parser across
+    sessions) and a pure time machine: every method takes an explicit
+    ``now`` so tests drive the clock.
+    """
+
+    def __init__(self, threshold: int = 3, cooloff_s: float = 30.0):
+        self.threshold = max(1, int(threshold))
+        self.cooloff_s = float(cooloff_s)
+        self.consecutive = 0
+        self.opened_at: Optional[float] = None
+        self.permanent = False
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        if self.permanent:
+            return "demoted"
+        if self.opened_at is None:
+            return "closed"
+        return "open"
+
+    def allow(self, now: Optional[float] = None) -> bool:
+        """May the next batch try the device?  Read-only: open simply
+        waits out the cool-off, then batches flow again (half-open by
+        time, not by a single claimed trial — several stream batches may
+        be in flight, and a still-broken device re-trips immediately)."""
+        with self._lock:
+            if self.permanent:
+                return False
+            if self.opened_at is None:
+                return True
+            now = time.monotonic() if now is None else now
+            return (now - self.opened_at) >= self.cooloff_s
+
+    def record_success(self, now: Optional[float] = None) -> None:
+        with self._lock:
+            if not self.permanent:
+                self.consecutive = 0
+                self.opened_at = None
+
+    def record_fault(self, now: Optional[float] = None,
+                     permanent: bool = False) -> bool:
+        """One device fault landed.  Returns True exactly when THIS
+        fault transitioned the breaker to open/demoted — the caller's
+        cue to warn-once and count the demotion."""
+        with self._lock:
+            now = time.monotonic() if now is None else now
+            if permanent:
+                was = self.permanent
+                self.permanent = True
+                self.opened_at = now
+                return not was
+            if self.permanent:
+                return False
+            self.consecutive += 1
+            if self.opened_at is not None:
+                # Fault during/after the cool-off window: re-open.
+                self.opened_at = now
+                return False
+            if self.consecutive >= self.threshold:
+                self.opened_at = now
+                return True
+            return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutive_faults": self.consecutive,
+            }
+
+
+def run_with_deadline(work: Callable[[], Any], deadline_s: float,
+                      label: str = "execute") -> Any:
+    """Run ``work`` on an abandonable daemon worker; raise
+    :class:`DeviceWedgeError` when it misses the deadline.  The PR-7
+    ``request_deadline_s`` idiom one level down: the worker keeps
+    running (and logs nothing) after abandonment — a wedged XLA call
+    cannot be cancelled, only walked away from."""
+    box: dict = {}
+    done = threading.Event()
+
+    def run() -> None:
+        try:
+            box["value"] = work()
+        except BaseException as e:  # noqa: BLE001 — relayed to the waiter
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name=f"lp-device-{label}",
+                         daemon=True)
+    t.start()
+    if not done.wait(deadline_s):
+        raise DeviceWedgeError(
+            f"device {label} exceeded its {deadline_s:.3f}s deadline "
+            "(wedged execution abandoned; batch reroutes to the host "
+            "oracle)"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["value"]

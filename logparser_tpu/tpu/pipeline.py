@@ -1178,6 +1178,34 @@ def assign_row_offsets(units: Sequence[FormatUnit]) -> int:
     return off
 
 
+def packed_row_count(units: Sequence[FormatUnit]) -> int:
+    """Stacked packed-output rows of one executor pass over ``units``
+    (``assign_row_offsets``'s return value without mutating offsets) —
+    the single home of the D2H footprint arithmetic the device byte
+    budget reads."""
+    return sum(u.layout.n_rows for u in units)
+
+
+def estimate_device_bytes(
+    units: Sequence[FormatUnit],
+    n_view_fields: int,
+    padded_b: int,
+    line_len: int,
+    lengths_itemsize: int = 4,
+) -> int:
+    """Pre-allocation device-footprint estimate for one padded batch:
+    the staged H2D input (``[padded_b, line_len]`` uint8 buffer + the
+    lengths vector) plus the packed int32 verdict output (one row per
+    output component, 4 trailing rows per device-view span field) —
+    deliberately the same arithmetic the executor's buffers resolve to,
+    so a budget validated against this estimate is a budget the device
+    actually sees (docs/FAULTS.md; the batch-tier twin of the serving
+    tier's frame ceilings validated before allocation)."""
+    rows = packed_row_count(units) + 4 * int(n_view_fields)
+    input_bytes = padded_b * line_len + padded_b * lengths_itemsize
+    return int(input_bytes + rows * padded_b * 4)
+
+
 def _units_rows_and_prefixes(
     units: Sequence[FormatUnit],
     buf: jnp.ndarray,

@@ -517,3 +517,129 @@ def test_cli_roundtrip(tmp_path, corpus_file, capsys, monkeypatch):
     assert rep2["skipped"] == rep["shards_total"]
     # --no-resume refuses the existing manifest
     assert main(argv + ["--no-resume"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# preemption (SIGTERM-clean stop at a shard commit boundary)
+# ---------------------------------------------------------------------------
+
+
+def test_stop_event_preempts_at_commit_boundary(tmp_path, corpus_file):
+    """The runner half of the SIGTERM contract (docs/JOBS.md
+    "Preemption"): a set stop_event stops the run at the FIRST commit
+    boundary it reaches — the shard in flight commits, the report says
+    preempted, and a resume re-parses ZERO committed shards, merging
+    byte-identical to an undisturbed run."""
+    import threading
+
+    ref = run(job_spec(tmp_path, corpus_file, "ref"))
+    assert ref.complete
+    ref_hash = merged_hash(ref.out_dir, JobManifest.load(ref.out_dir))
+
+    notice = threading.Event()
+    notice.set()  # preemption notice already delivered
+    before = metrics().get("job_preempted_total")
+    r1 = run(job_spec(tmp_path, corpus_file, "pre"),
+             policy=JobPolicy(stop_event=notice))
+    assert r1.preempted and r1.stopped_early and not r1.complete
+    assert r1.committed == 1  # the boundary in flight, nothing more
+    assert r1.as_dict()["preempted"] is True
+    assert metrics().get("job_preempted_total") > before
+
+    r2 = run(job_spec(tmp_path, corpus_file, "pre"))
+    assert r2.complete and r2.skipped == r1.committed
+    assert merged_hash(r2.out_dir, JobManifest.load(r2.out_dir)) == ref_hash
+    assert leaked_temp_files(r2.out_dir) == []
+
+
+def test_run_job_hands_caller_parser_back_without_chaos(
+    tmp_path, corpus_file
+):
+    """A drill must not keep injecting into unrelated parses: run_job
+    arms device chaos on a caller-supplied parser for the job's
+    duration only, and restores the PRIOR arming on the way out."""
+    from logparser_tpu.tpu.batch import TpuBatchParser
+
+    p = TpuBatchParser(FMT, FIELDS, device_chaos=None)
+    rep = run_job(job_spec(tmp_path, corpus_file, "armed"), parser=p,
+                  chaos="oom_batch:sticky=1:min_lines=1")
+    assert rep.complete  # the injected OOMs were absorbed, not raised
+    assert p._device_chaos is None  # handed back clean
+    # A caller mid-drill of its own gets ITS plan back, not None.
+    p.arm_device_chaos("wedge_device:seconds=0.01")
+    mine = p._device_chaos
+    run_job(job_spec(tmp_path, corpus_file, "armed2"), parser=p,
+            chaos="oom_batch:count=1")
+    assert p._device_chaos is mine
+    p.arm_device_chaos(None)
+    p.close()
+
+
+def test_preemption_on_final_commit_is_a_clean_finish(
+    tmp_path, corpus_file
+):
+    """A notice landing on the LAST shard's commit must not turn a
+    finished run into a preempted one — the relaunch would be a pure
+    no-op and the report would falsely read incomplete."""
+    import threading
+
+    notice = threading.Event()
+    notice.set()
+    # One-shard geometry: the first commit IS the final one.
+    r = run(job_spec(tmp_path, corpus_file, "lastshard",
+                     shard_bytes=1 << 20),
+            policy=JobPolicy(stop_event=notice))
+    assert r.complete and not r.preempted and r.shards_total == 1
+
+
+def test_unset_stop_event_changes_nothing(tmp_path, corpus_file):
+    import threading
+
+    r = run(job_spec(tmp_path, corpus_file, "quiet"),
+            policy=JobPolicy(stop_event=threading.Event()))
+    assert r.complete and not r.preempted
+
+
+def test_cli_sigterm_maps_to_preempted_exit_code(
+    tmp_path, corpus_file, capsys, monkeypatch
+):
+    """The CLI half: the SIGTERM handler's stop_event reaches
+    JobPolicy, and a preempted report exits EXIT_PREEMPTED (3) with the
+    preempted flag on the JSON line — what an orchestrator keys its
+    unconditional relaunch on.  (The live-signal drill — a real SIGTERM
+    into a subprocess mid-run — runs in tools/device_chaos_smoke.py.)"""
+    from logparser_tpu.jobs import EXIT_PREEMPTED
+    from logparser_tpu.jobs.__main__ import main
+
+    seen = {}
+    real_run_job = run_job
+
+    def preempting_run_job(spec, resume=True, parser=None, chaos=None,
+                           policy=None):
+        # The handler fires mid-run: model it as the notice arriving
+        # before the first boundary (the earliest legal stop).
+        assert policy is not None and policy.stop_event is not None
+        seen["stop_event"] = policy.stop_event
+        policy.stop_event.set()
+        return real_run_job(spec, resume=resume, parser=parser,
+                            chaos=chaos, policy=policy)
+
+    monkeypatch.setattr("logparser_tpu.jobs.__main__.run_job",
+                        preempting_run_job)
+    out = tmp_path / "term-out"
+    argv = [
+        str(corpus_file), "--format", FMT, "--out", str(out),
+        "--shard-bytes", "700", "--batch-lines", "16", "--threads",
+    ]
+    for f in FIELDS:
+        argv += ["--field", f]
+    assert main(argv) == EXIT_PREEMPTED
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["preempted"] is True and rep["stopped_early"] is True
+    # The same command resumes to completion (exit 0), never re-parsing
+    # the committed prefix.
+    monkeypatch.setattr("logparser_tpu.jobs.__main__.run_job",
+                        real_run_job)
+    assert main(argv) == 0
+    rep2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep2["complete"] and rep2["skipped"] == rep["committed"]

@@ -287,6 +287,75 @@ def test_pod_host_count_change_respects_commits(tmp_path, corpus_file):
                        JobManifest.load(spec0.out_dir)) == ref_hash
 
 
+def test_host_preemption_resume_byte_parity(tmp_path, corpus_file):
+    """The SIGTERM-preemption model of host loss (docs/JOBS.md
+    "Preemption"): a host stopped CLEANLY at a commit boundary
+    (JobPolicy.stop_event — exactly what the jobs CLI's SIGTERM handler
+    sets) resumes with ZERO re-parsed shards and merges
+    byte-identical — the cheap exit the preemption notice buys over the
+    SIGKILL crash path."""
+    import threading
+
+    ref_hash, ref = reference_hash(tmp_path, corpus_file)
+    spec0 = job_spec(tmp_path, corpus_file, "pre", n_hosts=2, host_index=0)
+    spec1 = job_spec(tmp_path, corpus_file, "pre", n_hosts=2, host_index=1)
+    r0 = run(spec0)
+    assert r0.complete
+    notice = threading.Event()
+    notice.set()
+    pre = run(spec1, policy=JobPolicy(stop_event=notice,
+                                      io_backoff_s=0.005))
+    assert pre.preempted and pre.stopped_early and pre.committed == 1
+    revived = run(spec1)
+    assert revived.complete and revived.skipped == pre.committed
+    merged = merge_manifests(spec0.out_dir)
+    assert len(merged.shards) == ref.shards_total
+    assert merged_hash(spec0.out_dir,
+                       JobManifest.load(spec0.out_dir)) == ref_hash
+    assert leaked_temp_files(spec0.out_dir) == []
+
+
+def test_preemption_watcher_fires_on_commit_count(tmp_path):
+    """The preempt_host chaos watcher SIGTERMs the host exactly when
+    its commit log reaches the trigger count — driven with a fake
+    process so the unit is deterministic."""
+    import json as _json
+    import threading
+
+    from logparser_tpu.jobs.manifest import host_manifest_name
+    from logparser_tpu.pod.runner import (
+        _committed_in_host_manifest,
+        _preemption_watcher,
+    )
+
+    out = str(tmp_path)
+    assert _committed_in_host_manifest(out, 1) == 0  # absent = 0
+
+    class FakeProc:
+        def __init__(self):
+            self.terminated = threading.Event()
+
+        def poll(self):
+            return 3 if self.terminated.is_set() else None
+
+        def terminate(self):
+            self.terminated.set()
+
+    proc = FakeProc()
+    t = threading.Thread(target=_preemption_watcher,
+                         args=(out, 1, 2, proc, 0.01), daemon=True)
+    t.start()
+    # One commit: below the trigger, the watcher must keep waiting.
+    path = tmp_path / host_manifest_name(1)
+    path.write_text(_json.dumps({"shards": {"4": {}}}))
+    assert not proc.terminated.wait(0.15)
+    # Second commit: trigger reached -> SIGTERM.
+    path.write_text(_json.dumps({"shards": {"4": {}, "5": {}}}))
+    assert proc.terminated.wait(5.0)
+    t.join(5.0)
+    assert not t.is_alive()
+
+
 def test_run_pod_inline(tmp_path, corpus_file):
     ref_hash, ref = reference_hash(tmp_path, corpus_file)
     spec = PodSpec(
