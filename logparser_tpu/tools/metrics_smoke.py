@@ -41,6 +41,7 @@ REQUIRED_SUBSTRINGS = (
     'logparser_tpu_stage_seconds_bucket{stage="assembly",le="+Inf"}',
     'logparser_tpu_stage_seconds_bucket{stage="ipc",le="+Inf"}',
     "logparser_tpu_oracle_routed_lines_total",
+    "logparser_tpu_device_escaped_quote_lines_total",
     "logparser_tpu_service_requests_total",
     "logparser_tpu_parse_lines_total",
 )
@@ -120,14 +121,18 @@ def main() -> int:
     lines = [
         '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] '
         '"GET /i.html?x=1 HTTP/1.1" 200 512 "-" "smoke/1.0"',
-        # Plausible-but-device-rejected (backslash-escaped quote in the
-        # user-agent — the host regex accepts it, the optimistic device
-        # split does not): routes to the oracle, so the
-        # oracle_routed_lines_total counter must move.  (A 20-digit %b no
-        # longer qualifies: the round-9 full-int64 decoder keeps that
-        # class on device.)
+        # Still-host-rescued class (truncated >8k line — the device
+        # judges only a prefix and always defers to the host): routes to
+        # the oracle, so oracle_routed_lines_total must move.  (An
+        # escaped-quote user-agent no longer qualifies: the round-18
+        # escape-parity mask keeps that class on device, like the
+        # round-9 full-int64 decoder did for 20-digit %b.)
         '5.6.7.8 - - [31/Dec/2012:23:49:41 +0100] '
-        '"GET /big HTTP/1.1" 200 17 "-" "smoke \\" esc/1.0"',
+        f'"GET /big HTTP/1.1" 200 17 "-" "smoke {"x" * 8300} trunc/1.0"',
+        # Device-decoded escaped quote (round 18): stays ON device and
+        # moves device_escaped_quote_lines_total instead.
+        '9.10.11.12 - - [31/Dec/2012:23:49:42 +0100] '
+        '"GET /esc HTTP/1.1" 200 9 "-" "smoke \\" esc/1.0"',
     ]
     with ParseService(metrics_port=0) as svc:
         with ParseServiceClient(

@@ -1,22 +1,23 @@
 """CI rescue smoke: the batched host-rescue pipeline on a dirty corpus.
 
-Builds a small mixed stream with FORCED ~5% device-rejected lines (a
-backslash-escaped quote inside the user-agent: the host regex accepts
-it, the optimistic device split does not) plus the former overflow
-class (20-digit ``%b`` counters), then asserts the round-9 rescue
-contract end to end:
+Round 18 moved the escaped-quote class ON DEVICE (escape-parity mask in
+``pipeline.compute_split``), so the smoke now drills BOTH sides of the
+new boundary:
 
-- the overflow class stays ON DEVICE (full-int64 decoder: zero routed
-  lines, exact values delivered) — the widening guard;
-- the forced rejects are rescued with values identical to the per-line
-  oracle, through the BATCHED rescue path;
-- the rescue pipeline clears a throughput floor (rescued lines per
-  second of rescue wall — load-independent of the device, so the smoke
-  means the same thing on a CI CPU and a TPU host), and the batch's
-  effective rate clears a conservative floor;
-- a live ``/metrics`` scrape exposes the per-reason
-  ``oracle_routed_lines_total`` counters and stays well-formed
-  exposition (validated by metrics_smoke's strict grammar checker).
+- leg 1 (the rescue machinery, still-host-rescued class): a small mixed
+  stream with FORCED ~5% truncated >8k lines (the device judges only a
+  prefix and always defers; the host parses the full line) plus the
+  former overflow class (20-digit ``%b`` counters, on-device since
+  round 9).  Asserts: truncated lines rescued byte-identically through
+  the BATCHED rescue path, 20-digit values exact on device, rescue
+  throughput/effective floors, ``oracle_routed_lines_total`` reasons on
+  a live ``/metrics``.
+- leg 2 (the escaped-quote class, device-decoded): a 5% forced
+  escaped-quote corpus must route ZERO lines to the oracle
+  (``oracle_routed_lines_total`` unchanged across the parse), deliver
+  byte parity vs the per-line oracle, count every forced line in
+  ``device_escaped_quote_lines_total``, and expose that counter on
+  ``/metrics``.
 
 Usage::
 
@@ -31,37 +32,63 @@ import sys
 import time
 
 # Rescue-pipeline throughput floor (rescued lines per rescue-wall
-# second).  The compiled+codegen oracle clears ~25k even on a weak CI
-# core; the pre-round-4 generic engine (~10k) or a rescue path that
+# second).  The truncated class carries ~8KB lines, so the floor is set
+# below the escaped-quote era's 15k: the compiled+codegen oracle still
+# clears ~8k of these on a weak CI core; a rescue path that
 # re-serializes per line would trip it.
 RESCUE_RATE_FLOOR = float(os.environ.get(
-    "LOGPARSER_TPU_RESCUE_SMOKE_RATE_FLOOR", "15000"))
+    "LOGPARSER_TPU_RESCUE_SMOKE_RATE_FLOOR", "5000"))
 # Whole-batch effective floor — deliberately conservative: the smoke
 # runs on CI CPUs; the real >=5M gate is bench.py's RESCUE_EFFECTIVE
 # floor on the TPU host.
 EFFECTIVE_FLOOR = float(os.environ.get(
-    "LOGPARSER_TPU_RESCUE_SMOKE_EFFECTIVE_FLOOR", "10000"))
+    "LOGPARSER_TPU_RESCUE_SMOKE_EFFECTIVE_FLOOR", "2000"))
 
 N_LINES = 2048
+TRUNC_LEN = 8300          # > runtime.DEFAULT_MAX_LINE_LEN (8191)
 FIELDS = ["IP:connection.client.host", "BYTES:response.body.bytes",
           "HTTP.USERAGENT:request.user-agent"]
 
 
 def build_corpus():
+    """Leg-1 corpus: 5% truncated >8k (host-rescued), 5% 20-digit %b
+    (on-device), 90% clean."""
     from logparser_tpu.tools.demolog import generate_combined_lines
 
     base = generate_combined_lines(N_LINES, seed=90)
-    forced, overflow = [], []
+    truncated, overflow = [], []
     for i, ln in enumerate(base):
-        if i % 20 == 0:  # 5%: forced device-reject, host-rescued
-            base[i] = re.sub(r'"([^"]*)"$', r'"esc \\" quote \1"', ln,
+        if i % 20 == 0:  # 5%: truncated >8k, device defers, host rescues
+            pad = "x" * max(1, TRUNC_LEN - len(ln))
+            base[i] = re.sub(r'"([^"]*)"$', f'"trunc {pad} \\1"', ln,
                              count=1)
-            forced.append(i)
+            truncated.append(i)
         elif i % 20 == 10:  # 5%: the FORMER overflow reject class
             base[i] = re.sub(r'" (\d{3}) (\d+|-) ',
                              f'" \\1 {10**19 + i} ', ln, count=1)
             overflow.append(i)
-    return base, forced, overflow
+    return base, truncated, overflow
+
+
+def build_escaped_corpus():
+    """Leg-2 corpus: 5% forced escaped-quote user-agents — the class
+    that must now route ZERO lines (device escape-parity decode)."""
+    from logparser_tpu.tools.demolog import generate_combined_lines
+
+    base = generate_combined_lines(N_LINES, seed=91)
+    forced = []
+    for i in range(0, len(base), 20):
+        base[i] = re.sub(r'"([^"]*)"$', r'"esc \\" quote \1"', base[i],
+                         count=1)
+        forced.append(i)
+    return base, forced
+
+
+def _routed_total() -> float:
+    """Sum of oracle_routed_lines_total across reason labels."""
+    from logparser_tpu.observability import counter_sum
+
+    return counter_sum("oracle_routed_lines_total")
 
 
 def main() -> int:
@@ -71,7 +98,10 @@ def main() -> int:
     from logparser_tpu.core.exceptions import DissectionFailure
     from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
 
-    lines, forced, overflow = build_corpus()
+    errors = []
+
+    # ---- leg 1: the rescue machinery on the truncated class ----------
+    lines, truncated, overflow = build_corpus()
     parser = TpuBatchParser("combined", FIELDS)
     parser.parse_batch(lines)  # warm: compile + caches
 
@@ -79,15 +109,19 @@ def main() -> int:
     result = parser.parse_batch(lines)
     wall = time.perf_counter() - t0
 
-    errors = []
     reasons = result.rescue_reasons
-    # (a) widening guard: the overflow class must NOT route.
     routed = result.oracle_rows
-    if reasons.get("overflow", 0) or routed > len(forced):
+    # (a) widening guard: the 20-digit class must NOT route; the ONLY
+    # routed lines are the truncated ones (reason "overflow").
+    if reasons.get("device_reject", 0) or routed > len(truncated):
         errors.append(
-            f"former overflow class routed to the oracle: rows={routed} "
-            f"reasons={reasons} (expected only the {len(forced)} forced "
-            "rejects)"
+            f"unexpected oracle routing: rows={routed} reasons={reasons} "
+            f"(expected only the {len(truncated)} truncated lines)"
+        )
+    if reasons.get("overflow", 0) < len(truncated):
+        errors.append(
+            f"truncated lines not routed: {reasons} (expected >= "
+            f"{len(truncated)} overflow)"
         )
     vals = result.to_pylist("BYTES:response.body.bytes")
     for i in overflow:
@@ -95,24 +129,19 @@ def main() -> int:
             errors.append(f"overflow row {i}: device value {vals[i]!r} != "
                           f"{10**19 + i}")
             break
-    # (b) forced rejects rescued, bit-identical to the per-line oracle.
-    if reasons.get("device_reject", 0) < len(forced):
-        errors.append(
-            f"forced rejects not routed: {reasons} (expected >= "
-            f"{len(forced)} device_reject)"
-        )
+    # (b) truncated lines rescued, bit-identical to the per-line oracle.
     ua = result.to_pylist("HTTP.USERAGENT:request.user-agent")
-    for i in forced[: 8]:
+    for i in truncated[: 4]:
         try:
             rec = parser.oracle.parse(lines[i], _CollectingRecord())
             want = rec.values.get("HTTP.USERAGENT:request.user-agent")
         except DissectionFailure:
-            errors.append(f"forced line {i} not host-parseable")
+            errors.append(f"truncated line {i} not host-parseable")
             break
         if not result.valid[i] or ua[i] != want:
             errors.append(
-                f"forced row {i} not rescued bit-identically: "
-                f"{ua[i]!r} != {want!r}"
+                f"truncated row {i} not rescued bit-identically: "
+                f"{(ua[i] or '')[:40]!r}... != {(want or '')[:40]!r}..."
             )
             break
     # (c) throughput floors.
@@ -130,8 +159,43 @@ def main() -> int:
             f"{EFFECTIVE_FLOOR:.0f} smoke floor"
         )
 
-    # (d) /metrics exposes the per-reason rescue counters (live scrape,
-    # strict exposition grammar — reuses metrics_smoke's validator).
+    # ---- leg 2: the escaped-quote class must stay on device ----------
+    esc_lines, forced = build_escaped_corpus()
+    esc_parser = TpuBatchParser("combined", FIELDS)
+    esc_parser.parse_batch(esc_lines)  # warm
+    routed_before = _routed_total()
+    esc_result = esc_parser.parse_batch(esc_lines)
+    routed_after = _routed_total()
+    if esc_result.oracle_rows or routed_after != routed_before:
+        errors.append(
+            "escaped-quote corpus routed lines to the oracle: "
+            f"oracle_rows={esc_result.oracle_rows}, "
+            f"oracle_routed_lines_total {routed_before} -> {routed_after} "
+            "(must be unchanged — the class lives on device)"
+        )
+    if esc_result.escaped_quote_rows < len(forced):
+        errors.append(
+            f"device decoded {esc_result.escaped_quote_rows} < "
+            f"{len(forced)} forced escaped-quote lines "
+            "(device_escaped_quote_lines_total undercounts)"
+        )
+    esc_ua = esc_result.to_pylist("HTTP.USERAGENT:request.user-agent")
+    for i in forced[: 8]:
+        try:
+            rec = esc_parser.oracle.parse(esc_lines[i], _CollectingRecord())
+            want = rec.values.get("HTTP.USERAGENT:request.user-agent")
+        except DissectionFailure:
+            errors.append(f"escaped line {i} not host-parseable")
+            break
+        if not esc_result.valid[i] or esc_ua[i] != want:
+            errors.append(
+                f"escaped row {i} device decode not bit-identical to the "
+                f"oracle: {esc_ua[i]!r} != {want!r}"
+            )
+            break
+
+    # (d) /metrics exposes the per-reason rescue counters AND the new
+    # escaped-quote counter (live scrape, strict exposition grammar).
     from logparser_tpu.service import ParseService, ParseServiceClient
     from logparser_tpu.tools.metrics_smoke import validate_exposition
 
@@ -139,15 +203,21 @@ def main() -> int:
         with ParseServiceClient(svc.host, svc.port, "combined",
                                 FIELDS) as client:
             client.parse(lines[: 256])
+            client.parse(esc_lines[: 256])
         url = f"http://{svc.host}:{svc.metrics_port}/metrics"
         with urllib.request.urlopen(url, timeout=10) as resp:
             text = resp.read().decode("utf-8")
     errors += validate_exposition(text)
-    if ('logparser_tpu_oracle_routed_lines_total{reason="device_reject"}'
+    if ('logparser_tpu_oracle_routed_lines_total{reason="overflow"}'
             not in text):
         errors.append(
             "/metrics missing per-reason rescue counter "
-            "oracle_routed_lines_total{reason=\"device_reject\"}"
+            "oracle_routed_lines_total{reason=\"overflow\"}"
+        )
+    if "logparser_tpu_device_escaped_quote_lines_total" not in text:
+        errors.append(
+            "/metrics missing device_escaped_quote_lines_total "
+            "(the escaped-quote decode counter)"
         )
 
     if errors:
@@ -159,7 +229,10 @@ def main() -> int:
         "rescue smoke OK: "
         f"{routed}/{len(lines)} routed ({reasons}), "
         f"rescue {rescue_rate:.0f} lines/s, "
-        f"effective {effective:.0f} lines/s, /metrics well-formed"
+        f"effective {effective:.0f} lines/s; "
+        f"escaped-quote leg: 0 routed, "
+        f"{esc_result.escaped_quote_rows} device-decoded, "
+        "/metrics well-formed"
     )
     return 0
 

@@ -583,6 +583,12 @@ class BatchResult:
         # counts by reject reason and the wall seconds rescue added.
         self.rescue_reasons: Dict[str, int] = {}
         self.rescue_wall_s: float = 0.0
+        # Lines the device claimed THROUGH the escape-parity mask (their
+        # quoted-field split skipped a backslash-escaped separator
+        # occurrence): the round-18 class that used to route to the host
+        # rescue.  Filled by the materializer from the winning unit's
+        # ESC_QUOTE_BIT; mirrors device_escaped_quote_lines_total.
+        self.escaped_quote_rows: int = 0
         # Per-row reject ledger (filled by the materializer): row ->
         # stable reason ("implausible" | "oracle_reject" |
         # "oracle_error") for every row whose ``valid`` ended False —
@@ -816,8 +822,9 @@ class BatchResult:
         DROPPED (slices deliver copy-mode Arrow — the coalesced wire
         path never ships views; ``strings="view"`` still works through
         the host gather), and the parent's batch-level rescue
-        composition stats (``rescue_reasons``/``rescue_wall_s``) stay on
-        the parent — they describe the shared batch, not any window."""
+        composition stats (``rescue_reasons``/``rescue_wall_s``/
+        ``escaped_quote_rows``) stay on the parent — they describe the
+        shared batch, not any window."""
         B = self.lines_read
         start = max(0, min(int(start), B))
         stop = max(start, min(int(stop), B))
@@ -2714,6 +2721,27 @@ class TpuBatchParser:
                 need_oracle.add(i)
                 extra_rows.append(i)
         observe_stage("csr_materialize", time.perf_counter() - t_csr, items=B)
+        # Escaped-quote decode accounting (round 18): lines the device
+        # claimed THROUGH the escape-parity mask — the winning unit's
+        # ESC_QUOTE_BIT on rows that survived every demotion above.
+        # These are exactly the lines that pre-round-18 routed to the
+        # host rescue as device_reject.
+        escaped_quote_rows = 0
+        if packed is not None and self.units:
+            from .pipeline import ESC_QUOTE_BIT
+
+            esc_bits = np.stack([
+                (packed[u.row_offset, :B] & ESC_QUOTE_BIT) != 0
+                for u in self.units
+            ])
+            esc_won = np.take_along_axis(
+                esc_bits, np.maximum(winner, 0)[None, :], axis=0
+            )[0]
+            escaped_quote_rows = int(np.count_nonzero(esc_won & valid))
+            if escaped_quote_rows:
+                reg.increment(
+                    "device_escaped_quote_lines_total", escaped_quote_rows
+                )
         # Routed-line accounting by reject class (batch granularity): WHY
         # each line left the device-only path.  overflow = truncated lines
         # the device judged on a prefix; device_reject = no automaton
@@ -2908,6 +2936,7 @@ class TpuBatchParser:
         # composition line and the smoke tool read these).
         result.rescue_reasons = rescue_reasons
         result.rescue_wall_s = rescue_wall
+        result.escaped_quote_rows = escaped_quote_rows
         result.reject_reasons = reject_reasons
         result.oracle_row_ids = np.asarray(oracle_rows_sorted, dtype=np.int64)
         return result

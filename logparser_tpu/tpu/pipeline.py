@@ -108,17 +108,96 @@ def _charset_mask(b32: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
     return ok
 
 
+# ---------------------------------------------------------------------------
+# Escaped-quote decoding (round 18, ROADMAP direction 5).  Apache's
+# ap_escape_logitem writes `\"` for a quote inside a quoted field (%r /
+# %{User-Agent}i ...) and `\\` for a backslash, so in a well-formed log a
+# DATA quote always sits behind an odd-length backslash run and a field
+# TERMINATOR behind an even one.  The reference regex is escape-UNAWARE
+# (FORMAT_STRING is a bare lazy `.*?`): it accepts these lines through
+# backtracking and delivers the span VERBATIM, backslashes included
+# (httpd/utils_apache.py replicates the upstream bug that keeps the
+# decode dormant).  The device split therefore models the terminator
+# choice, not a byte rewrite: a quote-led separator occurrence whose
+# quote has odd backslash parity is masked out of the cursor search.
+#
+# Soundness (device-valid must imply byte-identity with the host):
+# - FINAL op (the format's last separator, host rest is `$`): masking is
+#   unconditionally exact.  The host's lazy scan tries occurrences in
+#   order and only an occurrence ENDING the line can satisfy the end
+#   anchor; every masked (odd-parity) occurrence the device skipped lies
+#   strictly before its chosen terminator, hence before line end, hence
+#   the host rejects it too and lands on the same terminator.
+# - NON-final op: the host might match at a skipped occurrence (its rest
+#   is a full regex tail, satisfiable by hostile bytes), and proving it
+#   cannot requires evaluating that tail.  Such lines are NOT claimed:
+#   any skipped occurrence before the chosen terminator invalidates the
+#   line and routes it to the oracle, which applies the reference's
+#   backtracking exactly.  (Realistic escaped quotes inside %r/referer
+#   rarely form a separator occurrence at all — `\"x` contains no
+#   `" `/`" "` — so the conservative arm costs only genuinely ambiguous
+#   lines, which also failed the device split before this round.)
+#
+# Plausibility is untouched: the host regex is escape-unaware, so the
+# UNMASKED occurrence masks remain the sound model (regex-accept still
+# implies plausible).
+# ---------------------------------------------------------------------------
+
+_BACKSLASH = 0x5C
+
+
+def esc_quote_op_flags(program: DeviceProgram) -> Dict[int, bool]:
+    """{op position: op is the program's final op} for every until_lit
+    whose separator begins with a quote over an unconstrained (CS_ANY)
+    capture — the quoted-field shape escape-parity masking applies to."""
+    ops = program.ops
+    return {
+        i: i == len(ops) - 1
+        for i, op in enumerate(ops)
+        if op.kind == "until_lit"
+        and op.lit[:1] == b'"'
+        and op.charset == CS_ANY
+    }
+
+
+def escaped_lead_positions(b32: jnp.ndarray) -> jnp.ndarray:
+    """[B, L] bool: the maximal backslash run immediately before position
+    p has ODD length — a quote AT p is escaped data under Apache's
+    ap_escape_logitem convention, not a field terminator.  One vectorized
+    O(B*L) pass (compare + running max), independent of the byte at p;
+    zero-padding past line end breaks runs, so no lengths mask is
+    needed."""
+    B, L = b32.shape
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
+    non_bs = b32 != _BACKSLASH
+    last_non_bs = jax.lax.cummax(
+        jnp.where(non_bs, pos, -1), axis=1
+    )
+    prev_last = jnp.concatenate(
+        [jnp.full((B, 1), -1, dtype=jnp.int32), last_non_bs[:, :-1]],
+        axis=1,
+    )
+    run_before = (pos - 1) - prev_last
+    return (run_before & 1) == 1
+
+
 def compute_split_dense(
     program: DeviceProgram,
     b32: jnp.ndarray,
     lengths: jnp.ndarray,
     need_plausible: bool = False,
-) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], jnp.ndarray, Optional[jnp.ndarray]]:
+) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """Run the split program over int32 byte rows.
 
-    Returns (start_list, end_list, valid, plausible): per-token [B] cursors
-    plus the per-line validity mask.  Gather-free: precomputed literal-match
-    masks and charset masks + masked reductions.
+    Returns (start_list, end_list, valid, plausible, esc_hit): per-token
+    [B] cursors plus the per-line validity mask.  Gather-free: precomputed
+    literal-match masks and charset masks + masked reductions.
+
+    ``esc_hit`` (None for programs without a quoted-field op) marks lines
+    whose quoted-field cursor search skipped a backslash-escaped separator
+    occurrence under the escape-parity mask (see the module comment above
+    this function) — on a line that stays valid, the device decoded an
+    escaped quote the pre-round-18 split would have rejected.
 
     ``plausible`` (only when need_plausible) is a SOUND over-approximation of
     "the format's real regex could accept this line": all literal separators
@@ -152,6 +231,10 @@ def compute_split_dense(
         name: _charset_mask(b32, program.charset_table[cid])
         for name, cid in program.charset_ids.items()
     }
+
+    esc_ops = esc_quote_op_flags(program)
+    esc_mask = escaped_lead_positions(b32) if esc_ops else None
+    esc_hit = jnp.zeros(B, dtype=bool) if esc_ops else None
 
     def check_charset(start, end, op, valid):
         cs_ok = cs_masks[op.charset]
@@ -220,7 +303,7 @@ def compute_split_dense(
             plausible = plausible & (found < L)
             p_cursor = found + k
 
-    for op in program.ops:
+    for oi, op in enumerate(program.ops):
         if op.kind == "lit":
             # Literal matches exactly at the cursor: probe the match mask
             # with a one-hot reduction (no gather).
@@ -229,7 +312,24 @@ def compute_split_dense(
             cursor = cursor + len(op.lit)
         elif op.kind == "until_lit":
             usable = lit_masks[op.lit] & (pos >= cursor[:, None])
+            if oi in esc_ops:
+                # Escape-parity mask: an occurrence whose quote sits
+                # behind an odd backslash run is data, not a terminator.
+                skipped = usable & esc_mask
+                usable = usable & ~esc_mask
+                first_skip = jnp.min(
+                    jnp.where(skipped, pos, L), axis=1
+                ).astype(jnp.int32)
             found = jnp.min(jnp.where(usable, pos, L), axis=1).astype(jnp.int32)
+            if oi in esc_ops:
+                had_skip = first_skip < found
+                if esc_ops[oi]:
+                    # Final op: skipping is exact (host rest is `$`).
+                    esc_hit = esc_hit | had_skip
+                else:
+                    # Non-final op: the host might match at the skipped
+                    # occurrence — don't claim, let the oracle decide.
+                    valid = valid & ~had_skip
             token_valid = found < L
             start = cursor
             end = jnp.where(token_valid, found, cursor)
@@ -249,7 +349,7 @@ def compute_split_dense(
 
     # The whole line must be consumed (the regex is end-anchored).
     valid = valid & (cursor == lengths)
-    return starts, ends, valid, plausible
+    return starts, ends, valid, plausible, esc_hit
 
 
 # ---------------------------------------------------------------------------
@@ -372,10 +472,11 @@ def compute_split(
     b32: jnp.ndarray,
     lengths: jnp.ndarray,
     need_plausible: bool = False,
-) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], jnp.ndarray, Optional[jnp.ndarray]]:
+) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """Bitplane execution of the split program — semantically identical to
     :func:`compute_split_dense` (same return contract; see its docstring for
-    the plausibility soundness argument), one O(B*L) packing pass total."""
+    the plausibility soundness argument and the escape-parity module
+    comment for ``esc_hit``), one O(B*L) packing pass total."""
     if any(0 in op.lit for op in program.ops if op.lit):
         # A NUL byte inside a separator literal would collide with the
         # zero padding the plane derivation relies on.
@@ -407,9 +508,15 @@ def compute_split(
         # inside the line (pos + len(lit) <= lengths).
         lit_planes[lit] = m & _plane_cutoff(lengths - (len(lit) - 1), C)
 
+    esc_ops = esc_quote_op_flags(program)
+    esc_plane = (
+        _plane_pack(escaped_lead_positions(bp), C) if esc_ops else None
+    )
+
     zeros = jnp.zeros(B, dtype=jnp.int32)
     cursor = zeros
     valid = jnp.ones(B, dtype=bool)
+    esc_hit = jnp.zeros(B, dtype=bool) if esc_ops else None
     n_tok = len(program.tokens)
     starts: List[jnp.ndarray] = [zeros] * n_tok
     ends: List[jnp.ndarray] = [zeros] * n_tok
@@ -424,13 +531,29 @@ def compute_split(
             ok = ok & (width <= op.max_len)
         return ok
 
-    for op in program.ops:
+    for oi, op in enumerate(program.ops):
         if op.kind == "lit":
             ok = _plane_test_bit(lit_planes[op.lit], cursor, C)
             valid = valid & ok
             cursor = cursor + len(op.lit)
         elif op.kind == "until_lit":
-            found = _plane_first_ge(lit_planes[op.lit], cursor, C, L)
+            if oi in esc_ops:
+                # Escape-parity mask (see the dense variant): search the
+                # even-parity plane; a skipped odd-parity occurrence is
+                # exact for the final op, un-claims the line otherwise.
+                found = _plane_first_ge(
+                    lit_planes[op.lit] & ~esc_plane, cursor, C, L
+                )
+                first_skip = _plane_first_ge(
+                    lit_planes[op.lit] & esc_plane, cursor, C, L
+                )
+                had_skip = first_skip < found
+                if esc_ops[oi]:
+                    esc_hit = esc_hit | had_skip
+                else:
+                    valid = valid & ~had_skip
+            else:
+                found = _plane_first_ge(lit_planes[op.lit], cursor, C, L)
             token_valid = found < L
             start = cursor
             end = jnp.where(token_valid, found, cursor)
@@ -489,7 +612,7 @@ def compute_split(
                 found = _plane_first_ge(plane, lower, C, L)
             plausible = plausible & (found < L)
             p_cursor = found + k
-    return starts, ends, valid, plausible
+    return starts, ends, valid, plausible, esc_hit
 
 
 # ---------------------------------------------------------------------------
@@ -521,8 +644,12 @@ CSR_SLOTS = 16
 CSR_SLOTS_MAX = 128
 
 # row 0 bit assignments (see compute_rows): bit 0 = line validity, bit 1 =
-# plausibility (multi-format winner protocol), bit 2 = CSR slot overflow.
+# plausibility (multi-format winner protocol), bit 2 = CSR slot overflow,
+# bit 3 = the valid line's quoted-field split consumed a backslash-escaped
+# separator occurrence (escape-parity masking — the device handled a line
+# that pre-round-18 routed to the host rescue).
 CSR_OVERFLOW_BIT = 4
+ESC_QUOTE_BIT = 8
 
 
 def csr_group_key(plan: FieldPlan) -> str:
@@ -756,7 +883,7 @@ def compute_rows(
     to its 3 LE-packed first-12-byte words (see span_prefix_words),
     consumed by the winner merge in :func:`compute_view_rows`."""
     B = b32.shape[0]
-    starts, ends, valid, plausible = compute_split(
+    starts, ends, valid, plausible, esc_hit = compute_split(
         program, b32, lengths, need_plausible
     )
     extract = postproc.gather_span_bytes
@@ -1123,6 +1250,13 @@ def compute_rows(
     row0 = jnp.where(valid, 1, 0).astype(jnp.int32)
     if plausible is not None:
         row0 = row0 | (jnp.where(plausible, 2, 0).astype(jnp.int32))
+    if esc_hit is not None:
+        # Escaped-quote decode marker: only meaningful on lines this
+        # format still claims after every constraint (the host counts
+        # device_escaped_quote_lines_total from the winning unit's bit).
+        row0 = row0 | jnp.where(
+            esc_hit & valid, ESC_QUOTE_BIT, 0
+        ).astype(jnp.int32)
     for overflowed in csr_overflow_rows:
         row0 = row0 | jnp.where(overflowed, CSR_OVERFLOW_BIT, 0).astype(
             jnp.int32
@@ -1226,7 +1360,7 @@ def _units_rows_and_prefixes(
         if u.plausibility_only:
             # Uncompilable format: one row, plausible bit only (bit 1);
             # the valid bit is never set so the probe cannot win a line.
-            _, _, _, plausible = compute_split(
+            _, _, _, plausible, _ = compute_split(
                 u.program, buf, lengths, need_plausible=True
             )
             rows.append(jnp.where(plausible, 2, 0).astype(jnp.int32))

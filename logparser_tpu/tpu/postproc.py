@@ -932,3 +932,86 @@ def split_firstline(
         "has_protocol": has_protocol,
         "ok": has_space,
     }
+
+
+def unescape_compact_spans(
+    buf: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+    width: int,
+    extract=None,
+):
+    """Device-side inverse of Apache's ``ap_escape_logitem`` for the
+    byte-DROPPING escape classes: ``\\"`` -> ``"`` and ``\\\\`` -> ``\\``
+    inside a quoted-field span, as a compaction gather.
+
+    Returns ``(out, out_len, exact)``: ``out`` is ``[B, width]`` span
+    bytes with every ESCAPING backslash removed (bytes past ``out_len``
+    zeroed), ``exact`` marks rows where this IS the reference decode
+    (:func:`...dissectors.utils.decode_apache_httpd_log_value`) of the
+    span.  Rows carrying a byte-SUBSTITUTING escape (``\\n``/``\\t``/
+    ``\\xhh`` ... — C-escapes the reference maps to different bytes, and
+    a bare trailing backslash, which it raises on) or a span longer than
+    ``width`` are flagged inexact and left for the host decoder.
+
+    NOTE this pass is NOT in the product delivery path: the reference
+    compares the VALUE (not the token name) before applying its decode
+    (ApacheHttpdLogFormatDissector.java:170-198 — see
+    httpd/utils_apache.py), so the observable host semantics deliver
+    quoted-field values VERBATIM, backslashes included, and the
+    escape-parity split (pipeline.compute_split) already emits exactly
+    those verbatim spans.  The pass exists for consumers that want the
+    DECODED form on device (and as the executable spec of the escape
+    geometry, differentially locked against the reference decoder in
+    tests/test_fuzz_differential.py).  Cost: one [B, width] stable
+    argsort — a cold-path utility, not a hot-path stage.
+
+    Decode model (mirrors the reference's left-to-right pair scan): in a
+    maximal backslash run of length n, the backslashes at even offsets
+    0, 2, ... are the escaping bytes of ``\\\\`` pairs and are dropped;
+    an odd run's trailing backslash is dropped only when it escapes a
+    quote (``\\"``), kept verbatim before an unknown character (the
+    reference's fall-through appends both bytes), and inexact before a
+    substituting C-escape."""
+    extract = extract or gather_span_bytes
+    B = start.shape[0]
+    width = min(width, buf.shape[1])  # extract clamps to the buffer width
+    n = jnp.clip(end - start, 0, None)
+    win = extract(buf, start, width).astype(jnp.int32)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, width), 1)
+    in_span = pos < n[:, None]
+    is_bs = (win == ord("\\")) & in_span
+
+    # Offset of each backslash inside its maximal run: distance to the
+    # last non-backslash before it (same running-max derivation as
+    # pipeline.escaped_lead_positions, here within the span window).
+    non_bs = ~is_bs
+    last_non_bs = jax.lax.cummax(jnp.where(non_bs, pos, -1), axis=1)
+    prev_last = jnp.concatenate(
+        [jnp.full((B, 1), -1, dtype=jnp.int32), last_non_bs[:, :-1]],
+        axis=1,
+    )
+    even_offset = ((pos - prev_last) & 1) == 1  # offset = pos-prev_last-1
+
+    nxt = shift_zero(win, 1)
+    nxt_in_span = (pos + 1) < n[:, None]
+    last_of_run = is_bs & ~(shift_zero(is_bs, 1) & nxt_in_span)
+    odd_tail = last_of_run & even_offset  # odd run length <=> last offset even
+    escapes_quote = odd_tail & nxt_in_span & (nxt == ord('"'))
+    # Substituting C-escapes (byte rewrite, not a drop) and a trailing
+    # backslash with nothing after it: the reference decode diverges
+    # from pure compaction there — flag the row.
+    subst = jnp.zeros(odd_tail.shape, dtype=bool)
+    for c in b"bnrtvx":
+        subst = subst | (nxt == c)
+    inexact_pos = odd_tail & ((subst & nxt_in_span) | ~nxt_in_span)
+
+    drop = is_bs & even_offset & (~odd_tail | escapes_quote)
+    keep = in_span & ~drop
+    out_len = jnp.sum(jnp.where(keep, 1, 0), axis=1)
+    # Stable compaction: kept bytes first, original order preserved.
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    out = jnp.take_along_axis(win, order, axis=1)
+    out = jnp.where(pos < out_len[:, None], out, 0)
+    exact = (n <= width) & ~jnp.any(inexact_pos, axis=1)
+    return out, out_len, exact

@@ -97,7 +97,7 @@ def _run_program_impl(
     """Back-compat wrapper over the shared split pipeline (pipeline.py)."""
     from .pipeline import compute_split
 
-    starts, ends, valid, _ = compute_split(program, buf.astype(jnp.int32), lengths)
+    starts, ends, valid, _, _ = compute_split(program, buf.astype(jnp.int32), lengths)
     return {
         "starts": jnp.stack(starts),
         "ends": jnp.stack(ends),

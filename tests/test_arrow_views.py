@@ -76,13 +76,14 @@ def test_view_matches_copy_oracle_override_rows():
     """Host-override (oracle) rows patch in as side-buffer strings."""
     parser = shared_parser("combined", HEADLINE_FIELDS)
     lines = generate_combined_lines(64, seed=12)
-    # A backslash-escaped quote in the user-agent forces the oracle for
-    # the line (device split rejects, host regex accepts); other columns
-    # of that row become overrides.  (>19-digit byte counts stay on
-    # device since the round-9 full-int64 decoder.)
+    # A referer ending in a backslash (`\" "` — ambiguous non-final
+    # separator occurrence) forces the oracle for the line (device
+    # defers by design, host regex accepts); other columns of that row
+    # become overrides.  (>19-digit byte counts stay on device since
+    # round 9; escaped-quote USER-AGENTS since round 18.)
     lines[7] = ('9.9.9.9 - frank [10/Oct/2023:13:55:36 -0700] '
-                '"GET /ov HTTP/1.0" 200 123456789012345678901 "-" '
-                '"z \\" z"')
+                '"GET /ov HTTP/1.0" 200 123456789012345678901 "r\\" '
+                '"z z"')
     res = parser.parse_batch(lines)
     assert res.oracle_rows >= 1
     tv = _assert_tables_match(res)

@@ -1,10 +1,11 @@
 """Differential lock: the bitplane split executor == the dense one.
 
 `pipeline.compute_split` (bitplane) must reproduce
-`pipeline.compute_split_dense` bit-for-bit — starts, ends, validity AND
-plausibility — across format shapes that exercise every op kind (leading
-literal, until_lit chains, to_end tails with bounded/narrow charsets) on
-real-ish, hostile, and boundary corpora.
+`pipeline.compute_split_dense` bit-for-bit — starts, ends, validity,
+plausibility AND the escape-parity esc_hit marker — across format shapes
+that exercise every op kind (leading literal, until_lit chains, to_end
+tails with bounded/narrow charsets) on real-ish, hostile, and boundary
+corpora (including backslash-escaped quotes in quoted fields).
 """
 import numpy as np
 import pytest
@@ -44,6 +45,19 @@ def _corpus(seed):
         '1.2.3.4 - - [01/Jan/2024:00:00:00 +0000] "GET / HTTP/1.0" 200 0 "x" "y"',
         " ".join(['"'] * 10),
         "".join(rng.choice(list(' "[]abc0123'), size=50)),
+        # Escape-parity adversaries (round 18): escaped quotes in the
+        # final field (device-decoded), backslash runs of every parity,
+        # a bare trailing backslash, and a skipped non-final occurrence.
+        '1.2.3.4 - - [01/Jan/2024:00:00:00 +0000] "GET / HTTP/1.0" 200 0 '
+        '"x" "esc \\" quote"',
+        '1.2.3.4 - - [01/Jan/2024:00:00:00 +0000] "GET / HTTP/1.0" 200 0 '
+        '"x" "tail\\"',
+        '1.2.3.4 - - [01/Jan/2024:00:00:00 +0000] "GET / HTTP/1.0" 200 0 '
+        '"x" "even\\\\"',
+        '1.2.3.4 - - [01/Jan/2024:00:00:00 +0000] "GET /p\\" HTTP/1.0" 200 '
+        '0 "x" "y"',
+        '"\\" " \\" " "\\\\" "\\\\\\"',
+        "".join(rng.choice(list(' "\\ab0'), size=60)),
     ]
     return lines
 
@@ -56,14 +70,18 @@ def test_bitplane_matches_dense(fmt, fields):
     jbuf, jlen = jnp.asarray(buf), jnp.asarray(lengths)
     for unit in parser.units:
         prog = unit.program
-        s_d, e_d, v_d, p_d = compute_split_dense(
+        s_d, e_d, v_d, p_d, esc_d = compute_split_dense(
             prog, jbuf, jlen, need_plausible=True
         )
-        s_b, e_b, v_b, p_b = compute_split(
+        s_b, e_b, v_b, p_b, esc_b = compute_split(
             prog, jbuf, jlen, need_plausible=True
         )
         np.testing.assert_array_equal(np.asarray(v_d), np.asarray(v_b))
         np.testing.assert_array_equal(np.asarray(p_d), np.asarray(p_b))
+        if esc_d is not None or esc_b is not None:
+            np.testing.assert_array_equal(
+                np.asarray(esc_d), np.asarray(esc_b)
+            )
         for i, (sd, sb) in enumerate(zip(s_d, s_b)):
             # starts/ends only meaningful on valid lines (the dense path
             # leaves stale cursors on invalid ones) — but the executors
@@ -89,8 +107,8 @@ def test_bitplane_long_literal_separator():
     buf, lengths, _ = runtime.encode_batch(lines)
     jbuf, jlen = jnp.asarray(buf), jnp.asarray(lengths)
     prog = parser.units[0].program
-    s_d, e_d, v_d, p_d = compute_split_dense(prog, jbuf, jlen, True)
-    s_b, e_b, v_b, p_b = compute_split(prog, jbuf, jlen, True)
+    s_d, e_d, v_d, p_d, _ = compute_split_dense(prog, jbuf, jlen, True)
+    s_b, e_b, v_b, p_b, _ = compute_split(prog, jbuf, jlen, True)
     np.testing.assert_array_equal(np.asarray(v_d), np.asarray(v_b))
     np.testing.assert_array_equal(np.asarray(p_d), np.asarray(p_b))
     for sd, sb in zip(s_d + e_d, s_b + e_b):
@@ -109,8 +127,8 @@ def test_bitplane_non_multiple_of_32_width():
     assert buf.shape[1] % 32 != 0
     jbuf, jlen = jnp.asarray(buf), jnp.asarray(lengths)
     prog = parser.units[0].program
-    s_d, e_d, v_d, p_d = compute_split_dense(prog, jbuf, jlen, True)
-    s_b, e_b, v_b, p_b = compute_split(prog, jbuf, jlen, True)
+    s_d, e_d, v_d, p_d, _ = compute_split_dense(prog, jbuf, jlen, True)
+    s_b, e_b, v_b, p_b, _ = compute_split(prog, jbuf, jlen, True)
     np.testing.assert_array_equal(np.asarray(v_d), np.asarray(v_b))
     np.testing.assert_array_equal(np.asarray(p_d), np.asarray(p_b))
     for sd, sb in zip(s_d + e_d, s_b + e_b):
@@ -125,6 +143,6 @@ def test_bitplane_int32_input():
     jbuf = jnp.asarray(buf).astype(jnp.int32)
     jlen = jnp.asarray(lengths)
     prog = parser.units[0].program
-    _, _, v_d, _ = compute_split_dense(prog, jbuf, jlen)
-    _, _, v_b, _ = compute_split(prog, jbuf, jlen)
+    _, _, v_d, _, _ = compute_split_dense(prog, jbuf, jlen)
+    _, _, v_b, _, _ = compute_split(prog, jbuf, jlen)
     np.testing.assert_array_equal(np.asarray(v_d), np.asarray(v_b))

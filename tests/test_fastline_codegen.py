@@ -103,7 +103,8 @@ def corpus():
         '"GET /x HTTP/1.1" 200 9999999999999999999 "-" "x"',
         '1.2.3.4 - - [10/Oct/2023:13:55:36 -0700] '
         '"GET /x HTTP/1.1" 200 10000000000000000000 "-" "x"',
-        # Device-rejected, host-rescued (the forced-reject bench class)
+        # Escaped quote in the UA (device-decoded since round 18; still
+        # a host-engine differential case here)
         '1.2.3.4 - - [10/Oct/2023:13:55:36 -0700] '
         '"GET /x HTTP/1.1" 200 5 "-" "esc \\" quote"',
         # The faithful upstream decode quirk: a VALUE literally equal to

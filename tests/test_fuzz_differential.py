@@ -583,3 +583,174 @@ def test_localized_timestamps_device_matches_oracle(locale_tag):
     res = parser.parse_batch(lines[:60])
     assert res.good_lines == 60
     assert res.oracle_rows == 0  # localized names stay device-resident
+
+
+# --------------------------------------------------------------------------
+# Quote-escape differential matrix (round 18): the escape-parity mask in
+# pipeline.compute_split decodes backslash-escaped quotes ON DEVICE for
+# the final quoted field and conservatively defers ambiguous non-final
+# occurrences to the oracle.  Either way the contract is the same one
+# this whole file enforces: device output byte-identical to the per-line
+# host oracle (which is escape-UNAWARE and delivers spans VERBATIM,
+# backslashes included — httpd/utils_apache.py).
+# --------------------------------------------------------------------------
+
+ESC_FIELDS = [
+    "IP:connection.client.host",
+    "HTTP.METHOD:request.firstline.method",
+    "HTTP.URI:request.firstline.uri",
+    "STRING:request.status.last",
+    "BYTES:response.body.bytes",
+    "HTTP.URI:request.referer",
+    "HTTP.USERAGENT:request.user-agent",
+]
+
+_BS = "\\"
+
+
+def _combined_line(r="GET /i HTTP/1.1", b="5", ref="-", ua="Mozilla/5.0"):
+    return (
+        f'1.2.3.4 - - [10/Oct/2020:13:55:36 -0700] "{r}" 200 {b} '
+        f'"{ref}" "{ua}"'
+    )
+
+
+def esc_matrix_lines():
+    lines = [
+        # backslash as the FINAL byte of a field: the closing quote reads
+        # as escaped (odd parity) — device defers, oracle delivers.
+        _combined_line(ua="Mozilla" + _BS),
+        _combined_line(ref="/r" + _BS),
+        _combined_line(r="GET /p" + _BS + " HTTP/1.1"),
+        # \\" — escaped backslash then REAL closing quote (even run).
+        _combined_line(ua="Moz" + _BS * 2),
+        _combined_line(ref="/q" + _BS * 2),
+    ]
+    # Runs of 2-5 backslashes before a quote: closing (parity decides
+    # whether the quote terminates) and interior (host backtracking
+    # territory on even runs).
+    for n in range(2, 6):
+        lines.append(_combined_line(ua="run" + _BS * n))
+        lines.append(_combined_line(ua="in " + _BS * n + '" tail'))
+    lines += [
+        # Multiple escaped quotes in one field.
+        _combined_line(ua="a " + _BS + '" b ' + _BS + '" c'),
+        _combined_line(ua=_BS + '"' + _BS + '"' + _BS + '"'),
+        _combined_line(ref="r " + _BS + '"x' + _BS + '" y'),
+        # Escaped quotes in %r vs %{User-Agent}i vs both.
+        _combined_line(r="GET /a" + _BS + '"b HTTP/1.1'),
+        _combined_line(ua="esc " + _BS + '" quote UA'),
+        _combined_line(r="GET /a" + _BS + '"b HTTP/1.1',
+                       ua="esc " + _BS + '" quote UA'),
+        # The escaped quote forming a '" ' separator occurrence INSIDE
+        # %r: ambiguous vs host backtracking — the no-skip guard must
+        # route it to the oracle, never claim it.
+        _combined_line(r="GET /a" + _BS + '" HTTP/1.1'),
+        # Escaped quotes on lines that also carry 19/20-digit %b values
+        # (interaction with the int64 limb frame + big-row byte patch).
+        _combined_line(ua="esc " + _BS + '" quote', b="9" * 19),
+        _combined_line(ua="esc " + _BS + '" quote', b="1" + "0" * 19),
+        _combined_line(ua="esc " + _BS + '" quote', b=str(2 ** 63 - 1)),
+        _combined_line(r="GET /q" + _BS + '"z HTTP/1.1', b="9" * 20),
+        # Clean control row.
+        _combined_line(),
+    ]
+    return lines
+
+
+def test_quote_escape_matrix_device_matches_oracle():
+    assert_device_matches_oracle(
+        "combined", ESC_FIELDS, esc_matrix_lines(), "esc-matrix"
+    )
+
+
+def test_quote_escape_matrix_nginx_combined():
+    """The same escape geometry through the NGINX dialect (same quoted
+    combined shape, different dissector/decode path)."""
+    lines = [
+        _combined_line(ua="esc " + _BS + '" quote UA'),
+        _combined_line(ua="Moz" + _BS * 2),
+        _combined_line(ua="Mozilla" + _BS),
+        _combined_line(ua="a " + _BS + '" b ' + _BS + '" c'),
+        _combined_line(),
+    ]
+    assert_device_matches_oracle(
+        '$remote_addr - $remote_user [$time_local] "$request" '
+        '$status $body_bytes_sent "$http_referer" "$http_user_agent"',
+        ["IP:connection.client.host", "STRING:request.status.last",
+         "BYTES:response.body.bytes",
+         "HTTP.USERAGENT:request.user-agent"],
+        lines, "esc-nginx",
+    )
+
+
+def test_escaped_quote_class_zero_oracle_and_counted():
+    """The realistic class (escaped quote in the FINAL quoted field) must
+    not touch the oracle at all: zero routed rows, every forced line
+    device-decoded and counted (the serving-tier isolation property —
+    a hostile tenant forcing escaped quotes costs device time only)."""
+    parser = TpuBatchParser("combined", ESC_FIELDS)
+    esc = [
+        _combined_line(ua="esc " + _BS + '" quote UA'),
+        _combined_line(ua="a " + _BS + '" b ' + _BS + '" c'),
+        _combined_line(ua="Moz" + _BS * 2),   # even run: no skip needed
+        _combined_line(),
+    ]
+    result = parser.parse_batch(esc)
+    assert result.oracle_rows == 0
+    assert all(result.valid)
+    # Only the odd-parity (actually skipped) lines count as decoded.
+    assert result.escaped_quote_rows == 2
+    # And the delivered bytes are the VERBATIM spans.
+    ua = result.to_pylist("HTTP.USERAGENT:request.user-agent")
+    assert ua[0] == 'esc \\" quote UA'
+    assert ua[1] == 'a \\" b \\" c'
+    assert ua[2] == "Moz\\\\"
+    parser.close()
+
+
+def test_unescape_compact_matches_reference_decoder():
+    """postproc.unescape_compact_spans is the executable spec of the
+    escape geometry: rows it flags EXACT must reproduce
+    decode_apache_httpd_log_value byte-for-byte; byte-substituting
+    C-escapes and a bare trailing backslash must be flagged inexact
+    (the reference rewrites or raises there — not a compaction)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from logparser_tpu.dissectors.utils import decode_apache_httpd_log_value
+    from logparser_tpu.tpu.postproc import unescape_compact_spans
+
+    cases = [
+        (b'esc \\" quote', True),
+        (b"a\\\\b", True),
+        (b'a\\\\\\"b', True),          # \\\" -> \"
+        (b'run\\\\\\\\\\"x', True),    # 5 backslashes + quote
+        (b'\\" \\" \\"', True),
+        (b"plain", True),
+        (b"tail\\\\", True),           # even run at span end
+        (b"a\\qb", True),              # unknown escape: verbatim
+        (b"odd\\", False),             # bare trailing backslash
+        (b"a\\nb", False),             # substituting C-escape
+        (b"\\x41z", False),            # \xhh
+    ]
+    W = 32
+    L = max(len(c) for c, _ in cases) + 2
+    buf = np.zeros((len(cases), L), dtype=np.uint8)
+    for i, (c, _) in enumerate(cases):
+        buf[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+    out, out_len, exact = unescape_compact_spans(
+        jnp.asarray(buf),
+        jnp.zeros(len(cases), dtype=jnp.int32),
+        jnp.asarray([len(c) for c, _ in cases], dtype=jnp.int32),
+        W,
+    )
+    out = np.asarray(out)
+    out_len = np.asarray(out_len)
+    exact = np.asarray(exact)
+    for i, (c, want_exact) in enumerate(cases):
+        assert bool(exact[i]) == want_exact, (c, bool(exact[i]))
+        if want_exact:
+            got = bytes(out[i, : out_len[i]].astype(np.uint8))
+            ref = decode_apache_httpd_log_value(c.decode("latin-1"))
+            assert got == ref.encode("latin-1"), (c, got, ref)

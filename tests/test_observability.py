@@ -47,15 +47,16 @@ def test_parse_batch_traces_pipeline_stages():
             ["IP:connection.client.host", "BYTES:response.body.bytes"],
         )
         lines = generate_combined_lines(32, seed=23, garbage_fraction=0.1)
-        # A PLAUSIBLE-but-device-rejected line (backslash-escaped quote
-        # in the user-agent: host regex accepts, device split does not),
-        # so it must visit the oracle.  (Pure garbage no longer does —
-        # the implausible-for-all-formats filter counts it bad without a
-        # per-line re-parse; 20-digit %b counts stay on device since the
-        # round-9 full-int64 decoder.)
+        # A PLAUSIBLE-but-device-rejected line (referer ending in a
+        # backslash: the `\" "` bytes form an ambiguous non-final
+        # separator occurrence the device defers on; the host regex
+        # accepts), so it must visit the oracle.  (Pure garbage no
+        # longer does — the implausible-for-all-formats filter counts it
+        # bad without a per-line re-parse; 20-digit %b stays on device
+        # since round 9, escaped-quote USER-AGENTS since round 18.)
         lines[3] = (
             '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] '
-            '"GET /x HTTP/1.1" 200 17 "-" "esc \\" quote"'
+            '"GET /x HTTP/1.1" 200 17 "r\\" "esc quote"'
         )
         parser.parse_batch(lines)
     finally:

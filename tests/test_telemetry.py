@@ -27,14 +27,16 @@ from logparser_tpu.observability import (
 from logparser_tpu.tools.metrics_smoke import validate_exposition
 
 FIELDS = ["IP:connection.client.host", "BYTES:response.body.bytes"]
-# Plausible-but-device-rejected: a backslash-escaped quote in the
-# user-agent — the host regex accepts it, the optimistic device split
-# does not, so the line routes to the oracle, which rescues it.  (A
-# 20-digit %b no longer qualifies: the round-9 full-int64 decoder keeps
-# that class on device.)
+# Plausible-but-device-rejected: a referer ending in a backslash (raw
+# bytes `\" "` — the escaped quote forms a separator occurrence of the
+# NON-final referer field, ambiguous against the host regex's
+# backtracking, so the device defers by design and the oracle rescues).
+# (An escaped quote in the USER-AGENT no longer qualifies: the round-18
+# escape-parity mask keeps that final-field class on device, like the
+# round-9 full-int64 decoder did for 20-digit %b.)
 RESCUE_LINE = (
     '5.6.7.8 - - [31/Dec/2012:23:49:41 +0100] '
-    '"GET /big HTTP/1.1" 200 777 "-" "esc \\" quote t/1.0"'
+    '"GET /big HTTP/1.1" 200 777 "r\\" "t/1.0"'
 )
 GOOD_LINE = (
     '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] '

@@ -132,6 +132,44 @@ class TestEdge:
         else:
             assert ua == expected.get("HTTP.USERAGENT:request.user-agent")
 
+    def test_escaped_quote_in_ua_stays_on_device(self):
+        """Round 18: a backslash-escaped quote in the FINAL quoted field
+        is decoded by the escape-parity mask — zero oracle rows, the
+        VERBATIM span delivered (the host decode never fires per the
+        replicated upstream bug), and the decode counted."""
+        lines = [
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET / HTTP/1.1" '
+            '200 5 "-" "esc \\" quote agent/1.0"',
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET / HTTP/1.1" '
+            '200 5 "-" "clean/1.0"',
+        ]
+        batch = shared_parser("combined", FIELDS)
+        result = batch.parse_batch(lines)
+        assert result.oracle_rows == 0
+        assert list(result.valid) == [True, True]
+        assert result.escaped_quote_rows == 1
+        ua = result.to_pylist("HTTP.USERAGENT:request.user-agent")
+        assert ua == ['esc \\" quote agent/1.0', "clean/1.0"]
+
+    def test_escaped_quote_nonfinal_field_defers_to_oracle(self):
+        """A skipped escaped-separator occurrence in a NON-final quoted
+        field (referer ending in a backslash: raw `\\" "`) is ambiguous
+        against the host regex's backtracking — the device must NOT
+        claim it; the oracle referees, byte-identically."""
+        line = (
+            '1.2.3.4 - - [31/Dec/2012:23:49:40 +0100] "GET / HTTP/1.1" '
+            '200 5 "r\\" "ua/1.0"'
+        )
+        batch = shared_parser("combined", FIELDS)
+        result = batch.parse_batch([line])
+        assert result.oracle_rows == 1
+        assert result.escaped_quote_rows == 0
+        expected = oracle_parse([line])[0]
+        assert result.valid[0] == (expected is not None)
+        if expected is not None:
+            ua = result.to_pylist("HTTP.USERAGENT:request.user-agent")[0]
+            assert ua == expected.get("HTTP.USERAGENT:request.user-agent")
+
     def test_long_line_device_resident(self):
         # Lines up to 8191 bytes fit the 13-bit span slots: no oracle.
         line = (
@@ -733,10 +771,13 @@ class TestBatchSlice:
     ]
 
     def _corpus(self):
-        import bench  # force_reject_lines: the host-rescued line class
+        import bench  # the bench's forced-line writers
 
         lines = generate_combined_lines(160, seed=13)
-        lines = bench.force_reject_lines(lines, 10)  # ~10% oracle-rescued
+        lines = bench.force_rescued_lines(lines, 10)  # ~10% oracle-rescued
+        # ...and some device-decoded escaped quotes (round 18), so the
+        # slice contract also covers escape-parity-claimed rows.
+        lines = bench.force_escaped_quote_lines(lines, 7)
         lines[5] = "complete garbage"                # definitely-bad row
         return lines
 
