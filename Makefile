@@ -9,7 +9,7 @@ PY ?= python
 SMOKE_TIMEOUT ?= 600
 SMOKE = timeout -k 10 $(SMOKE_TIMEOUT)
 
-.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke pod-smoke device-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke pod-smoke device-smoke agg-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -125,6 +125,18 @@ pod-smoke:
 # this after pod-smoke.
 device-smoke:
 	$(SMOKE) $(PY) -m logparser_tpu.tools.device_chaos_smoke
+
+# Analytics smoke: the on-device aggregation pushdown's exactness
+# contract (docs/ANALYTICS.md) — a LIVE service session configured with
+# an aggregate spec must return a state EQUAL to the host-oracle
+# referee (garbage + forced long-overflow fold rows included) while
+# recording positive analytics_d2h_bytes_saved_total; an aggregate job
+# SIGKILLed mid-run and resumed must merge byte-identical sidecars AND
+# AggregateState wire bytes vs a single-shot run; zero leaked
+# threads/tmp/shm and a valid exposition.  CI runs this after
+# device-smoke.
+agg-smoke:
+	$(SMOKE) $(PY) -m logparser_tpu.tools.agg_smoke
 
 lint:
 	$(PY) -m ruff check logparser_tpu tests

@@ -207,6 +207,24 @@ DEVICE_FAULT_COMPILE_BATCHES = 4
 DEVICE_FAULT_PASSES = 2
 DEVICE_WEDGE_DEADLINE_S = 0.3
 DEVICE_WEDGE_SECONDS = 1.2
+# Analytics-pushdown drill (round 19, docs/ANALYTICS.md): the SAME
+# headline corpus through aggregate mode (parser.aggregate_batch —
+# partial-aggregate arrays are the only D2H) vs the row-delivery path
+# (parse_batch + copy-mode Arrow, the per-request serving cost).
+# Gates: device aggregates must equal the host-oracle referee
+# BIT-FOR-BIT on the headline corpus AND every bench config (always
+# hard — exactness is the contract, docs/ANALYTICS.md "Exactness");
+# the aggregate fetch must ship >= ANALYTICS_D2H_RATIO_FLOOR x fewer
+# bytes per batch than the packed row payload (shape math on THIS
+# parser, container-valid, hard); and aggregate throughput must reach
+# ANALYTICS_SPEEDUP_FLOOR x the row-delivery rate — recorded-floor
+# lane, armed only on a multi-core host: the row path leans on the
+# multi-worker assembly pool while the aggregate path skips assembly
+# entirely, and a 1-core container serializes both sides into a
+# scheduler measurement.
+ANALYTICS_SPEEDUP_FLOOR = 1.5
+ANALYTICS_D2H_RATIO_FLOOR = 10.0
+ANALYTICS_AB_PASSES = 5
 
 GEO_TEST_DATA = "/root/reference/GeoIP2-TestData/test-data"
 if not os.path.isdir(GEO_TEST_DATA):
@@ -1122,6 +1140,152 @@ def bench_device_faults(lines):
         "compile_drill": comp_drill,
         "wall_undisturbed_s": round(und_wall, 4),
         "wall_faulted_s": round(flt_wall, 4),
+    }
+
+
+def representative_spec(parser):
+    """A spec derived generically from whatever the parser requests —
+    count + count_by/top_k on the first string-group field + sum on the
+    first numeric field + hourly time_bucket on the first epoch field —
+    so the parity sweep exercises every device-reduction op class on
+    every config's OWN schema instead of hard-coding field names."""
+    from logparser_tpu.analytics.spec import parse_aggregate_config
+
+    ops = [{"op": "count"}]
+    str_f = num_f = ts_f = None
+    for fid in parser.requested:
+        plan = parser.plan_by_id.get(fid)
+        if plan is None:
+            continue
+        group = parser._plan_group(plan)
+        if str_f is None and group in ("span", "obj", "host"):
+            str_f = fid
+        if (num_f is None and group == "numeric"
+                and not fid.startswith("TIME.")):
+            num_f = fid
+        if ts_f is None and fid.startswith("TIME.EPOCH:"):
+            ts_f = fid
+    if str_f is not None:
+        ops.append({"op": "count_by", "field": str_f})
+        ops.append({"op": "top_k", "field": str_f, "k": 5})
+    if num_f is not None:
+        ops.append({"op": "sum", "field": num_f})
+    if ts_f is not None:
+        ops.append({"op": "time_bucket", "field": ts_f, "width_s": 3600})
+    return parse_aggregate_config(ops)
+
+
+def dashboard_spec(parser):
+    """The A/B leg's query: the canonical access-log dashboard rollup
+    over the headline schema — status mix, top endpoints, bytes served
+    (+ size histogram), traffic per hour.  This is the DESIGN POINT of
+    the pushdown (low-cardinality rollups whose partials are a few KB);
+    the parity sweep keeps representative_spec, whose first-string-field
+    choice lands on the unique-per-line client IP — the distinct-key
+    stress case — so exactness is proven where it is hardest while
+    throughput/D2H are measured on the workload the tier exists for."""
+    from logparser_tpu.analytics.spec import parse_aggregate_config
+
+    want = ("STRING:request.status.last", "HTTP.URI:request.firstline.uri",
+            "BYTES:response.body.bytes",
+            "TIME.EPOCH:request.receive.time.epoch")
+    if not set(want) <= set(parser.requested):
+        return representative_spec(parser)
+    status, uri, nbytes, ts = want
+    return parse_aggregate_config([
+        {"op": "count"},
+        {"op": "count_by", "field": status},
+        {"op": "top_k", "field": uri, "k": 5},
+        {"op": "sum", "field": nbytes},
+        {"op": "histogram", "field": nbytes,
+         "edges": [1000, 100000, 10000000]},
+        {"op": "time_bucket", "field": ts, "width_s": 3600},
+    ])
+
+
+def bench_analytics(parser, lines, config_states):
+    """The analytics-pushdown drill (round 19, docs/ANALYTICS.md).
+
+    Two legs, both clean-phase host wall-clock:
+
+    - **A/B throughput**: the headline corpus through aggregate mode
+      (``aggregate_batch`` — device reduction, partials-only D2H, host
+      fold of the rescued tail) vs the row-delivery path (``parse_batch``
+      + copy-mode Arrow, the per-request serving cost).  Interleaved
+      passes, best-of per side (the ring-A/B pattern).
+    - **parity sweep**: on EVERY config built by the configs phase
+      (state reuse — parser + lines), a generically-derived spec runs
+      through the device reduction AND the host-oracle referee
+      (AggregateState.update_from_result over the delivered rows); the
+      two must compare equal bit-for-bit.  combined_rescue rides along,
+      so the sweep covers forced oracle-rescued rows by construction.
+
+    D2H shrinkage is shape math on THIS parser: the packed row payload
+    (packed rows + device view rows, padded batch) vs the bytes the
+    aggregate fetch actually shipped (AggregateOutcome.d2h_bytes).
+    """
+    from logparser_tpu.analytics.state import AggregateState
+    from logparser_tpu.tpu.pipeline import packed_row_count
+
+    batch = list(lines[:CONFIG_BATCH])
+    spec = dashboard_spec(parser)
+    # Warm both paths outside the timed windows (jit buckets, the
+    # compiled reduction, the assembly pool) and take the referee
+    # comparison on the warming parse.
+    warm = parser.parse_batch(batch)
+    warm.to_arrow(strings="copy")
+    out0 = parser.aggregate_batch(batch, spec)
+    referee = AggregateState(spec)
+    referee.update_from_result(warm)
+    exact = out0.state == referee
+    del warm
+    row_walls, agg_walls = [], []
+    for _ in range(ANALYTICS_AB_PASSES):
+        t0 = time.perf_counter()
+        r = parser.parse_batch(batch)
+        r.to_arrow(strings="copy")
+        row_walls.append(time.perf_counter() - t0)
+        del r
+        t0 = time.perf_counter()
+        parser.aggregate_batch(batch, spec)
+        agg_walls.append(time.perf_counter() - t0)
+    row_lps = len(batch) / min(row_walls)
+    agg_lps = len(batch) / min(agg_walls)
+    padded = parser._bucket(len(batch))
+    row_d2h = (packed_row_count(parser.units)
+               + 4 * parser._view_field_count(None)) * padded * 4
+    parity = {}
+    for cname, state in config_states.items():
+        cparser, clines = state[:2]
+        try:
+            cspec = representative_spec(cparser)
+            outcome = cparser.aggregate_batch(clines, cspec)
+            ref = AggregateState(cspec)
+            ref.update_from_result(cparser.parse_batch(clines))
+            parity[cname] = {
+                "equal": bool(outcome.state == ref),
+                "ops": len(cspec.ops),
+                "device_fraction": round(
+                    outcome.device_rows / max(1, len(clines)), 4),
+            }
+        except Exception as e:  # noqa: BLE001 — one config must not hide the rest
+            parity[cname] = {"error": f"{type(e).__name__}: {e}"}
+    return {
+        "spec": [op.as_dict() for op in spec.ops],
+        "batch_lines": len(batch),
+        "aggregate_lines_per_sec": round(agg_lps, 1),
+        "row_delivery_lines_per_sec": round(row_lps, 1),
+        "speedup_vs_arrow": round(agg_lps / row_lps, 3) if row_lps else 0.0,
+        "speedup_gateable": multicore_host(),
+        "d2h_bytes_row_path": int(row_d2h),
+        "d2h_bytes_aggregate": int(out0.d2h_bytes),
+        "d2h_bytes_ratio": (
+            round(row_d2h / out0.d2h_bytes, 1) if out0.d2h_bytes else 0.0
+        ),
+        "device_fraction": round(
+            out0.device_rows / max(1, len(batch)), 4),
+        "exact_vs_referee": bool(exact),
+        "parity": parity,
     }
 
 
@@ -2284,6 +2448,16 @@ def main():
             c["arrow_spread_pct"] = round(retry_spread, 1)
             c["arrow_gate_remeasured"] = True
 
+    # ---- analytics: the aggregation-pushdown drill (round 19) -----------
+    # LAST clean-phase section (wall-clock A/B ratios, same reasoning as
+    # service/jobs) and deliberately after the configs phase: the parity
+    # sweep reuses every config's built parser + corpus from
+    # config_states instead of re-deriving them.
+    try:
+        analytics_section = bench_analytics(parser, lines, config_states)
+    except Exception as e:  # noqa: BLE001 — the drill must not kill the run
+        analytics_section = {"error": f"{type(e).__name__}: {e}"}
+
     # ---- profiler phase: kernel ground truth (headline + per config) ----
     headline_kern = kernel_rate(parser, lines)
     # The same kernel WITH device view-row emission (the parse_batch
@@ -2773,6 +2947,52 @@ def main():
                 "— the rescue machinery is no longer being exercised"
             )
 
+    # (g) Analytics gate (round 19, docs/ANALYTICS.md): device
+    #     aggregates must equal the host-oracle referee bit-for-bit on
+    #     the headline corpus AND every bench config (exactness is the
+    #     contract — always hard; a parity-sweep error counts as a
+    #     mismatch, not a pass), the aggregate path must ship >=
+    #     ANALYTICS_D2H_RATIO_FLOOR x fewer D2H bytes per batch than
+    #     the packed row payload (shape math, container-valid, hard),
+    #     and aggregate throughput must reach ANALYTICS_SPEEDUP_FLOOR x
+    #     row delivery — recorded-floor lane, armed only on a
+    #     multi-core host (see the constant's rationale).
+    if "error" in analytics_section:
+        gate_failures.append(f"analytics: {analytics_section['error']}")
+    else:
+        if not analytics_section.get("exact_vs_referee"):
+            gate_failures.append(
+                "analytics: headline device aggregate != host-oracle "
+                "referee (exactness is the contract)"
+            )
+        for cname, p in (analytics_section.get("parity") or {}).items():
+            if not isinstance(p, dict) or "error" in p:
+                detail = p.get("error") if isinstance(p, dict) else p
+                gate_failures.append(
+                    f"analytics: parity sweep errored on {cname}: "
+                    f"{detail}"
+                )
+            elif not p.get("equal"):
+                gate_failures.append(
+                    f"analytics: device aggregate != referee on {cname}"
+                )
+        ratio = analytics_section.get("d2h_bytes_ratio", 0.0)
+        if ratio < ANALYTICS_D2H_RATIO_FLOOR:
+            gate_failures.append(
+                f"analytics: aggregate D2H only {ratio:.1f}x smaller "
+                f"than the packed row payload (below "
+                f"{ANALYTICS_D2H_RATIO_FLOOR:.0f}x)"
+            )
+        speedup = analytics_section.get("speedup_vs_arrow", 0.0)
+        if (
+            analytics_section.get("speedup_gateable")
+            and speedup < ANALYTICS_SPEEDUP_FLOOR
+        ):
+            floor_gates.append(
+                f"analytics: aggregate throughput {speedup:.2f}x row "
+                f"delivery (below the {ANALYTICS_SPEEDUP_FLOOR}x floor)"
+            )
+
     # Recorded-floor resolution (see floor_gates above): hard gates only
     # on the hardware that recorded the baselines; informational
     # cross-hardware deltas otherwise.
@@ -2881,6 +3101,10 @@ def main():
         # must recover byte-identically with zero aborts and gated
         # throughput retention (docs/FAULTS.md).
         "device_faults": device_faults_section,
+        # The analytics-pushdown drill: aggregate-mode throughput vs row
+        # delivery, D2H shrinkage, and the device-vs-referee parity
+        # sweep over every config (docs/ANALYTICS.md).
+        "analytics": analytics_section,
         # This round's hardware + the recorded-floor baseline's: floor
         # comparisons hard-gate only on matching hardware; otherwise
         # they land in cross_hardware_deltas (informational, per the
@@ -3062,6 +3286,22 @@ def main():
                 "demote_ok": bool(
                     device_faults_section.get("compile_drill", {}).get(
                         "demoted")
+                ),
+            }
+        ),
+        # Analytics drill (round 19): the compact proof aggregation
+        # stays on device — speedup vs arrow delivery, D2H shrinkage,
+        # and the every-config exactness verdict (docs/ANALYTICS.md).
+        "analytics": (
+            {"error": True} if "error" in analytics_section else {
+                "speedup": analytics_section["speedup_vs_arrow"],
+                "d2h_ratio": analytics_section["d2h_bytes_ratio"],
+                "exact": bool(
+                    analytics_section["exact_vs_referee"]
+                    and all(
+                        isinstance(p, dict) and p.get("equal")
+                        for p in analytics_section["parity"].values()
+                    )
                 ),
             }
         ),

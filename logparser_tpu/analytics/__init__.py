@@ -1,0 +1,16 @@
+"""On-device analytics pushdown (docs/ANALYTICS.md).
+
+Aggregate queries — count / count_by / top_k / sum / histogram /
+time_bucket over requested fields — compile into a device reduction
+fused after the parse (``analytics.device``), producing per-batch
+partial aggregates a few KB wide instead of megabytes of packed
+columns.  The host referee (``analytics.state``) grows the SAME
+aggregations over parsed rows; device partials must merge to
+bit-identical results, with any row the device cannot finish exactly
+(escaped quotes, Long overflow, oracle-needing winners, ...) folded
+back through the row parser.
+"""
+from .spec import AggregateSpec, AggOp
+from .state import AggregateState
+
+__all__ = ["AggregateSpec", "AggOp", "AggregateState"]

@@ -1,0 +1,698 @@
+"""The fused device aggregation stage (docs/ANALYTICS.md).
+
+``build_aggregate_fn`` compiles one spec against one parser's format
+units into a jitted reduction ``(buf, lengths, n_rows, host_kill) ->
+partials`` that runs the SAME ``compute_units_rows`` parse pass the row
+executor runs (XLA prunes the packed rows the reduction never reads),
+mirrors the winner/contested merge of ``compute_view_rows`` /
+``_fetch_packed``, and reduces the surviving rows on device:
+
+- ``count``            one scalar (rows counted on device)
+- ``sum``              base-10^6 limb tiles, 16-bit split so int32 never
+                       overflows; the host recombines with Python ints
+- ``histogram``        static per-edge limb compares -> bin counts
+- ``count_by/top_k``   sort by (len, first-12-byte words, row), full
+                       content compare across the prefix tie, boundary
+                       scatter -> (count, representative row/span) per
+                       distinct value; the host reads the key bytes from
+                       its own copy of the batch buffer (no extra D2H)
+- ``time_bucket``      epoch-second bucket sort-group -> (bucket, count)
+
+Exactness contract: every row the device cannot finish EXACTLY — rows a
+host oracle visit could reshape (winner needs oracle fields, CSR
+overflow, escaped-quote claims, truncated lines), span values needing
+host repair (amp/fix), Long values beyond int64, timestamps outside the
+int32-second range — is FOLDED: flagged in the per-row class plane and
+re-parsed through the ordinary row path host-side.  The device partial
+plus the folded rows' referee partial equals the full referee partial
+bit-for-bit; anything else is a bug the differential suite must catch.
+
+All device arithmetic is int32 (x64 stays disabled); decimal limbs keep
+every intermediate far below 2^31 (see the per-op comments).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tpu.pipeline import (
+    CSR_OVERFLOW_BIT,
+    ESC_QUOTE_BIT,
+    _SPAN_BITS,
+    compute_units_rows,
+    ts_group_key,
+)
+from .spec import AggregateSpec
+from .state import AggregateState, _canon_key
+
+_SPAN_MASK = (1 << _SPAN_BITS) - 1
+_DEAD_KEY = 1 << 30          # sorts after every live (len <= 8191) key
+_INT32_MAX = (1 << 31) - 1
+SUM_TILE = 4096              # 4096 * 0xFFFF < 2^31: the 16-bit-split bound
+
+# Device-bucketable civil-year window: epoch SECONDS for years
+# 1902..2037 stay within int32 (1901-12-13..2038-01-19 are the exact
+# bounds; whole years keep the guard trivially safe on both sides).
+_TS_YEAR_MIN, _TS_YEAR_MAX = 1902, 2037
+
+
+def _limbs_of(value: int) -> Tuple[int, int, int]:
+    """(A, B, C) base-10^6 limbs of a non-negative int < 10^19."""
+    return value // 10**12, (value // 10**6) % 10**6, value % 10**6
+
+
+# ---------------------------------------------------------------------------
+# static planning
+# ---------------------------------------------------------------------------
+
+
+class _OpPlan:
+    """Static device plan for one op: per-unit slot descriptors, or None
+    where rows won by that unit must fold to the host referee."""
+
+    def __init__(self, op, units_desc: List[Optional[dict]]):
+        self.op = op
+        self.units_desc = units_desc
+
+
+def plan_aggregate(parser, spec: AggregateSpec) -> List[_OpPlan]:
+    """Resolve the spec against the parser's units.  A unit contributes
+    device-side only when its plan for the field decodes to the exact
+    delivered value with no host involvement; everything else folds —
+    statically per (op, unit), so an all-covered config pays nothing."""
+    plans: List[_OpPlan] = []
+    for op in spec.ops:
+        descs: List[Optional[dict]] = []
+        for ui, u in enumerate(parser.units):
+            if u.plausibility_only or parser._unit_oracle_fields[ui]:
+                # Probe units never win; units with oracle fields have
+                # every won row statically folded (the oracle visit can
+                # reshape row validity) — the descriptor is moot.
+                descs.append(None)
+                continue
+            if op.op == "count":
+                descs.append({})
+                continue
+            plan = u.plan_for(op.field)
+            if op.op in ("count_by", "top_k"):
+                descs.append({"plan": plan} if plan.kind == "span" else None)
+            elif op.op in ("sum", "histogram"):
+                descs.append(
+                    {"plan": plan}
+                    if plan.kind == "long" and plan.scale == 1 else None
+                )
+            else:  # time_bucket
+                descs.append(
+                    {"plan": plan}
+                    if plan.kind == "ts" and plan.comp == "epoch" else None
+                )
+        plans.append(_OpPlan(op, descs))
+    return plans
+
+
+# ---------------------------------------------------------------------------
+# jnp building blocks
+# ---------------------------------------------------------------------------
+
+
+def _slot(rows: Sequence[jnp.ndarray], unit, fid: str, comp: str):
+    """Read one packed slot component from the flat row list (the jnp
+    twin of PackedLayout.get over the stacked output)."""
+    r, shift, bits = unit.layout.slots[fid][comp]
+    col = rows[unit.row_offset + r]
+    if bits == 0:
+        return col
+    return (col >> shift) & ((1 << bits) - 1)
+
+
+def _prev(a: jnp.ndarray) -> jnp.ndarray:
+    """a[i-1] with a[0] carried (index 0 is handled by the callers'
+    explicit first-row boundary)."""
+    return jnp.concatenate([a[:1], a[:-1]])
+
+
+def _scatter_groups(boundary, live, perm_vals, B):
+    """Shared boundary-scatter: per-group segment ids + counts."""
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    n_groups = jnp.sum(boundary.astype(jnp.int32))
+    counts = jnp.zeros(B, dtype=jnp.int32).at[
+        jnp.where(live, seg, B)
+    ].add(1, mode="drop")
+    reps = [
+        jnp.zeros(B, dtype=jnp.int32).at[
+            jnp.where(boundary, seg, B)
+        ].set(v.astype(jnp.int32), mode="drop")
+        for v in perm_vals
+    ]
+    return n_groups, counts, reps
+
+
+def _group_spans(buf, sel, s, ln, B, L):
+    """Distinct-value grouping of span rows: (n_groups, [B, 4] int32
+    (count, rep_row, rep_start, rep_len)).  Sort order is any total
+    order — only adjacency-of-equals matters — so the 12-byte prefix
+    words sort signed; ties beyond the prefix resolve in the bounded
+    content-compare loop below (groups can only SPLIT on a prefix
+    collision, never merge, and the host dict re-merges by full key)."""
+    iota = jnp.arange(B, dtype=jnp.int32)
+    k0 = jnp.where(sel, ln, _DEAD_KEY).astype(jnp.int32)
+    pos = jnp.arange(12, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(s[:, None] + pos, 0, L - 1)
+    first12 = jnp.take_along_axis(buf, idx, axis=1).astype(jnp.int32)
+    masked = jnp.where(sel[:, None] & (pos < ln[:, None]), first12, 0)
+    words = [
+        (
+            masked[:, 4 * w]
+            | (masked[:, 4 * w + 1] << 8)
+            | (masked[:, 4 * w + 2] << 16)
+            | (masked[:, 4 * w + 3] << 24)
+        ).astype(jnp.int32)
+        for w in range(3)
+    ]
+    k0s, w0s, w1s, w2s, perm = jax.lax.sort(
+        (k0, words[0], words[1], words[2], iota), dimension=0, num_keys=5
+    )
+    s_s, l_s = s[perm], ln[perm]
+    live = k0s != _DEAD_KEY
+    eq12 = (
+        (k0s == _prev(k0s)) & (w0s == _prev(w0s))
+        & (w1s == _prev(w1s)) & (w2s == _prev(w2s))
+        & live & (iota > 0)
+    )
+    # Content compare past byte 12 for prefix-tied neighbors: byte-at-a-
+    # time while_loop, bounded by the longest tied span and early-exited
+    # when every pair is decided (typical fields decide in a handful of
+    # iterations; the loop is [B]-wide per step).
+    need = eq12 & (l_s > 12)
+    prev_row, prev_s = _prev(perm), _prev(s_s)
+    maxl = jnp.max(jnp.where(need, l_s, 0))
+
+    def cond(st):
+        j, undec, _ = st
+        return (j < maxl) & jnp.any(undec)
+
+    def body(st):
+        j, undec, eq = st
+        b1 = buf[perm, jnp.clip(s_s + j, 0, L - 1)]
+        b2 = buf[prev_row, jnp.clip(prev_s + j, 0, L - 1)]
+        within = j < l_s
+        mism = undec & within & (b1 != b2)
+        return j + 1, undec & within & ~mism, eq & ~mism
+
+    _, _, eq_full = jax.lax.while_loop(
+        cond, body, (jnp.int32(12), need, eq12)
+    )
+    boundary = live & ~eq_full
+    n_groups, counts, reps = _scatter_groups(
+        boundary, live, (perm, s_s, l_s), B
+    )
+    return n_groups, jnp.stack([counts] + reps, axis=1)
+
+
+def _group_ints(values, sel, B):
+    """Distinct-int grouping: (n_groups, [B, 2] int32 (bucket, count)).
+    Dead rows key to INT32_MAX, which no live epoch-second bucket can
+    reach (seconds cap below 2^31 - 1 by the year guard)."""
+    keys = jnp.where(sel, values, _INT32_MAX).astype(jnp.int32)
+    ks = jax.lax.sort(keys, dimension=0)
+    live = ks != _INT32_MAX
+    boundary = live & (
+        (jnp.arange(B, dtype=jnp.int32) == 0) | (ks != _prev(ks))
+    )
+    n_groups, counts, reps = _scatter_groups(boundary, live, (ks,), B)
+    return n_groups, jnp.stack([reps[0], counts], axis=1)
+
+
+def _frame_value_limbs(hi, lo, d18, ndig, is_null, dead):
+    """Right-aligned (A, B, C) base-10^6 limbs of the long frame.
+
+    parse_long_spans ships a LEFT-aligned 19-digit frame (hi = digits
+    0..8, lo = digits 9..17, d18 = digit 19); value = frame//10^(19-n).
+    Extract the 19 digits, shift right by (19 - ndig) via the binary
+    decomposition of the shift (5 static stages of selects), recombine.
+    ``dead`` rows (null/big/not-ok) force zero digits so the garbage in
+    their rows (big rows carry a SPAN in hi) never reaches arithmetic."""
+    hi = jnp.where(dead | is_null, 0, hi)
+    lo = jnp.where(dead | is_null, 0, lo)
+    d18 = jnp.where(dead | is_null, 0, d18)
+    digits = [(hi // 10 ** (8 - i)) % 10 for i in range(9)]
+    digits += [(lo // 10 ** (17 - i)) % 10 for i in range(9, 18)]
+    digits.append(d18)
+    shift = jnp.clip(19 - ndig, 0, 19)
+    for bit in (16, 8, 4, 2, 1):
+        on = (shift & bit) != 0
+        digits = [
+            jnp.where(on, digits[j - bit], digits[j]) if j >= bit
+            else jnp.where(on, 0, digits[j])
+            for j in range(19)
+        ]
+    a = jnp.zeros_like(hi)
+    for j in range(0, 7):
+        a = a + digits[j] * 10 ** (6 - j)
+    b = jnp.zeros_like(hi)
+    for j in range(7, 13):
+        b = b + digits[j] * 10 ** (12 - j)
+    c = jnp.zeros_like(hi)
+    for j in range(13, 19):
+        c = c + digits[j] * 10 ** (18 - j)
+    return a, b, c
+
+
+def _limb_ge(a, b, c, ea: int, eb: int, ec: int):
+    """(A,B,C) >= decomposed edge, all int32 lanes."""
+    return (
+        (a > ea)
+        | ((a == ea) & ((b > eb) | ((b == eb) & (c >= ec))))
+    )
+
+
+def _sum_tiles(sel, limbs, padded_b):
+    """[ntiles, 3, 2] int32 partial sums: per limb, 16-bit lo/hi halves
+    summed over SUM_TILE-row tiles (4096 * 0xFFFF < 2^31, and the hi
+    halves are <= 152 per row).  The host recombines exactly with
+    Python ints — merged sums may exceed int64, which is why the wire
+    value is decimal ASCII."""
+    tile = min(padded_b, SUM_TILE)
+    ntiles = padded_b // tile
+    outs = []
+    for limb in limbs:
+        v = jnp.where(sel, limb, 0).astype(jnp.int32)
+        lo = (v & 0xFFFF).reshape(ntiles, tile).sum(axis=1)
+        hi = (v >> 16).reshape(ntiles, tile).sum(axis=1)
+        outs.append(jnp.stack([lo, hi], axis=1))
+    return jnp.stack(outs, axis=1)  # [ntiles, 3, 2]
+
+
+# ---------------------------------------------------------------------------
+# the compiled reduction
+# ---------------------------------------------------------------------------
+
+
+def build_aggregate_fn(parser, spec: AggregateSpec):
+    """Compile the aggregate reduction for one parser + spec.  Returns
+    ``(fn, op_plans)`` where ``fn(buf, lengths, n_rows, host_kill)`` is
+    jitted (under the parser's mesh shardings when data-parallel) and
+    returns the partials dict; None when the parser has no device
+    executor at all (host-only fields)."""
+    if parser.device_fn() is None:
+        return None, None
+    units = list(parser.units)
+    op_plans = plan_aggregate(parser, spec)
+    covers_all = bool(parser._device_covers_all_formats)
+    n_units = len(units)
+
+    def fn(buf, lengths, n_rows, host_kill):
+        B, L = buf.shape
+        rows = compute_units_rows(units, buf, lengths)
+        row0 = [rows[u.row_offset] for u in units]
+        validity = jnp.stack([(r & 1) for r in row0])
+        plausible = jnp.stack([((r >> 1) & 1) for r in row0])
+        valid_any = jnp.any(validity != 0, axis=0)
+        winner = jnp.argmax(validity, axis=0).astype(jnp.int32)
+        if n_units > 1:
+            earlier = jnp.cumsum(plausible, axis=0) - plausible
+            ep_at_winner = earlier[0]
+            for ui in range(1, n_units):
+                ep_at_winner = jnp.where(
+                    winner == ui, earlier[ui], ep_at_winner
+                )
+            valid_any = valid_any & (ep_at_winner == 0)
+        plaus_any = jnp.any(plausible != 0, axis=0)
+        live = jnp.arange(B, dtype=jnp.int32) < n_rows
+        # Rows the device must not judge at all: truncated lines (the
+        # device saw a prefix) and any line that overflowed a CSR slot
+        # bank (the row path would regrow + re-run; the aggregate path
+        # folds instead).
+        csr_over = jnp.zeros(B, dtype=bool)
+        for r in row0:
+            csr_over = csr_over | ((r & CSR_OVERFLOW_BIT) != 0)
+        force_fold = live & (host_kill | csr_over)
+        base_valid = valid_any & live & ~force_fold
+        # Winner row0 for the escaped-quote bit (select-chain, mirroring
+        # compute_view_rows' TPU-gather avoidance).
+        w_row0 = row0[0]
+        for ui in range(1, n_units):
+            w_row0 = jnp.where(winner == ui, row0[ui], w_row0)
+        fold = base_valid & ((w_row0 & ESC_QUOTE_BIT) != 0)
+
+        # ---- per-op first pass: dynamic folds + row lanes -------------
+        lanes: List[dict] = []
+        zero = jnp.zeros(B, dtype=jnp.int32)
+        false = jnp.zeros(B, dtype=bool)
+        for p in op_plans:
+            lane: dict = {"op": p.op}
+            if p.op.op == "count":
+                lanes.append(lane)
+                continue
+            uncovered = false
+            for ui, u in enumerate(units):
+                if u.plausibility_only:
+                    continue
+                if p.units_desc[ui] is None:
+                    if not parser._unit_oracle_fields[ui]:
+                        uncovered = uncovered | (winner == ui)
+                    # (oracle-field units fold below, once, for all ops)
+            if p.op.op in ("count_by", "top_k"):
+                s, ln = zero, zero
+                ok, nul, ampfix = false, false, false
+                for ui, u in enumerate(units):
+                    d = p.units_desc[ui]
+                    if d is None:
+                        continue
+                    selu = winner == ui
+                    w = rows[
+                        u.row_offset + u.layout.slots[p.op.field]["start"][0]
+                    ]
+                    s = jnp.where(selu, w & _SPAN_MASK, s)
+                    ln = jnp.where(selu, (w >> _SPAN_BITS) & _SPAN_MASK, ln)
+                    ok = jnp.where(
+                        selu, ((w >> (2 * _SPAN_BITS)) & 1) != 0, ok
+                    )
+                    nul = jnp.where(
+                        selu, ((w >> (2 * _SPAN_BITS + 1)) & 1) != 0, nul
+                    )
+                    ampfix = jnp.where(
+                        selu, ((w >> (2 * _SPAN_BITS + 2)) & 3) != 0, ampfix
+                    )
+                fold = fold | (base_valid & (uncovered | ampfix))
+                lane.update(s=s, ln=ln, ok=ok, nul=nul)
+            elif p.op.op in ("sum", "histogram"):
+                hi, lo, d18, ndig = zero, zero, zero, zero
+                ok, nul, big = false, false, false
+                excl_zero = false
+                incl_null = false
+                for ui, u in enumerate(units):
+                    d = p.units_desc[ui]
+                    if d is None:
+                        continue
+                    selu = winner == ui
+                    fid = p.op.field
+                    hi = jnp.where(selu, _slot(rows, u, fid, "hi"), hi)
+                    lo = jnp.where(selu, _slot(rows, u, fid, "lo"), lo)
+                    d18 = jnp.where(selu, _slot(rows, u, fid, "d18"), d18)
+                    ndig = jnp.where(
+                        selu, _slot(rows, u, fid, "lo_digits"), ndig
+                    )
+                    ok = jnp.where(
+                        selu, _slot(rows, u, fid, "ok") != 0, ok
+                    )
+                    nul = jnp.where(
+                        selu, _slot(rows, u, fid, "null") != 0, nul
+                    )
+                    big = jnp.where(
+                        selu, _slot(rows, u, fid, "big") != 0, big
+                    )
+                    mode = d["plan"].null_mode
+                    if mode == "zero_null":
+                        excl_zero = jnp.where(selu, True, excl_zero)
+                    elif mode == "dash_zero":
+                        incl_null = jnp.where(selu, True, incl_null)
+                a, b, c = _frame_value_limbs(
+                    hi, lo, d18, ndig, nul, ~ok | big
+                )
+                # Long-overflow rows fold via the GLOBAL numeric-overflow
+                # pass below (the aggregated field is always requested);
+                # only winner-in-uncovered-unit folds here.
+                fold = fold | (base_valid & uncovered)
+                is_zero = (a == 0) & (b == 0) & (c == 0)
+                sel_extra = jnp.where(
+                    nul, incl_null, ~(excl_zero & is_zero)
+                )
+                lane.update(a=a, b=b, c=c, ok=ok, sel_extra=sel_extra)
+            else:  # time_bucket
+                c1, c2, off = zero, zero, zero
+                ok = false
+                for ui, u in enumerate(units):
+                    d = p.units_desc[ui]
+                    if d is None:
+                        continue
+                    key = ts_group_key(d["plan"])
+                    selu = winner == ui
+                    c1 = jnp.where(selu, _slot(rows, u, key, "c1"), c1)
+                    c2 = jnp.where(selu, _slot(rows, u, key, "c2"), c2)
+                    off = jnp.where(selu, _slot(rows, u, key, "off"), off)
+                    ok = jnp.where(
+                        selu, _slot(rows, u, key, "ok") != 0, ok
+                    )
+                year = c1 & 0x3FFF
+                month = (c1 >> 14) & 0xF
+                day = (c1 >> 18) & 0x1F
+                hour = (c1 >> 23) & 0x1F
+                minute = c2 & 0x3F
+                second = (c2 >> 6) & 0x3F
+                # Epoch seconds stay int32 only inside the year guard;
+                # anything outside folds to the int64 host referee.
+                in_range = (year >= _TS_YEAR_MIN) & (year <= _TS_YEAR_MAX)
+                fold = fold | (
+                    base_valid & (uncovered | (ok & ~in_range))
+                )
+                y = jnp.where(in_range, year, 2000) - (month <= 2)
+                era = jnp.floor_divide(
+                    jnp.where(y >= 0, y, y - 399), 400
+                )
+                yoe = y - era * 400
+                mp = jnp.mod(month + 9, 12)
+                doy = jnp.floor_divide(153 * mp + 2, 5) + day - 1
+                doe = (
+                    yoe * 365 + jnp.floor_divide(yoe, 4)
+                    - jnp.floor_divide(yoe, 100) + doy
+                )
+                days = era * 146097 + doe - 719468
+                secs = (
+                    days * 86400
+                    + hour * 3600 + minute * 60 + second - off
+                )
+                # floor(millis / (w*1000)) == floor(secs / w) for
+                # milli in [0, 1000): the whole-second-width invariant.
+                bucket = jnp.floor_divide(secs, p.op.width_s)
+                lane.update(bucket=bucket, ok=ok)
+            lanes.append(lane)
+
+        # Units whose winner needs ANY oracle field fold once, globally.
+        for ui, u in enumerate(units):
+            if not u.plausibility_only and parser._unit_oracle_fields[ui]:
+                fold = fold | (base_valid & (winner == ui))
+
+        # Global Long-overflow fold: a row whose winner delivers ANY
+        # requested numeric field with big-bit set or a full 19-digit
+        # frame can be byte-patched or DEMOTED by the host materializer
+        # (overflow delivery / non-digit big tails) — row validity itself
+        # is at stake, so every op folds the row.  ndig >= 19 over-folds
+        # the rare exact-19-digit values still within int64; folding is
+        # always exact, only unaccelerated.
+        for ui, u in enumerate(units):
+            if u.plausibility_only or parser._unit_oracle_fields[ui]:
+                continue
+            selu = winner == ui
+            for fid in parser.requested:
+                plan = u.plan_for(fid)
+                if plan.kind not in ("long", "secmillis"):
+                    continue
+                okb = _slot(rows, u, fid, "ok") != 0
+                nulb = _slot(rows, u, fid, "null") != 0
+                bigb = _slot(rows, u, fid, "big") != 0
+                nd = _slot(rows, u, fid, "lo_digits")
+                fold = fold | (
+                    base_valid & selu & okb & ~nulb
+                    & (bigb | (nd >= 19))
+                )
+
+        # ---- the per-row class plane ----------------------------------
+        invalid = live & ~valid_any & ~force_fold
+        if covers_all:
+            reject = invalid & ~plaus_any
+        else:
+            reject = false
+        cls = jnp.where(
+            ~live,
+            jnp.uint8(3),
+            jnp.where(
+                reject,
+                jnp.uint8(2),
+                jnp.where(
+                    force_fold | invalid | (base_valid & fold),
+                    jnp.uint8(1),
+                    jnp.uint8(0),
+                ),
+            ),
+        )
+        counted = cls == jnp.uint8(0)
+        out: Dict[str, jnp.ndarray] = {
+            "cls": cls,
+            "n_device": jnp.sum(counted.astype(jnp.int32)),
+        }
+
+        # ---- per-op reductions over the surviving rows ----------------
+        for i, (p, lane) in enumerate(zip(op_plans, lanes)):
+            if p.op.op == "count":
+                continue  # n_device is the answer
+            if p.op.op in ("count_by", "top_k"):
+                sel = counted & lane["ok"] & ~lane["nul"]
+                n, groups = _group_spans(
+                    buf, sel, lane["s"], lane["ln"], B, L
+                )
+                out[f"op{i}_n"] = n
+                out[f"op{i}_groups"] = groups
+            elif p.op.op == "sum":
+                sel = counted & lane["ok"] & lane["sel_extra"]
+                out[f"op{i}_tiles"] = _sum_tiles(
+                    sel, (lane["a"], lane["b"], lane["c"]), B
+                )
+            elif p.op.op == "histogram":
+                sel = counted & lane["ok"] & lane["sel_extra"]
+                a, b, c = lane["a"], lane["b"], lane["c"]
+                bin_of = jnp.zeros(B, dtype=jnp.int32)
+                for e in p.op.edges:
+                    if e <= 0:
+                        ge = jnp.ones(B, dtype=bool)  # values are >= 0
+                    else:
+                        ea, eb, ec = _limbs_of(int(e))
+                        ge = _limb_ge(a, b, c, ea, eb, ec)
+                    bin_of = bin_of + ge.astype(jnp.int32)
+                out[f"op{i}_bins"] = jnp.stack([
+                    jnp.sum((sel & (bin_of == k)).astype(jnp.int32))
+                    for k in range(len(p.op.edges) + 1)
+                ])
+            else:  # time_bucket
+                sel = counted & lane["ok"]
+                n, groups = _group_ints(lane["bucket"], sel, B)
+                out[f"op{i}_n"] = n
+                out[f"op{i}_groups"] = groups
+        return out
+
+    mesh = parser._mesh
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data = NamedSharding(mesh, P("data"))
+        rep = NamedSharding(mesh, P())
+        jitted = jax.jit(
+            fn,
+            in_shardings=(NamedSharding(mesh, P("data", None)), data,
+                          rep, data),
+            out_shardings=rep,
+        )
+    else:
+        jitted = jax.jit(fn)
+    return jitted, op_plans
+
+
+# ---------------------------------------------------------------------------
+# host side: fetch + accumulate
+# ---------------------------------------------------------------------------
+
+
+def _pow2_at_least(n: int, cap: int) -> int:
+    k = 1
+    while k < n:
+        k <<= 1
+    return min(k, cap)
+
+
+def fetch_partials(out: Dict[str, Any], spec: AggregateSpec, B: int,
+                   padded_b: int) -> Tuple[Dict[str, Any], int]:
+    """Pull the partials D2H: the per-row class plane (1 byte/row), the
+    scalars, and — for grouping ops — a power-of-two PREFIX of the group
+    arrays sized by the group count, so transfer scales with distinct
+    keys, not batch size.  Ops that compile to the SAME reduction
+    (count_by + top_k over one field, repeated sums/buckets) alias a
+    single fetch: XLA already CSEs the device compute, and the alias
+    keeps the D2H single too.  Returns (host partials, bytes fetched)."""
+    fetched: Dict[str, Any] = {}
+    nbytes = 0
+    cls = np.asarray(jax.device_get(out["cls"][:B]))
+    fetched["cls"] = cls
+    nbytes += cls.nbytes
+    fetched["n_device"] = int(jax.device_get(out["n_device"]))
+    nbytes += 4
+    seen: Dict[Tuple, int] = {}
+    for i, op in enumerate(spec.ops):
+        if op.op in ("count_by", "top_k"):
+            shared = ("spans", op.field)
+        elif op.op == "time_bucket":
+            shared = ("ints", op.field, op.width_s)
+        elif op.op == "sum":
+            shared = ("sum", op.field)
+        elif op.op == "histogram":
+            shared = ("hist", op.field, op.edges)
+        else:
+            shared = None
+        if shared is not None:
+            j = seen.get(shared)
+            if j is not None:
+                for suffix in ("_n", "_groups", "_tiles", "_bins"):
+                    if f"op{j}{suffix}" in fetched:
+                        fetched[f"op{i}{suffix}"] = fetched[f"op{j}{suffix}"]
+                continue
+            seen[shared] = i
+        if op.op in ("count_by", "top_k", "time_bucket"):
+            ng = int(jax.device_get(out[f"op{i}_n"]))
+            nbytes += 4
+            fetched[f"op{i}_n"] = ng
+            if ng > 0:
+                k = _pow2_at_least(ng, padded_b)
+                arr = np.asarray(jax.device_get(out[f"op{i}_groups"][:k]))
+                fetched[f"op{i}_groups"] = arr
+                nbytes += arr.nbytes
+            else:
+                fetched[f"op{i}_groups"] = np.zeros(
+                    (0, 2 if op.op == "time_bucket" else 4), dtype=np.int32
+                )
+        elif op.op == "sum":
+            arr = np.asarray(jax.device_get(out[f"op{i}_tiles"]))
+            fetched[f"op{i}_tiles"] = arr
+            nbytes += arr.nbytes
+        elif op.op == "histogram":
+            arr = np.asarray(jax.device_get(out[f"op{i}_bins"]))
+            fetched[f"op{i}_bins"] = arr
+            nbytes += arr.nbytes
+    return fetched, nbytes
+
+
+def accumulate_partials(state: AggregateState, spec: AggregateSpec,
+                        fetched: Dict[str, Any], buf: np.ndarray) -> None:
+    """Fold one batch's device partials into the state.  Key bytes for
+    the grouping ops come from the HOST copy of the batch buffer (the
+    encode output) — representative (row, start, len) triples index it,
+    so no span bytes ever cross D2H."""
+    n_device = fetched["n_device"]
+    for i, op in enumerate(spec.ops):
+        if op.op == "count":
+            state.data[i] += n_device
+        elif op.op in ("count_by", "top_k"):
+            acc = state.data[i]
+            groups = fetched[f"op{i}_groups"]
+            for g in range(fetched[f"op{i}_n"]):
+                cnt, row, s, ln = (int(x) for x in groups[g])
+                raw = bytes(buf[row, s:s + ln])
+                key = _canon_key(raw.decode("utf-8", errors="replace"))
+                acc[key] = acc.get(key, 0) + cnt
+        elif op.op == "sum":
+            tiles = fetched[f"op{i}_tiles"].astype(object)
+            limbs = []
+            for j in range(3):
+                lo = int(tiles[:, j, 0].sum())
+                hi = int(tiles[:, j, 1].sum())
+                limbs.append(lo + (hi << 16))
+            state.data[i] += (
+                limbs[0] * 10**12 + limbs[1] * 10**6 + limbs[2]
+            )
+        elif op.op == "histogram":
+            bins = fetched[f"op{i}_bins"]
+            for b in range(len(bins)):
+                state.data[i][b] += int(bins[b])
+        else:  # time_bucket
+            acc = state.data[i]
+            groups = fetched[f"op{i}_groups"]
+            for g in range(fetched[f"op{i}_n"]):
+                bucket, cnt = int(groups[g, 0]), int(groups[g, 1])
+                acc[bucket] = acc.get(bucket, 0) + cnt
+
+
+__all__ = [
+    "build_aggregate_fn", "plan_aggregate", "fetch_partials",
+    "accumulate_partials", "SUM_TILE",
+]

@@ -34,6 +34,7 @@ from .writer import (  # noqa: F401
     build_reject_table,
     leaked_temp_files,
     merged_hash,
+    merged_job_aggregate,
     reject_schema,
     sweepable_temp_files,
 )

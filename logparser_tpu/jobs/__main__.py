@@ -28,6 +28,7 @@ import sys
 import threading
 
 from .manifest import ManifestError, merge_manifests
+from .writer import merged_job_aggregate
 from .runner import (
     DEFAULT_JOB_BATCH_LINES,
     EXIT_PREEMPTED,
@@ -83,6 +84,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--data-parallel", type=int, default=None,
                     help="lay the device parse over N local chips "
                          "(jax.sharding mesh; default: single device)")
+    ap.add_argument("--aggregate", default=None, metavar="JSON",
+                    help="aggregate mode (docs/ANALYTICS.md): a JSON "
+                         "list of aggregation ops; shards land partial-"
+                         "aggregate sidecars instead of data tables and "
+                         "the completed job prints the merged aggregate "
+                         "summary")
     ap.add_argument("--stop-after-shards", type=int, default=None,
                     help=argparse.SUPPRESS)  # crash-drill hook (smoke)
     return ap
@@ -128,6 +135,7 @@ def _main(args, stop) -> int:
         n_hosts=args.hosts,
         host_index=args.host_index,
         data_parallel=args.data_parallel,
+        aggregate=args.aggregate,
     )
     policy = JobPolicy(io_retries=args.io_retries,
                        stop_after_shards=args.stop_after_shards,
@@ -135,22 +143,38 @@ def _main(args, stop) -> int:
     try:
         if args.merge_only:
             merged = merge_manifests(args.out_dir)
-            print(json.dumps({
+            d = {
                 "out_dir": args.out_dir,
                 "merged_shards": len(merged.shards),
-            }))
+            }
+            if merged.job.get("aggregate"):
+                d["aggregate"] = merged_job_aggregate(
+                    args.out_dir, merged).summary()
+            print(json.dumps(d))
             return 0
         report = run_job(spec, resume=not args.no_resume, policy=policy)
         if args.merge and report.complete:
             merged = merge_manifests(args.out_dir)
             d = report.as_dict()
             d["merged_shards"] = len(merged.shards)
+            if args.aggregate:
+                d["aggregate"] = merged_job_aggregate(
+                    args.out_dir, merged).summary()
             print(json.dumps(d))
             return 0  # complete implies no failed shards
     except (ManifestError, ValueError) as e:
         print(json.dumps({"error": str(e)}), file=sys.stderr)
         return 2
-    print(json.dumps(report.as_dict()))
+    d = report.as_dict()
+    if args.aggregate and args.hosts == 1 and report.complete:
+        # Single-host aggregate job: the merged answer is ready — print
+        # it (a pod host's share is partial; --merge owns that case).
+        try:
+            d["aggregate"] = merged_job_aggregate(args.out_dir).summary()
+        except (OSError, ValueError) as e:
+            print(json.dumps({"error": str(e)}), file=sys.stderr)
+            return 2
+    print(json.dumps(d))
     if report.failed:
         return 1
     return EXIT_PREEMPTED if report.preempted else 0

@@ -114,6 +114,11 @@ class ShardRecord:
     reject_file: Optional[str]  # relative filename; None when rejects == 0
     data_hash: Optional[str]   # blake2b hex of the data file bytes
     reject_hash: Optional[str]
+    # Analytics pushdown (docs/ANALYTICS.md): aggregate-mode shards land
+    # a partial-aggregate sidecar instead of a data table.  Defaulted so
+    # pre-analytics manifests load unchanged (from_dict -> None).
+    agg_file: Optional[str] = None
+    agg_hash: Optional[str] = None
     committed_at: float = 0.0  # wall clock; NOT part of output identity
 
     @classmethod

@@ -100,6 +100,13 @@ class JobSpec:
     # local devices (``TpuBatchParser(data_parallel=...)``); None = the
     # parser default (single device).
     data_parallel: Optional[int] = None
+    # Analytics pushdown (docs/ANALYTICS.md): an aggregation spec (op
+    # list / JSON string / AggregateSpec) switches the job to aggregate
+    # mode — each shard lands a partial-aggregate sidecar instead of a
+    # data table (rejects still land).  FINGERPRINTED: the spec
+    # determines the output bytes, so resuming a row job as an
+    # aggregate job (or across two specs) is refused.
+    aggregate: Optional[Any] = None
 
     def fingerprint(self, sources_norm) -> Dict[str, Any]:
         """The manifest's job block: resume refuses when any of this
@@ -127,12 +134,19 @@ class JobSpec:
                     "size": s.size,
                     "hash": hashlib.blake2b(s.blob).hexdigest()[:32],
                 })
+        from ..analytics.spec import parse_aggregate_config, spec_tuple
+
         return {
             "log_format": self.log_format,
             "fields": list(self.fields),
             "shard_bytes": int(self.shard_bytes),
             "batch_lines": int(self.batch_lines),
             "sources": descr,
+            # None for row jobs: a pre-analytics manifest's absent key
+            # reads back as None too, so old row jobs still resume.
+            "aggregate": spec_tuple(
+                parse_aggregate_config(self.aggregate)
+            ),
         }
 
 
@@ -217,7 +231,7 @@ class _ShardAccumulator:
     not double-counted)."""
 
     __slots__ = ("tables", "rejects", "reason_counts", "lines",
-                 "payload_bytes")
+                 "payload_bytes", "agg", "rows")
 
     def __init__(self) -> None:
         self.tables: List[Any] = []
@@ -225,6 +239,10 @@ class _ShardAccumulator:
         self.reason_counts: Dict[str, int] = {}
         self.lines = 0
         self.payload_bytes = 0
+        # Aggregate mode: the shard's merged partial state + good-line
+        # count (there is no data table to count rows from).
+        self.agg: Any = None
+        self.rows = 0
 
 
 def _split_chaos(chaos: Any):
@@ -288,6 +306,9 @@ def run_job(
     pod = spec.n_hosts > 1
     own_name = (host_manifest_name(spec.host_index) if pod
                 else MANIFEST_NAME)
+    from ..analytics.spec import parse_aggregate_config
+
+    agg_spec = parse_aggregate_config(spec.aggregate)
     sources_norm = normalize_sources(spec.sources)
     plan = plan_shards(sources_norm, spec.shard_bytes)
     out_dir = spec.out_dir
@@ -373,6 +394,16 @@ def run_job(
             parser.arm_device_chaos(None)
     elif armed_caller_parser:
         parser.arm_device_chaos(device_chaos)
+    if agg_spec is not None:
+        # Field-level spec validation needs the built parser; a bad
+        # spec must refuse the job BEFORE the pool spins up (and must
+        # not leak a just-built parser's worker pools).
+        try:
+            agg_spec.validate_for(parser)
+        except Exception:
+            if own_parser:
+                parser.close()
+            raise
 
     # The pool runs over a RENUMBERED plan (FeederPool requires index ==
     # position); remaining[pool_index] maps back to the global shard.
@@ -412,10 +443,19 @@ def run_job(
                           labels={"reason": "write_io"})
             LOG.error("job: shard %d failed durably: %s", shard.index, e)
 
+        agg_state = None
+        if agg_spec is not None:
+            # Always a sidecar, even for an empty shard: a committed
+            # aggregate shard's record must carry its partial frame
+            # (merged_job_aggregate folds records, not directory scans).
+            from ..analytics.state import AggregateState
+
+            agg_state = (acc.agg if acc.agg is not None
+                         else AggregateState(agg_spec))
         try:
             record = writer.write_shard(
                 shard, data_table, acc.rejects, acc.lines,
-                acc.payload_bytes,
+                acc.payload_bytes, agg_state=agg_state, agg_rows=acc.rows,
             )
         except ShardWriteError as e:
             fail(e)
@@ -492,9 +532,14 @@ def run_job(
         return True
 
     try:
-        stream = parser.parse_batch_stream(
-            _tap(pool.batches(detach=True)), emit_views=False,
-        )
+        if agg_spec is not None:
+            stream = parser.aggregate_batch_stream(
+                _tap(pool.batches(detach=True)), agg_spec,
+            )
+        else:
+            stream = parser.parse_batch_stream(
+                _tap(pool.batches(detach=True)), emit_views=False,
+            )
         for result in stream:
             pshard, bidx, n_lines, src_bytes = meta.popleft()
             if current is None:
@@ -502,8 +547,12 @@ def run_job(
             if pshard != current and not _advance_to(pshard):
                 report.stopped_early = True
                 return report
-            _fold_result(remaining[pshard], bidx, src_bytes, result, acc,
-                         reg)
+            if agg_spec is not None:
+                _fold_outcome(remaining[pshard], bidx, src_bytes, result,
+                              acc)
+            else:
+                _fold_result(remaining[pshard], bidx, src_bytes, result,
+                             acc, reg)
         if current is None and pool_shards:
             current = 0  # every shard was empty
         if not _advance_to(None):
@@ -529,6 +578,29 @@ def run_job(
                 log_warning_once(LOG, f"job: parser close failed: {e}")
         report.wall_s = time.perf_counter() - t_start
     return report
+
+
+def _fold_outcome(shard: Shard, batch_index: int, src_bytes: int,
+                  outcome, acc: _ShardAccumulator) -> None:
+    """Aggregate-mode twin of :func:`_fold_result`: merge one
+    :class:`~logparser_tpu.analytics.state.AggregateOutcome` into its
+    shard's accumulator — partial state, good-line count, and the same
+    reasoned reject ledger the row path lands (an aggregate job never
+    silently drops a bad line either)."""
+    line_base = acc.lines
+    if acc.agg is None:
+        acc.agg = outcome.state
+    else:
+        acc.agg.merge(outcome.state)
+    acc.rows += outcome.good_lines
+    for row, reason, raw in outcome.reject_items:
+        acc.rejects.append((
+            shard.index, batch_index, line_base + int(row), reason,
+            bytes(raw),
+        ))
+        acc.reason_counts[reason] = acc.reason_counts.get(reason, 0) + 1
+    acc.lines += outcome.lines_read
+    acc.payload_bytes += int(src_bytes)
 
 
 def _fold_result(shard: Shard, batch_index: int, src_bytes: int, result,

@@ -43,6 +43,11 @@ LOG = logging.getLogger(__name__)
 
 DATA_FILE = "shard-{index:05d}.arrow"
 REJECT_FILE = "shard-{index:05d}.rejects.arrow"
+# Aggregate-mode sidecar (docs/ANALYTICS.md): one partial-aggregate
+# frame per shard, committed through the same temp->fsync->rename->
+# manifest protocol — always written (even for an empty shard) so a
+# committed aggregate shard's record always carries its sidecar.
+AGG_FILE = "shard-{index:05d}.agg.arrow"
 
 #: The writer's retryable operations (chaos injection points share the
 #: names: ``io_error:op=write`` etc.).
@@ -170,16 +175,21 @@ class JobWriter:
     # -- shard commit ---------------------------------------------------
 
     def write_shard(self, shard, data_table, reject_rows, lines: int,
-                    payload_bytes: int) -> ShardRecord:
+                    payload_bytes: int, agg_state: Any = None,
+                    agg_rows: int = 0) -> ShardRecord:
         """Land one shard's outputs and return its (uncommitted)
         :class:`ShardRecord` — the runner appends it to the manifest,
-        which is the actual commit point."""
+        which is the actual commit point.  ``agg_state`` (aggregate-mode
+        jobs) lands the shard's partial-aggregate sidecar instead of a
+        data table; ``agg_rows`` records the shard's good-line count in
+        the record's ``rows`` field (there is no data table to count)."""
         from ..tpu.arrow_bridge import table_to_ipc_bytes
 
         t0 = time.perf_counter()
         reg = metrics()
         data_file = data_hash = None
         reject_file = reject_hash = None
+        agg_file = agg_hash = None
         rows = 0
         if data_table is not None and data_table.num_rows:
             rows = int(data_table.num_rows)
@@ -187,6 +197,13 @@ class JobWriter:
             data_file = DATA_FILE.format(index=shard.index)
             data_hash = hashlib.blake2b(data).hexdigest()
             self.write_file(data_file, data, shard.index)
+            reg.increment("job_bytes_written_total", len(data))
+        if agg_state is not None:
+            rows = int(agg_rows)
+            data = agg_state.to_ipc_bytes()
+            agg_file = AGG_FILE.format(index=shard.index)
+            agg_hash = hashlib.blake2b(data).hexdigest()
+            self.write_file(agg_file, data, shard.index)
             reg.increment("job_bytes_written_total", len(data))
         if reject_rows:
             reject = table_to_ipc_bytes(build_reject_table(reject_rows))
@@ -203,6 +220,7 @@ class JobWriter:
             payload_bytes=payload_bytes,
             data_file=data_file, reject_file=reject_file,
             data_hash=data_hash, reject_hash=reject_hash,
+            agg_file=agg_file, agg_hash=agg_hash,
         )
 
 
@@ -228,7 +246,41 @@ def merged_hash(out_dir: str, manifest: JobManifest) -> str:
                 continue
             with open(os.path.join(out_dir, name), "rb") as f:
                 h.update(f.read())
+        # Aggregate sidecars join the identity only when present, so a
+        # pre-analytics job's hash is unchanged byte for byte.
+        if rec.agg_file is not None:
+            with open(os.path.join(out_dir, rec.agg_file), "rb") as f:
+                h.update(f.read())
     return h.hexdigest()
+
+
+def merged_job_aggregate(out_dir: str,
+                         manifest: Optional[JobManifest] = None):
+    """Merge every committed shard's partial-aggregate sidecar — in
+    global shard order — into one
+    :class:`~logparser_tpu.analytics.state.AggregateState`: the job-level
+    aggregate answer (docs/ANALYTICS.md).  Order is cosmetic (the merge
+    is associative and commutative) but fixed, so two resumed/pod runs
+    of one job produce byte-identical merged frames."""
+    from ..analytics.spec import AggregateSpec
+    from ..analytics.state import AggregateState
+
+    if manifest is None:
+        manifest = JobManifest.load(out_dir)
+        if manifest is None:
+            raise ValueError(f"{out_dir}: no manifest to aggregate")
+    key = manifest.job.get("aggregate")
+    if not key:
+        raise ValueError(f"{out_dir}: not an aggregate-mode job")
+    spec = AggregateSpec.from_canonical(key)
+    total = AggregateState(spec)
+    for idx in manifest.committed_indices():
+        rec = manifest.shards[idx]
+        if rec.agg_file is None:
+            continue
+        with open(os.path.join(out_dir, rec.agg_file), "rb") as f:
+            total.merge(AggregateState.from_ipc_bytes(f.read(), spec))
+    return total
 
 
 def leaked_temp_files(out_dir: str) -> List[str]:

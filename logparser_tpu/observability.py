@@ -574,6 +574,7 @@ PIPELINE_STAGES = (
     "oracle_fallback",   # host per-line engine over routed lines
     "assembly",          # BatchResult -> pyarrow Table (hostpool fan-out)
     "ipc",               # Arrow IPC stream serialization
+    "aggregate",         # analytics pushdown: partial fetch + host fold
 )
 
 _ANNOTATE = {"enabled": _env_truthy("LOGPARSER_TPU_XPROF_STAGES")}

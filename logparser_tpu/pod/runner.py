@@ -70,6 +70,11 @@ class PodSpec:
     n_hosts: int = 2
     shard_bytes: int = DEFAULT_SHARD_BYTES
     batch_lines: int = DEFAULT_JOB_BATCH_LINES
+    # Analytics pushdown (docs/ANALYTICS.md): aggregate-mode pod — each
+    # host lands partial-aggregate sidecars, the merge step folds them
+    # into the pod-level answer.  Output-determining (fingerprinted by
+    # every host job).
+    aggregate: Optional[Any] = None
     # Execution-only:
     workers: Optional[int] = None          # feeder workers per host
     use_processes: Optional[bool] = None
@@ -91,6 +96,7 @@ class PodSpec:
             n_hosts=self.n_hosts,
             host_index=host_index,
             data_parallel=self.data_parallel,
+            aggregate=self.aggregate,
         )
 
 
@@ -133,6 +139,9 @@ class PodReport:
     hosts: List[HostResult] = field(default_factory=list)
     wall_s: float = 0.0
     merge_error: Optional[str] = None
+    # Aggregate-mode pods: the merged job-level aggregate summary
+    # (None for row pods or before a successful merge).
+    aggregate: Optional[List[Dict[str, Any]]] = None
 
     @property
     def complete(self) -> bool:
@@ -150,6 +159,8 @@ class PodReport:
             "wall_s": round(self.wall_s, 4),
             **({"merge_error": self.merge_error}
                if self.merge_error else {}),
+            **({"aggregate": self.aggregate}
+               if self.aggregate is not None else {}),
             "hosts": [
                 {
                     "host": h.host_index,
@@ -192,6 +203,13 @@ def host_argv(spec: PodSpec, host_index: int,
         argv += ["--transport", spec.transport]
     if spec.data_parallel:
         argv += ["--data-parallel", str(spec.data_parallel)]
+    if spec.aggregate is not None:
+        # Canonical JSON on the wire: every host must fingerprint the
+        # IDENTICAL spec string or the merge would refuse its manifests.
+        from ..analytics.spec import parse_aggregate_config
+
+        argv += ["--aggregate",
+                 parse_aggregate_config(spec.aggregate).canonical_key()]
     return argv
 
 
@@ -376,7 +394,19 @@ def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
             report.merged_shards = len(merged.shards)
             reg.increment("pod_merge_runs_total")
             reg.increment("pod_merged_shards_total", len(merged.shards))
-        except ManifestError as e:
+            if spec.aggregate is not None:
+                # Pod-level aggregate: fold every committed shard's
+                # partial sidecar — hosts merge exactly like manifests
+                # (docs/ANALYTICS.md), and the answer over a partial
+                # merge is the partial answer, never a wrong one.
+                from ..jobs.writer import merged_job_aggregate
+
+                t_m = time.perf_counter()
+                report.aggregate = merged_job_aggregate(
+                    spec.out_dir, merged).summary()
+                reg.observe("analytics_partial_merge_seconds",
+                            time.perf_counter() - t_m)
+        except (ManifestError, ValueError, OSError) as e:
             report.merge_error = str(e)
             reg.increment("pod_merge_refusals_total")
     report.wall_s = time.perf_counter() - t0

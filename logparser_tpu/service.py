@@ -439,11 +439,21 @@ class _ParserCache:
         same key share one parser — and one continuous-batching lane
         (requests coalesce ONLY within a key: a shared device batch must
         run exactly one compiled program)."""
+        agg = config.get("aggregate")
+        if agg is not None:
+            # Analytics pushdown (PROTOCOL.md "aggregate"): per-session
+            # specs key the parser cache, so an aggregate session never
+            # shares a compiled-reduction cache — or a continuous-
+            # batching lane — with a row session or a different spec.
+            from .analytics.spec import parse_aggregate_config
+
+            agg = parse_aggregate_config(agg).canonical_key()
         return (
             config["log_format"],
             tuple(config["fields"]),
             config.get("timestamp_format"),
             config.get("assembly_workers"),
+            agg,
         )
 
     def get(self, config: Dict[str, Any]):
@@ -775,7 +785,21 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                         "coalesce_wait_ms must be >= 0, got "
                         f"{config['coalesce_wait_ms']!r}"
                     )
+            # Analytics pushdown (PROTOCOL.md "aggregate" / docs/
+            # ANALYTICS.md): the session's responses become aggregate
+            # frames instead of row Arrow.  Spec errors — bad JSON, an
+            # unknown op, a field outside the parse config — relay
+            # through the same "bad config:" loop as every other
+            # config defect.
+            agg_spec = None
+            if isinstance(config, dict) \
+                    and config.get("aggregate") is not None:
+                from .analytics.spec import parse_aggregate_config
+
+                agg_spec = parse_aggregate_config(config["aggregate"])
             parser = self.server.parser_cache.get(config)
+            if agg_spec is not None:
+                agg_spec.validate_for(parser)
             metrics().increment("service_sessions_total")
         except Exception as e:  # noqa: BLE001 — relay config errors to client
             self._config_error_loop(f"bad config: {e}")
@@ -787,7 +811,8 @@ class _SessionHandler(socketserver.BaseRequestHandler):
             parser_key = repr(config)
         state = {"feeder_workers": feeder_workers,
                  "parser_key": parser_key,
-                 "coalesce_wait_s": coalesce_wait_s}
+                 "coalesce_wait_s": coalesce_wait_s,
+                 "aggregate": agg_spec}
         # Per-key session registry: the coalescer skips its straggler
         # window when this session is the key's only one.
         self.server.key_session_enter(parser_key)
@@ -1026,6 +1051,23 @@ class _SessionHandler(socketserver.BaseRequestHandler):
             )
         blob_shape = count and blob and not blob.endswith(b"\n") \
             and b"\r" not in blob
+        if state.get("aggregate") is not None:
+            # Aggregate session (docs/ANALYTICS.md): the response is an
+            # aggregate frame, not row Arrow, so the feeder's table
+            # concatenation and the coalescer's row-window slicing
+            # don't apply — aggregate requests keep their own
+            # dispatch.  (They never coalesce wrongly either way:
+            # the spec is part of the parser cache key, so an
+            # aggregate session shares no lane with a row session.)
+            spec = state["aggregate"]
+            if blob_shape:
+                agg_out = parser.aggregate_blob(blob, spec)
+            else:
+                agg_out = parser.aggregate_batch(
+                    blob.split(b"\n") if count else [], spec
+                )
+            return (agg_out.state.to_ipc_bytes(), count,
+                    agg_out.oracle_rows, agg_out.bad_lines)
         feeder_workers = state["feeder_workers"]
         table = None
         if blob_shape and feeder_workers >= 2 \
@@ -1635,6 +1677,7 @@ class ParseServiceClient:
         backoff_max_s: float = 2.0,
         timeout: Optional[float] = None,
         tenant: Optional[str] = None,
+        aggregate: Optional[Any] = None,
     ):
         self._addr = (host, port)
         self._stats = bool(stats)
@@ -1666,6 +1709,18 @@ class ParseServiceClient:
             # Only stats sessions carry the key: a v1 server ignores it,
             # but omitting it keeps this client byte-exact v1 by default.
             config["stats"] = True
+        self._agg_spec = None
+        if aggregate is not None:
+            # Analytics pushdown (PROTOCOL.md "aggregate"): the session's
+            # responses become aggregate frames; :meth:`parse` returns an
+            # :class:`~logparser_tpu.analytics.AggregateState` instead of
+            # a row table.  Parsed eagerly so a malformed spec fails at
+            # construction, not as a server error frame.
+            from .analytics.spec import parse_aggregate_config
+
+            self._agg_spec = parse_aggregate_config(aggregate)
+            config["aggregate"] = [op.as_dict()
+                                   for op in self._agg_spec.ops]
         self._config_payload = json.dumps(config).encode("utf-8")
         self._sock = self._connect()
 
@@ -1765,6 +1820,10 @@ class ParseServiceClient:
             raise ServiceClosedError("server closed the connection")
         with pa.ipc.open_stream(pa.BufferReader(response)) as reader:
             table = reader.read_all()
+        if self._agg_spec is not None:
+            from .analytics.state import AggregateState
+
+            table = AggregateState.from_arrow(table, self._agg_spec)
         if self._stats:
             stats_frame = read_frame(self._sock)
             if stats_frame is None:

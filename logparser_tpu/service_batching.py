@@ -6,7 +6,10 @@ N× the per-batch fixed cost — device dispatch, pad waste, one D2H
 round-trip each — that ``tpu/batch.py`` amortizes so well at large batch
 sizes.  This module coalesces line payloads ACROSS sessions into shared
 device batches, keyed by the compiled-parser cache key (format + fields
-config): the LLM-serving continuous-batching trick applied to log lines,
+config — and the aggregate spec, so analytics-pushdown sessions, whose
+requests return aggregate frames and never enter the coalescer, can
+never share a lane with row sessions even by key collision): the
+LLM-serving continuous-batching trick applied to log lines,
 and the device-program twin of CelerLog's route-by-format host
 dispatching (PAPERS.md).
 

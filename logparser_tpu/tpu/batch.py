@@ -543,6 +543,17 @@ def _release_stream_item(item) -> None:
         release()
 
 
+def _raw_line_bytes(line) -> bytes:
+    """One line as ingested bytes — :meth:`BatchResult.raw_line`'s
+    conversion for paths that carry no result object (the aggregate
+    reject ledger)."""
+    if isinstance(line, bytes):
+        return line
+    if isinstance(line, (bytearray, memoryview)):
+        return bytes(line)
+    return str(line).encode("utf-8", errors="surrogateescape")
+
+
 class BatchResult:
     """Columnar parse result over one batch."""
 
@@ -1054,6 +1065,12 @@ class TpuBatchParser:
         )
         self._jitted = self._build_jitted()
         self._jitted_views = None  # lazily built by device_views_fn()
+        # Aggregate-pushdown executors (docs/ANALYTICS.md): canonical
+        # spec key -> (csr_slots at build, jitted reduction, op plans).
+        # _agg_disabled holds spec keys whose reduction failed to
+        # COMPILE — permanently demoted to the exact row-path fallback.
+        self._agg_fns: Dict[str, tuple] = {}
+        self._agg_disabled: set = set()
 
     def _init_fault_layer(self, budget, deadline, policy, chaos) -> None:
         """Device-tier fault state — shared by ``__init__`` and
@@ -1912,6 +1929,288 @@ class TpuBatchParser:
         )
         return (lines, buf, lengths, overflow, B, padded_b, staged)
 
+    # ------------------------------------------------------------------
+    # analytics pushdown (docs/ANALYTICS.md): aggregate queries fuse the
+    # reduction into the device pass — the packed columns, view rows and
+    # Arrow assembly never happen, and the D2H transfer is the per-batch
+    # partial arrays (a few KB) plus one byte per row of fold/reject
+    # classification.  Rows the device cannot finish exactly replay the
+    # ordinary row path host-side, so every aggregate is bit-identical
+    # to aggregating the row-path results.
+    # ------------------------------------------------------------------
+
+    def _resolve_agg_spec(self, spec):
+        """Normalize a public ``spec`` argument: a built ``AggregateSpec``
+        passes through untouched (the service/jobs boundary already
+        validated it); an op list or JSON string parses AND validates
+        against this parser's fields here, so the parser-level surface
+        matches the CONFIG/CLI one."""
+        from ..analytics.spec import AggregateSpec, parse_aggregate_config
+
+        if isinstance(spec, AggregateSpec):
+            return spec
+        parsed = parse_aggregate_config(spec)
+        if parsed is None:
+            raise ValueError("aggregate: need a spec (op list, JSON "
+                             "string, or AggregateSpec)")
+        parsed.validate_for(self)
+        return parsed
+
+    def aggregate_batch(self, lines: Sequence[Union[bytes, str]], spec):
+        """Parse + aggregate one batch entirely on device: returns an
+        :class:`~logparser_tpu.analytics.state.AggregateOutcome` whose
+        ``state`` holds this batch's partial aggregates (merge partials
+        across batches with ``AggregateState.merge``).  ``spec`` is an
+        ``AggregateSpec``, an op list, or a JSON string (validated
+        against this parser's fields)."""
+        spec = self._resolve_agg_spec(spec)
+        return self._finish_aggregate(
+            self._dispatch_aggregate(self._encode_batch(lines), spec), spec
+        )
+
+    def aggregate_blob(self, data: Union[bytes, bytearray, memoryview],
+                       spec):
+        """:meth:`parse_blob` framing, aggregate delivery (the jobs /
+        sidecar ingest shape)."""
+        from ..native import encode_blob
+        from ..observability import pipeline_stage, record_batch_shape
+
+        spec = self._resolve_agg_spec(spec)
+        data = bytes(data)
+        lines = _BlobLines(data)
+        B = len(lines)
+        with pipeline_stage("encode", items=B):
+            buf, lengths, overflow = encode_blob(data)
+        if buf.shape[0] != B:  # framer/view disagreement: authoritative path
+            return self.aggregate_batch(list(lines), spec)
+        padded_b = self._bucket(B)
+        if padded_b != B:
+            buf = np.pad(buf, ((0, padded_b - B), (0, 0)))
+            lengths = np.pad(lengths, (0, padded_b - B))
+        record_batch_shape(B, padded_b, buf.shape[1], int(lengths.sum()))
+        enc = (lines, buf, lengths, overflow, B, padded_b)
+        return self._finish_aggregate(
+            self._dispatch_aggregate(enc, spec), spec
+        )
+
+    def aggregate_batch_stream(self, batches, spec, depth: int = 1):
+        """Streamed aggregation: yields one AggregateOutcome per input
+        batch, in order, overlapping host accumulation with device work
+        (the :meth:`parse_batch_stream` discipline minus the packed D2H
+        — there is nothing column-sized to drain).  Items may be line
+        lists, or feeder-framed ``EncodedBatch``es (ring slots release
+        one accumulation behind delivery, as in the row stream)."""
+        from collections import deque
+
+        from ..feeder.worker import EncodedBatch
+
+        spec = self._resolve_agg_spec(spec)
+        depth = max(1, depth)
+        pending = deque()
+        inflight = deque()
+        try:
+            for lines in batches:
+                enc = (
+                    self._adopt_encoded(lines)
+                    if isinstance(lines, EncodedBatch)
+                    else self._encode_batch(lines)
+                )
+                inflight.append(lines)
+                pending.append(self._dispatch_aggregate(enc, spec))
+                if len(pending) > depth:
+                    outcome = self._finish_aggregate(
+                        pending.popleft(), spec
+                    )
+                    _release_stream_item(inflight.popleft())
+                    yield outcome
+            while pending:
+                outcome = self._finish_aggregate(pending.popleft(), spec)
+                _release_stream_item(inflight.popleft())
+                yield outcome
+        finally:
+            while inflight:
+                _release_stream_item(inflight.popleft())
+
+    def _agg_executor(self, spec):
+        """The compiled aggregate reduction for this parser + spec:
+        cached per (canonical spec, CSR slot generation) — a slot regrow
+        rebuilds the units, so the reduction rebuilds with them.  None
+        when the parser is host-only, the breaker is open, or the spec's
+        reduction was compile-demoted (every batch then replays the
+        exact row path)."""
+        key = spec.canonical_key()
+        if key in self._agg_disabled:
+            return None
+        cached = self._agg_fns.get(key)
+        if cached is not None and cached[0] == self.csr_slots:
+            return cached[1]
+        from ..analytics.device import build_aggregate_fn
+
+        fn, _ = build_aggregate_fn(self, spec)
+        self._agg_fns[key] = (self.csr_slots, fn)
+        return fn
+
+    def _dispatch_aggregate(self, enc, spec):
+        """Asynchronously dispatch the aggregate reduction for one
+        encoded batch; faults ride the state tuple to
+        :meth:`_finish_aggregate` (same discipline as the row path)."""
+        from ..observability import metrics, pipeline_stage
+
+        lines, buf, lengths, overflow, B, padded_b = enc[:6]
+        out = None
+        fault = None
+        fn = self._agg_executor(spec) if self._breaker.allow() else None
+        if fn is not None and self._oom_clamp is not None \
+                and padded_b > self._oom_clamp:
+            # Standing OOM clamp: the row-path fallback executes this
+            # batch in clamp-sized chunks instead.
+            fn = None
+        if fn is not None:
+            n_group_ops = sum(
+                1 for op in spec.ops
+                if op.op in ("count_by", "top_k", "time_bucket")
+            )
+            self._check_device_budget(
+                buf, lengths, B, False, aggregate_group_ops=n_group_ops
+            )
+            host_kill = np.zeros(padded_b, dtype=bool)
+            for i in overflow:
+                # Truncated lines: the device saw a prefix — judged
+                # host-side, exactly like the row path's overflow demote.
+                host_kill[i] = True
+            metrics().increment(
+                "device_dispatch_total", labels={"views": "agg"}
+            )
+            with pipeline_stage("device", items=B):
+                try:
+                    out = fn(jnp.asarray(buf), jnp.asarray(lengths),
+                             jnp.int32(B), jnp.asarray(host_kill))
+                except Exception as e:  # noqa: BLE001 — absorbed at finish
+                    out, fault = None, e
+        return (lines, buf, lengths, overflow, B, padded_b, out,
+                spec.canonical_key(), fault)
+
+    def _finish_aggregate(self, state, spec):
+        """Block on one in-flight aggregate dispatch: fetch the partials,
+        accumulate them host-side, and replay every folded row through
+        the ordinary row path so the outcome is exact.  Any device fault
+        (or no executor at all) downgrades the WHOLE batch to the row
+        path — which owns the central fault absorption — and aggregates
+        its delivered rows; an aggregate stream never aborts on a device
+        failure and never returns an approximate answer."""
+        from ..analytics.device import accumulate_partials, fetch_partials
+        from ..analytics.state import AggregateOutcome, AggregateState
+        from ..observability import metrics, observe_stage
+
+        (lines, buf, lengths, overflow, B, padded_b, out, key,
+         fault) = state
+        agg = AggregateState(spec)
+        fetched = None
+        nbytes = 0
+        t0 = time.perf_counter()
+        if out is not None and fault is None:
+            try:
+                fetched, nbytes = fetch_partials(out, spec, B, padded_b)
+            except Exception as e:  # noqa: BLE001 — classified below
+                fetched, fault = None, e
+        if fault is not None:
+            from ..observability import log_warning_once
+            from .device_faults import classify_device_error
+
+            if classify_device_error(fault) == "compile":
+                # The REDUCTION does not compile (the row kernel may be
+                # fine): demote this spec permanently, keep the parser.
+                self._agg_disabled.add(key)
+                metrics().increment("analytics_compile_demotions_total")
+                log_warning_once(
+                    _LOG,
+                    "analytics: aggregate reduction failed to compile; "
+                    "spec demoted to the exact row-path fallback "
+                    "(analytics_compile_demotions_total counts, details "
+                    "at DEBUG)",
+                )
+                _LOG.debug("aggregate compile fault for %s: %s", key, fault)
+            else:
+                _LOG.debug("aggregate device fault (row-path fallback "
+                           "absorbs): %s", fault)
+        if fetched is None:
+            # Row-path fallback for the whole batch: a fresh dispatch —
+            # NOT the ridden fault — so the row executor's own fault
+            # layer (bisect/reroute/breaker) judges its own faults.
+            result = self._finish_batch(
+                (lines, buf, lengths, overflow, B, padded_b, None,
+                 self.csr_slots, False, None)
+            )
+            metrics().increment("analytics_batches_total",
+                                labels={"path": "fallback"})
+            t1 = time.perf_counter()
+            agg.update_from_result(result)
+            metrics().observe("analytics_partial_merge_seconds",
+                              time.perf_counter() - t1)
+            reject_items = [
+                (int(i), reason, result.raw_line(int(i)))
+                for i, reason in sorted(result.reject_reasons.items())
+            ]
+            return AggregateOutcome(
+                agg, B, result.good_lines, result.bad_lines,
+                result.oracle_rows, reject_items,
+                device_rows=0, d2h_bytes=0,
+            )
+        self._breaker.record_success()
+        cls = fetched["cls"]
+        accumulate_partials(agg, spec, fetched, buf)
+        observe_stage("aggregate", time.perf_counter() - t0, items=B)
+        metrics().increment("d2h_bytes_total", int(nbytes))
+        metrics().increment("analytics_batches_total",
+                            labels={"path": "device"})
+        # What the row path would have transferred for this batch
+        # (packed unit rows + the device-view block) minus what the
+        # partials actually cost:
+        from .pipeline import packed_row_count
+
+        row_bytes = (
+            packed_row_count(self.units) + 4 * self._view_field_count(None)
+        ) * padded_b * 4
+        metrics().increment(
+            "analytics_d2h_bytes_saved_total",
+            max(0, int(row_bytes) - int(nbytes)),
+        )
+        n_device = int(np.count_nonzero(cls == 0))
+        fold_rows = np.nonzero(cls == 1)[0]
+        reject_rows = np.nonzero(cls == 2)[0]
+        reject_items = [
+            (int(i), "implausible", _raw_line_bytes(lines[int(i)]))
+            for i in reject_rows
+        ]
+        good = n_device
+        bad = len(reject_rows)
+        oracle_rows = 0
+        if len(fold_rows):
+            # Exactness fold: every row the device flagged replays the
+            # ordinary row path (rescue, overflow patches, escaped-quote
+            # and oracle semantics included) and aggregates from its
+            # delivered values — per-row results are independent of
+            # batch geometry, so the sub-batch parses identically.
+            sub = self.parse_batch(
+                [lines[int(i)] for i in fold_rows], emit_views=False
+            )
+            t1 = time.perf_counter()
+            agg.update_from_result(sub)
+            metrics().observe("analytics_partial_merge_seconds",
+                              time.perf_counter() - t1)
+            good += sub.good_lines
+            bad += sub.bad_lines
+            oracle_rows = sub.oracle_rows
+            for j, reason in sub.reject_reasons.items():
+                reject_items.append(
+                    (int(fold_rows[int(j)]), reason, sub.raw_line(int(j)))
+                )
+            reject_items.sort(key=lambda item: item[0])
+        return AggregateOutcome(
+            agg, B, good, bad, oracle_rows, reject_items,
+            device_rows=n_device, d2h_bytes=int(nbytes),
+        )
+
     def _start_batch(self, lines: Sequence[Union[bytes, str]]):
         """Encode + pad + asynchronously dispatch the device program.
         Returns the in-flight state ``_finish_batch`` consumes."""
@@ -1942,14 +2241,19 @@ class TpuBatchParser:
         return len(self._view_specs())
 
     def _check_device_budget(self, buf, lengths, B: int,
-                             emit_views: Optional[bool]) -> None:
+                             emit_views: Optional[bool],
+                             aggregate_group_ops: Optional[int] = None,
+                             ) -> None:
         """Pre-allocation device-memory ceiling: validate the padded
         batch's estimated footprint (staged H2D input + packed verdict
         output, ``pipeline.estimate_device_bytes``) against the
         configured budget BEFORE any ``device_put`` — over budget
         answers a structured :class:`DeviceBudgetError`, never an XLA
         RESOURCE_EXHAUSTED (the batch-tier twin of the serving tier's
-        frame ceilings; docs/FAULTS.md)."""
+        frame ceilings; docs/FAULTS.md).  ``aggregate_group_ops`` (the
+        analytics pushdown) selects the aggregate-only footprint — no
+        view rows, partial-sized D2H — so the budget stops over-
+        rejecting aggregate batches that fit comfortably."""
         budget = self.device_bytes_budget
         if not budget:
             return
@@ -1960,6 +2264,7 @@ class TpuBatchParser:
         est = estimate_device_bytes(
             self.units, self._view_field_count(emit_views),
             buf.shape[0], buf.shape[1], lengths.dtype.itemsize,
+            aggregate_group_ops=aggregate_group_ops,
         )
         if est > budget:
             metrics().increment("device_budget_rejects_total")
@@ -3560,6 +3865,10 @@ class TpuBatchParser:
         state["_device_chaos"] = None
         state["_oom_clamp"] = None
         state["_oom_events"] = 0
+        # Aggregate executors are jit handles (rebuilt lazily on load);
+        # the compile-demote set is runtime fault state like the breaker.
+        state["_agg_fns"] = {}
+        state["_agg_disabled"] = set()
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -3589,6 +3898,9 @@ class TpuBatchParser:
             self._overflow_delivery = self._build_overflow_delivery()
         if "data_parallel" not in state:  # pre-pod artifacts
             self.data_parallel = None
+        if "_agg_fns" not in state:  # pre-analytics artifacts
+            self._agg_fns = {}
+            self._agg_disabled = set()
         # Fault layer rebuilds fresh on the loading host: pickled knobs
         # (budget/deadline/policy) are honored, env fallbacks re-read,
         # breaker/clamp/chaos start clean (pre-fault-layer artifacts

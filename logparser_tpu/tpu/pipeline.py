@@ -1326,6 +1326,7 @@ def estimate_device_bytes(
     padded_b: int,
     line_len: int,
     lengths_itemsize: int = 4,
+    aggregate_group_ops: Optional[int] = None,
 ) -> int:
     """Pre-allocation device-footprint estimate for one padded batch:
     the staged H2D input (``[padded_b, line_len]`` uint8 buffer + the
@@ -1334,8 +1335,21 @@ def estimate_device_bytes(
     deliberately the same arithmetic the executor's buffers resolve to,
     so a budget validated against this estimate is a budget the device
     actually sees (docs/FAULTS.md; the batch-tier twin of the serving
-    tier's frame ceilings validated before allocation)."""
-    rows = packed_row_count(units) + 4 * int(n_view_fields)
+    tier's frame ceilings validated before allocation).
+
+    ``aggregate_group_ops`` switches to the analytics-pushdown footprint
+    (docs/ANALYTICS.md): the reduction emits no device-view rows and no
+    packed-column D2H — its resident peak is the units rows (the parse
+    intermediates, before XLA prunes the unread ones) plus the sort
+    workspace of the grouping ops (five int32 key/operand lanes each,
+    double-buffered by ``lax.sort``).  Without this split, the budget
+    charged aggregate batches the full view-emitting row-path footprint
+    and over-rejected batches that fit comfortably."""
+    rows = packed_row_count(units)
+    if aggregate_group_ops is None:
+        rows += 4 * int(n_view_fields)
+    else:
+        rows += 10 * int(aggregate_group_ops)
     input_bytes = padded_b * line_len + padded_b * lengths_itemsize
     return int(input_bytes + rows * padded_b * 4)
 

@@ -1,0 +1,416 @@
+"""On-device analytics pushdown (docs/ANALYTICS.md): the spec grammar,
+device-vs-referee exactness across every entrypoint (batch, blob,
+stream, data-parallel mesh) including forced fold/reject rows, partial
+merge associativity, the (op, key, value) aggregate wire frame, the
+device-budget estimate split, and the jobs/service composition
+(aggregate sidecars survive kill+resume byte-identically; an aggregate
+service session returns the aggregate frame)."""
+import json
+
+import pytest
+
+from _shared_parsers import shared_parser
+from logparser_tpu.analytics import AggregateSpec, AggregateState
+from logparser_tpu.analytics.spec import parse_aggregate_config, spec_tuple
+from logparser_tpu.analytics.state import merge_states
+from logparser_tpu.tools.demolog import generate_combined_lines
+
+pa = pytest.importorskip("pyarrow")
+
+FIELDS = [
+    "IP:connection.client.host",
+    "TIME.EPOCH:request.receive.time.epoch",
+    "STRING:request.status.last",
+    "BYTES:response.body.bytes",
+]
+OPS = [
+    {"op": "count"},
+    {"op": "count_by", "field": "STRING:request.status.last"},
+    {"op": "top_k", "field": "IP:connection.client.host", "k": 3},
+    {"op": "sum", "field": "BYTES:response.body.bytes"},
+    {"op": "histogram", "field": "BYTES:response.body.bytes",
+     "edges": [1000, 100000, 10000000]},
+    {"op": "time_bucket",
+     "field": "TIME.EPOCH:request.receive.time.epoch", "width_s": 3600},
+]
+
+
+def parser(**kwargs):
+    return shared_parser("combined", FIELDS, **kwargs)
+
+
+def spec():
+    return parse_aggregate_config(OPS)
+
+
+def combined_line(ip="1.2.3.4", ts="01/Jan/2026:10:00:00 +0000",
+                  status="200", nbytes="512"):
+    return (
+        f'{ip} - - [{ts}] "GET /x HTTP/1.1" {status} {nbytes} "-" "ua"'
+    ).encode()
+
+
+def referee(p, lines, sp):
+    state = AggregateState(sp)
+    state.update_from_result(p.parse_batch(lines))
+    return state
+
+
+def corpus(n=512, garbage=True):
+    lines = generate_combined_lines(n, seed=7, garbage_fraction=0.0)
+    if garbage:
+        lines[5] = "total garbage ! matches nothing ::"
+        lines[n - 9] = ""
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bad", [
+    "not json at all {",
+    [{"op": "median", "field": "x"}],
+    [{"op": "count_by"}],
+    [{"op": "top_k", "field": "x", "k": 0}],
+    [{"op": "top_k", "field": "x", "k": 10**9}],
+    [{"op": "histogram", "field": "x", "edges": [5, 5]}],
+    [{"op": "histogram", "field": "x", "edges": []}],
+    [{"op": "time_bucket", "field": "x", "width_s": 0}],
+    [{"op": "count"}] * 64,
+    [],
+])
+def test_spec_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_aggregate_config(
+            bad if isinstance(bad, str) else json.dumps(bad)
+        )
+
+
+def test_spec_canonical_roundtrip():
+    sp = spec()
+    key = sp.canonical_key()
+    again = AggregateSpec.from_canonical(key)
+    assert again.canonical_key() == key
+    assert spec_tuple(again) == key
+    # passthroughs: an AggregateSpec and an ops list both parse
+    assert parse_aggregate_config(sp) is sp
+    assert parse_aggregate_config(OPS).canonical_key() == key
+    assert parse_aggregate_config(None) is None
+
+
+@pytest.mark.parametrize("ops,err", [
+    ([{"op": "count_by", "field": "NOSUCH:field"}], "not in the"),
+    ([{"op": "sum", "field": "STRING:request.status.last"}], "numeric"),
+    ([{"op": "count_by", "field": "BYTES:response.body.bytes"}], "string"),
+])
+def test_validate_for_rejects(ops, err):
+    sp = parse_aggregate_config(ops)
+    with pytest.raises(ValueError, match=err):
+        sp.validate_for(parser())
+
+
+# ---------------------------------------------------------------------------
+# device-vs-referee exactness
+# ---------------------------------------------------------------------------
+
+
+def test_aggregate_batch_matches_referee():
+    p, sp, lines = parser(), spec(), corpus()
+    out = p.aggregate_batch(lines, sp)
+    assert out.state == referee(p, lines, sp)
+    assert out.lines_read == len(lines)
+    assert out.good_lines + out.bad_lines == len(lines)
+    assert out.bad_lines == 2
+    # most rows finish on device, and the fetch is far under the packed
+    # row payload the row path would have shipped
+    assert out.device_rows > 0.9 * len(lines)
+    assert 0 < out.d2h_bytes < 64 * len(lines)
+
+
+def test_aggregate_blob_matches_referee():
+    p, sp = parser(), spec()
+    lines = corpus(n=256, garbage=False)
+    blob = b"\n".join(ln if isinstance(ln, bytes) else ln.encode()
+                      for ln in lines) + b"\n"
+    out = p.aggregate_blob(blob, sp)
+    assert out.state == referee(p, lines, sp)
+
+
+def test_aggregate_stream_matches_and_merges():
+    p, sp, lines = parser(), spec(), corpus()
+    chunks = [lines[i:i + 128] for i in range(0, len(lines), 128)]
+    outcomes = list(p.aggregate_batch_stream(chunks, sp, depth=2))
+    assert len(outcomes) == len(chunks)
+    total = merge_states(sp, (o.state for o in outcomes))
+    assert total == referee(p, lines, sp)
+
+
+def test_mesh_aggregate_bit_identical():
+    """data_parallel lay-out must not change a single byte of the
+    partial state (the pod merge protocol depends on it)."""
+    sp, lines = spec(), corpus()
+    single = parser().aggregate_batch(lines, sp).state
+    mesh = parser(data_parallel=8).aggregate_batch(lines, sp).state
+    assert mesh == single
+    assert mesh.to_ipc_bytes() == single.to_ipc_bytes()
+
+
+def test_forced_fold_rows_stay_exact():
+    """Rows the device must NOT finish — 20-digit byte counters (long
+    overflow) and timestamps outside the int32-second window — fold to
+    the host row path and the total still equals the referee."""
+    p, sp = parser(), spec()
+    lines = corpus(n=128, garbage=False)
+    lines[3] = combined_line(nbytes="9" * 20).decode()
+    lines[40] = combined_line(ts="01/Jan/2050:00:00:00 +0000").decode()
+    out = p.aggregate_batch(lines, sp)
+    # both rows FOLDED (left the device-counted set), whatever mix of
+    # row-path machinery finished them host-side
+    assert out.device_rows <= len(lines) - 2
+    assert out.state == referee(p, lines, sp)
+    # the folded overflow value really is in the sum (exceeds int64 paths)
+    count_idx = 0
+    assert out.state.data[count_idx] == len(lines)
+
+
+def test_reject_rows_carry_reasons():
+    p, sp = parser(), spec()
+    lines = corpus(n=128, garbage=False)
+    lines[17] = "total garbage ! matches nothing ::"
+    out = p.aggregate_batch(lines, sp)
+    assert out.bad_lines == 1
+    rows = [r for r, _reason, _raw in out.reject_items]
+    assert rows == sorted(rows)
+    assert any(r == 17 for r, _reason, _raw in out.reject_items)
+    assert out.state == referee(p, lines, sp)
+
+
+def test_histogram_bisect_right_edges():
+    """Bin b holds values with exactly b edges <= v — an edge-value lands
+    in the bin ABOVE the edge, matching the referee's bisect_right."""
+    p = parser()
+    sp = parse_aggregate_config([
+        {"op": "histogram", "field": "BYTES:response.body.bytes",
+         "edges": [1000, 100000]},
+    ])
+    values = [999, 1000, 1001, 99999, 100000, 100001]
+    lines = [combined_line(nbytes=str(v)) for v in values]
+    out = p.aggregate_batch(lines, sp)
+    assert out.state == referee(p, lines, sp)
+    assert out.state.data[0] == [1, 3, 2]
+
+
+def test_time_bucket_hour_boundaries():
+    p = parser()
+    sp = parse_aggregate_config([
+        {"op": "time_bucket",
+         "field": "TIME.EPOCH:request.receive.time.epoch",
+         "width_s": 3600},
+    ])
+    lines = [
+        combined_line(ts="01/Jan/2026:10:59:59 +0000"),
+        combined_line(ts="01/Jan/2026:11:00:00 +0000"),
+        combined_line(ts="01/Jan/2026:11:59:59 +0000"),
+    ]
+    out = p.aggregate_batch(lines, sp)
+    assert out.state == referee(p, lines, sp)
+    assert sorted(out.state.data[0].values()) == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# merge + wire
+# ---------------------------------------------------------------------------
+
+
+def test_merge_associativity():
+    p, sp, lines = parser(), spec(), corpus(n=300)
+    parts = [referee(p, lines[a:b], sp)
+             for a, b in ((0, 70), (70, 71), (71, 300))]
+    left = merge_states(sp, parts)
+    right = AggregateState(sp)
+    tail = merge_states(sp, parts[1:])
+    right.merge(parts[0])
+    right.merge(tail)
+    assert left == right == referee(p, lines, sp)
+
+
+def test_merge_spec_mismatch_raises():
+    a = AggregateState(spec())
+    b = AggregateState(parse_aggregate_config([{"op": "count"}]))
+    with pytest.raises(ValueError, match="spec mismatch"):
+        a.merge(b)
+
+
+def test_wire_roundtrip_and_accumulate():
+    p, sp, lines = parser(), spec(), corpus(n=200)
+    state = p.aggregate_batch(lines, sp).state
+    table = state.to_arrow()
+    assert table.column_names == ["op", "key", "value"]
+    again = AggregateState.from_ipc_bytes(state.to_ipc_bytes(), sp)
+    assert again == state
+    # merging the same frame twice doubles every carrier
+    twice = AggregateState(sp)
+    twice.merge(AggregateState.from_arrow(table, sp))
+    twice.merge(AggregateState.from_arrow(table, sp))
+    expect = AggregateState(sp)
+    expect.merge(state)
+    expect.merge(state)
+    assert twice == expect
+
+
+def test_wire_rejects_bad_rows():
+    sp = spec()
+    bad = pa.table({
+        "op": pa.array([99], type=pa.int32()),
+        "key": pa.array([b""], type=pa.binary()),
+        "value": pa.array(["1"], type=pa.string()),
+    })
+    with pytest.raises(ValueError, match="bad op index"):
+        AggregateState.from_arrow(bad, sp)
+
+
+def test_topk_summary_selection_deterministic():
+    sp = parse_aggregate_config(
+        [{"op": "top_k", "field": "IP:connection.client.host", "k": 2}]
+    )
+    state = AggregateState(sp)
+    state.data[0] = {b"b": 5, b"a": 5, b"c": 9, b"d": 1}
+    (d,) = state.summary()
+    assert d["values"] == [["c", 9], ["a", 5]]
+    # the wire still carries the FULL dict (associativity across shards)
+    assert len(state._rows()) == 4
+
+
+# ---------------------------------------------------------------------------
+# device-budget estimate
+# ---------------------------------------------------------------------------
+
+
+def test_estimate_device_bytes_aggregate_variant():
+    from logparser_tpu.tpu.pipeline import estimate_device_bytes
+
+    p = parser()
+    n_views = p._view_field_count(None)
+    row = estimate_device_bytes(p.units, n_views, 512, 256)
+    agg = estimate_device_bytes(p.units, n_views, 512, 256,
+                                aggregate_group_ops=2)
+    assert agg != row
+    assert agg == estimate_device_bytes(p.units, 0, 512, 256,
+                                        aggregate_group_ops=2)
+
+
+# ---------------------------------------------------------------------------
+# jobs composition: aggregate sidecars through the manifest protocol
+# ---------------------------------------------------------------------------
+
+
+def _job_corpus(tmp_path, n=240):
+    lines = generate_combined_lines(n, seed=3, garbage_fraction=0.0)
+    lines[11] = "garbage that matches nothing ::"
+    blob = "\n".join(lines).encode() + b"\n"
+    path = tmp_path / "corpus.log"
+    path.write_bytes(blob)
+    return lines, path
+
+
+def _job_spec(tmp_path, corpus_path, out_name, **kw):
+    from logparser_tpu.jobs import JobSpec
+
+    kw.setdefault("shard_bytes", 4096)
+    kw.setdefault("batch_lines", 64)
+    kw.setdefault("use_processes", False)
+    kw.setdefault("aggregate", json.dumps(OPS))
+    return JobSpec([str(corpus_path)], "combined", FIELDS,
+                   str(tmp_path / out_name), **kw)
+
+
+def test_job_aggregate_kill_resume_byte_identical(tmp_path):
+    from logparser_tpu.jobs import (
+        JobPolicy, merged_hash, merged_job_aggregate, run_job,
+    )
+
+    lines, corpus_path = _job_corpus(tmp_path)
+    p, sp = parser(), spec()
+
+    rep_a = run_job(_job_spec(tmp_path, corpus_path, "a"), parser=p)
+    assert rep_a.complete
+
+    spec_b = _job_spec(tmp_path, corpus_path, "b")
+    rep_b1 = run_job(spec_b, parser=p,
+                     policy=JobPolicy(stop_after_shards=2))
+    assert not rep_b1.complete and rep_b1.committed == 2
+    rep_b2 = run_job(spec_b, parser=p)
+    assert rep_b2.complete
+    assert rep_b2.skipped == 2
+
+    from logparser_tpu.jobs import JobManifest
+
+    dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+    assert merged_hash(dir_a, JobManifest.load(dir_a)) == merged_hash(
+        dir_b, JobManifest.load(dir_b))
+    agg_a = merged_job_aggregate(str(tmp_path / "a"))
+    agg_b = merged_job_aggregate(str(tmp_path / "b"))
+    assert agg_a == agg_b == referee(p, lines, sp)
+    assert agg_a.data[0] == len(lines) - 1  # one garbage line rejected
+
+
+def test_job_aggregate_fingerprint_pins_spec(tmp_path):
+    from logparser_tpu.jobs import ManifestError, run_job
+
+    _, corpus_path = _job_corpus(tmp_path, n=64)
+    p = parser()
+    run_job(_job_spec(tmp_path, corpus_path, "j"), parser=p)
+    other = _job_spec(tmp_path, corpus_path, "j",
+                      aggregate=json.dumps([{"op": "count"}]))
+    with pytest.raises(ManifestError, match="aggregate"):
+        run_job(other, parser=p)
+
+
+def test_merged_job_aggregate_refuses_row_jobs(tmp_path):
+    from logparser_tpu.jobs import merged_job_aggregate, run_job
+
+    _, corpus_path = _job_corpus(tmp_path, n=64)
+    row_spec = _job_spec(tmp_path, corpus_path, "rows", aggregate=None)
+    run_job(row_spec, parser=parser())
+    with pytest.raises(ValueError):
+        merged_job_aggregate(str(tmp_path / "rows"))
+
+
+# ---------------------------------------------------------------------------
+# service composition (slow: spins a live TCP service)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_service_aggregate_session():
+    from logparser_tpu.service import (
+        ParseService, ParseServiceClient, ParseServiceError,
+    )
+
+    p, sp = parser(), spec()
+    lines = corpus(n=200)
+    with ParseService() as svc:
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS, aggregate=OPS
+        ) as client:
+            state = client.parse(lines)
+            assert isinstance(state, AggregateState)
+            assert state == referee(p, lines, sp)
+            # a second request on the SAME session starts fresh
+            assert client.parse(lines[:50]) == referee(
+                p, lines[:50], sp)
+        # a row session on the same server still gets row frames
+        with ParseServiceClient(
+            svc.host, svc.port, "combined", FIELDS[:1]
+        ) as client:
+            table = client.parse(lines[:10])
+            assert table.num_rows == 10
+        # bad spec relays through the error loop
+        with pytest.raises(ParseServiceError, match="bad config"):
+            ParseServiceClient(
+                svc.host, svc.port, "combined", FIELDS,
+                aggregate=[{"op": "sum",
+                            "field": "STRING:request.status.last"}],
+            ).parse(["x"])
