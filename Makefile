@@ -9,7 +9,7 @@ PY ?= python
 SMOKE_TIMEOUT ?= 600
 SMOKE = timeout -k 10 $(SMOKE_TIMEOUT)
 
-.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke pod-smoke device-smoke agg-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke pod-smoke device-smoke agg-smoke trace-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -137,6 +137,16 @@ device-smoke:
 # device-smoke.
 agg-smoke:
 	$(SMOKE) $(PY) -m logparser_tpu.tools.agg_smoke
+
+# Distributed-tracing + flight-recorder drill (docs/OBSERVABILITY.md
+# "Tracing"): a real two-session front fleet must produce ONE connected
+# trace — two front_session roots, their service_request spans linked
+# into a single shared coalesce_batch span with pipeline-stage children
+# — and a SIGUSR2 flight dump from a live sidecar must name the
+# injected device fault it silently absorbed during warmup.  CI runs
+# this after agg-smoke.
+trace-smoke:
+	$(SMOKE) $(PY) -m logparser_tpu.tools.trace_smoke
 
 lint:
 	$(PY) -m ruff check logparser_tpu tests

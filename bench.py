@@ -225,6 +225,22 @@ DEVICE_WEDGE_SECONDS = 1.2
 ANALYTICS_SPEEDUP_FLOOR = 1.5
 ANALYTICS_D2H_RATIO_FLOOR = 10.0
 ANALYTICS_AB_PASSES = 5
+# Tracing-overhead drill (round 20, docs/OBSERVABILITY.md "Tracing"):
+# the SAME warmed parse timed three ways — tracing disabled (the
+# default: head sampling off, every span factory returns None), the
+# per-request plumbing live but UNSAMPLED (context checks on the
+# request path, still no spans), and fully SAMPLED (root span + batch
+# scope, pipeline-stage spans recording into the buffer).  Paired
+# alternating windows with the median of per-round ratios: both sides
+# of each ratio are measured back to back on THIS host, so scheduler
+# drift cancels instead of gating.  Hard in-run gates: sampled <= 5%
+# over base, disabled <= 1% — observability must never become the
+# regression it exists to catch.
+TRACING_BATCH = 8192
+TRACING_WINDOW_PARSES = 6
+TRACING_ROUNDS = 7
+TRACING_DISABLED_GATE = 1.01
+TRACING_SAMPLED_GATE = 1.05
 
 GEO_TEST_DATA = "/root/reference/GeoIP2-TestData/test-data"
 if not os.path.isdir(GEO_TEST_DATA):
@@ -1201,6 +1217,74 @@ def dashboard_spec(parser):
          "edges": [1000, 100000, 10000000]},
         {"op": "time_bucket", "field": ts, "width_s": 3600},
     ])
+
+
+def bench_tracing(parser, lines):
+    """The tracing-overhead A/B drill (round 20, docs/OBSERVABILITY.md
+    "Tracing"): see the TRACING_* constants' rationale.  Three legs per
+    round on ONE warmed shape bucket — base (sampling off, no span
+    calls: the shipped default), disabled (the request path's
+    context-plumbing calls with sampling off: every factory returns
+    None), sampled (rate 1.0, a root span + batch scope around each
+    parse so the stage sink records pipeline-stage spans).  Returns the
+    per-round ratio medians the gates consume."""
+    from logparser_tpu import tracing
+
+    corpus = lines[:TRACING_BATCH]
+    parser.parse_batch(corpus)  # warm this shape bucket outside windows
+
+    def window(mode):
+        t0 = time.perf_counter()
+        for _ in range(TRACING_WINDOW_PARSES):
+            if mode == "base":
+                parser.parse_batch(corpus)
+            elif mode == "disabled":
+                # The per-request cost when sampling is off: one head
+                # coin (rate 0 -> None) + the None-parent span factory
+                # the service request path runs — exactly what every
+                # unsampled session pays.
+                ctx = tracing.head_context()
+                span = tracing.child_span("service_request", ctx)
+                parser.parse_batch(corpus)
+                if span is not None:
+                    span.end()
+            else:
+                root = tracing.root_span("bench_session")
+                batch_span = tracing.child_span(
+                    "coalesce_batch", root.context)
+                with tracing.batch_scope(batch_span):
+                    parser.parse_batch(corpus)
+                batch_span.end()
+                root.end()
+        return time.perf_counter() - t0
+
+    base_windows, disabled_ratios, sampled_ratios = [], [], []
+    try:
+        for _ in range(TRACING_ROUNDS):
+            tracing.set_sample_rate(0.0)
+            base = window("base")
+            disabled = window("disabled")
+            tracing.set_sample_rate(1.0)
+            sampled = window("sampled")
+            base_windows.append(base)
+            disabled_ratios.append(disabled / base)
+            sampled_ratios.append(sampled / base)
+    finally:
+        tracing.reset_for_tests()
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    return {
+        "batch_lines": len(corpus),
+        "window_parses": TRACING_WINDOW_PARSES,
+        "rounds": TRACING_ROUNDS,
+        "base_window_s": round(med(base_windows), 4),
+        "disabled_over_base": round(med(disabled_ratios), 4),
+        "sampled_over_base": round(med(sampled_ratios), 4),
+        "disabled_ratio_rounds": [round(r, 4) for r in disabled_ratios],
+        "sampled_ratio_rounds": [round(r, 4) for r in sampled_ratios],
+    }
 
 
 def bench_analytics(parser, lines, config_states):
@@ -2399,6 +2483,14 @@ def main():
     except Exception as e:  # noqa: BLE001 — the drill must not kill the run
         device_faults_section = {"error": f"{type(e).__name__}: {e}"}
 
+    # ---- tracing: the observability-overhead A/B drill (round 20) -------
+    # Clean-phase (paired wall-clock windows on the warmed headline
+    # parser; no fleet processes, no tensorflow).
+    try:
+        tracing_section = bench_tracing(parser, lines)
+    except Exception as e:  # noqa: BLE001 — the drill must not kill the run
+        tracing_section = {"error": f"{type(e).__name__}: {e}"}
+
     # ---- all five BASELINE configs: host-side phase ---------------------
     # Strict two-phase order: every HOST measurement (oracle, Arrow) for
     # every config BEFORE the first kernel_rate call — the xplane parse
@@ -2993,6 +3085,27 @@ def main():
                 f"delivery (below the {ANALYTICS_SPEEDUP_FLOOR}x floor)"
             )
 
+    # (h) Tracing gate (round 20, docs/OBSERVABILITY.md "Tracing"):
+    #     paired in-run ratios, hard everywhere — sampled tracing must
+    #     cost <= 5% over the untraced parse and the disabled plumbing
+    #     <= 1% (the default config must be observably free).
+    if "error" in tracing_section:
+        gate_failures.append(f"tracing: {tracing_section['error']}")
+    else:
+        disabled_ratio = tracing_section.get("disabled_over_base", 99.0)
+        if disabled_ratio > TRACING_DISABLED_GATE:
+            gate_failures.append(
+                f"tracing: disabled-path overhead {disabled_ratio:.4f}x "
+                f"base (above {TRACING_DISABLED_GATE}x — the off switch "
+                "must be free)"
+            )
+        sampled_ratio = tracing_section.get("sampled_over_base", 99.0)
+        if sampled_ratio > TRACING_SAMPLED_GATE:
+            gate_failures.append(
+                f"tracing: sampled overhead {sampled_ratio:.4f}x base "
+                f"(above {TRACING_SAMPLED_GATE}x)"
+            )
+
     # Recorded-floor resolution (see floor_gates above): hard gates only
     # on the hardware that recorded the baselines; informational
     # cross-hardware deltas otherwise.
@@ -3105,6 +3218,10 @@ def main():
         # delivery, D2H shrinkage, and the device-vs-referee parity
         # sweep over every config (docs/ANALYTICS.md).
         "analytics": analytics_section,
+        # The tracing-overhead drill: sampled / disabled parse-wall
+        # ratios vs the untraced base, paired windows
+        # (docs/OBSERVABILITY.md "Tracing").
+        "tracing": tracing_section,
         # This round's hardware + the recorded-floor baseline's: floor
         # comparisons hard-gate only on matching hardware; otherwise
         # they land in cross_hardware_deltas (informational, per the
@@ -3303,6 +3420,14 @@ def main():
                         for p in analytics_section["parity"].values()
                     )
                 ),
+            }
+        ),
+        # Tracing drill (round 20): the compact proof observability is
+        # free when off and cheap when on — the two gated ratios.
+        "tracing": (
+            {"error": True} if "error" in tracing_section else {
+                "sampled": tracing_section["sampled_over_base"],
+                "disabled": tracing_section["disabled_over_base"],
             }
         ),
         # Rescue composition (round 9): the gated measured effective rate,

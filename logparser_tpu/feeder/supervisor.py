@@ -157,18 +157,24 @@ class FeederSupervisor:
             if nxt is None:
                 # Bottom of the ladder and still dying: route around the
                 # data instead of the worker.
-                return Decision("quarantine", transport)
+                return self._record(
+                    Decision("quarantine", transport),
+                    worker=worker, shard=shard_index)
             demoted_from, transport = transport, nxt
             self._note_demotion(worker, nxt)
         if kills >= self.policy.poison_threshold:
-            return Decision("quarantine", transport,
-                            demoted_from=demoted_from)
+            return self._record(
+                Decision("quarantine", transport,
+                         demoted_from=demoted_from),
+                worker=worker, shard=shard_index)
         backoff = min(
             self.policy.backoff_max_s,
             self.policy.backoff_base_s
             * (2 ** (self._rung_restarts[worker] - 1)),
         )
-        return Decision("respawn", transport, backoff, demoted_from)
+        return self._record(
+            Decision("respawn", transport, backoff, demoted_from),
+            worker=worker, shard=shard_index)
 
     # -- ring-lane faults ------------------------------------------------
 
@@ -197,7 +203,21 @@ class FeederSupervisor:
         current = self.transport_of[worker]
         nxt = demote_transport(current, self.mode) or "inline"
         self._note_demotion(worker, nxt)
-        return Decision("respawn", nxt, demoted_from=current)
+        return self._record(
+            Decision("respawn", nxt, demoted_from=current), worker=worker)
+
+    @staticmethod
+    def _record(decision: Decision, **fields: object) -> Decision:
+        """Every supervisory decision is a flight-recorder event: the
+        recovery itself is silent by design (byte-identical output), so
+        the ring is the only per-incident record that survives a later
+        crash (docs/OBSERVABILITY.md "Flight recorder")."""
+        from ..tracing import flight_event
+
+        flight_event("feeder_decision", action=decision.action,
+                     transport=decision.transport,
+                     demoted_from=decision.demoted_from, **fields)
+        return decision
 
     def _note_demotion(self, worker: int, new_transport: str) -> None:
         self.demotions.append(

@@ -97,6 +97,12 @@ from .service import (
     write_error,
     write_frame,
 )
+from .tracing import (
+    flight_event,
+    flightz_payload,
+    root_span,
+    tracez_payload,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -1175,8 +1181,12 @@ class FrontTier:
         if decision.circuit_opened:
             metrics().increment("front_circuit_open_total",
                                 labels={"sidecar": slot.name})
+            flight_event("front_circuit_open", sidecar=slot.name)
             LOG.warning("front: circuit OPEN around flapping sidecar %s",
                         slot.name)
+        flight_event("front_sidecar_fault", sidecar=slot.name, fault=kind,
+                     action=decision.action,
+                     backoff_s=round(decision.backoff_s, 3))
         LOG.warning("front: sidecar %s fault (%s) -> %s (backoff %.2fs)",
                     slot.name, kind, decision.action, decision.backoff_s)
         if decision.action == "disable":
@@ -1296,6 +1306,7 @@ class FrontTier:
     def _shed(self, sock: socket.socket, reason: str,
               tenant: Optional[str] = None) -> None:
         metrics().increment("front_shed_total", labels={"reason": reason})
+        flight_event("front_shed", reason=reason, tenant=tenant)
         if tenant is not None:
             metrics().increment("front_tenant_shed_total",
                                 labels={"tenant": self._tenant_label(
@@ -1316,6 +1327,7 @@ class FrontTier:
         metrics().increment("front_failovers_total")
         metrics().increment("front_shed_total",
                             labels={"reason": "sidecar_failover"})
+        flight_event("front_failover", sidecar=slot.name, fault=kind)
         LOG.warning("front: session failover off sidecar %s (%s)",
                     slot.name, kind)
         try:
@@ -1354,6 +1366,7 @@ class FrontTier:
             return
         tenant = "default"
         send_stats = False
+        config: Any = None
         parser_key: Any = ("raw", hashlib.blake2b(
             config_raw, digest_size=8).hexdigest())
         try:
@@ -1366,20 +1379,41 @@ class FrontTier:
             pass           # sidecar answers the structured config error
         klabel = key_label(parser_key)
 
+        # Root session span (docs/OBSERVABILITY.md "Tracing"): a sampled
+        # session gets the front's root context injected into the
+        # relayed CONFIG — the ONLY case the config is re-serialized.
+        # Unsampled sessions forward the client's RAW bytes untouched,
+        # so an untraced session stays byte-identical on the wire
+        # (golden protocol vectors replay unchanged).
+        span = None
+        if isinstance(config, dict):
+            span = root_span("front_session",
+                             traceparent=config.get("traceparent"),
+                             attrs={"tenant": tenant, "key": klabel})
+            if span is not None:
+                config["traceparent"] = span.traceparent
+                config_raw = json.dumps(config).encode("utf-8")
+
         # Tenant fairness + fleet backpressure at the front door.
         if not self._tenants.session_enter(tenant):
+            if span is not None:
+                span.end(outcome="shed", reason="tenant_quota")
             self._shed(sock, "tenant_quota", tenant=tenant)
             return
         try:
             from .feeder import queue_backpressure
 
             if queue_backpressure() >= pol.backpressure_threshold:
+                if span is not None:
+                    span.end(outcome="shed", reason="backpressure")
                 self._shed(sock, "backpressure")
                 return
             self._proxy_routed(sock, config_raw, klabel, tenant,
                                send_stats)
         finally:
             self._tenants.session_exit(tenant)
+            if span is not None:
+                span.end()
 
     def _connect_upstream(self, sock: socket.socket, klabel: str,
                           config_raw: bytes
@@ -1566,9 +1600,11 @@ class FrontTier:
 class _FrontHttpHandler(BaseHTTPRequestHandler):
     """GET /metrics -> the MERGED fleet exposition (front families +
     every live sidecar's scrape under a ``sidecar`` label); GET
-    /healthz -> front liveness; GET /readyz -> 200 while >= 1 sidecar
-    is ready (503 otherwise / while draining); POST /rollz -> trigger a
-    background rolling restart (the loadgen ``--roll`` hook)."""
+    /tracez, /flightz -> the front's spans / flight events plus every
+    live sidecar's, keyed by slot name; GET /healthz -> front liveness;
+    GET /readyz -> 200 while >= 1 sidecar is ready (503 otherwise /
+    while draining); POST /rollz -> trigger a background rolling
+    restart (the loadgen ``--roll`` hook)."""
 
     server: ThreadingHTTPServer
 
@@ -1591,6 +1627,25 @@ class _FrontHttpHandler(BaseHTTPRequestHandler):
             ).encode("utf-8")
             self._respond(200, body,
                           "text/plain; version=0.0.4; charset=utf-8")
+            return
+        if path in ("/tracez", "/flightz"):
+            # The fleet's trace/flight view in one scrape: the front's
+            # own payload plus each live sidecar's, keyed by slot name
+            # (a dead sidecar reports its scrape error instead).
+            own = tracez_payload() if path == "/tracez" \
+                else flightz_payload()
+            sidecars: Dict[str, Any] = {}
+            for name, host, _port, mport in front.sidecars():
+                if mport is None:
+                    continue
+                try:
+                    sidecars[name] = json.loads(
+                        _scrape(f"http://{host}:{mport}{path}"))
+                except Exception as e:  # noqa: BLE001 — dead sidecar
+                    sidecars[name] = {"error": str(e)}
+            body = json.dumps({"front": own, "sidecars": sidecars},
+                              sort_keys=True).encode("utf-8")
+            self._respond(200, body, "application/json")
             return
         if path in ("/healthz", "/readyz"):
             ready = [s.name for s in front._slots if s.ready]
@@ -1713,7 +1768,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   lambda *_: threading.Thread(target=front.roll,
                                               daemon=True).start())
     stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+
+    def _on_sigterm(*_: Any) -> None:
+        # Crash-safe postmortem before the shutdown proceeds
+        # (docs/OBSERVABILITY.md "Flight recorder").
+        from .tracing import dump_flight
+
+        flight_event("sigterm_shutdown")
+        dump_flight("sigterm")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    from .tracing import arm_flight_signals, install_flight_excepthook
+
+    arm_flight_signals()
+    install_flight_excepthook()
     front.start()
     try:
         while not stop.wait(0.5):

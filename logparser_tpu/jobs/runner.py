@@ -361,8 +361,24 @@ def run_job(
                        backoff_base_s=policy.io_backoff_s,
                        chaos=writer_chaos)
     reg.increment("job_runs_total")
+    # One durable job = one connected trace (docs/OBSERVABILITY.md
+    # "Tracing"): a pod launcher hands its context down via the
+    # LOGPARSER_TPU_TRACEPARENT env; a standalone job head-samples
+    # under LOGPARSER_TPU_TRACE_SAMPLE.  Feeder shards and shard
+    # commits become child spans below.
+    from ..tracing import child_span, root_span
+
+    job_span = root_span(
+        "job_run",
+        traceparent=os.environ.get("LOGPARSER_TPU_TRACEPARENT"),
+        attrs={"host_index": spec.host_index, "n_hosts": spec.n_hosts,
+               "shards": len(owned)},
+    )
+    job_ctx = job_span.context if job_span is not None else None
     if not remaining:
         report.wall_s = time.perf_counter() - t_start
+        if job_span is not None:
+            job_span.end(committed=0, skipped=report.skipped)
         return report
 
     own_parser = parser is None
@@ -424,9 +440,21 @@ def run_job(
     )
 
     meta: deque = deque()
+    # One feeder_shard span per shard the fabric feeds: opened when the
+    # shard's first batch arrives, closed when the next shard starts
+    # (trailing span closed in the finally below).
+    feed_state: Dict[str, Any] = {"shard": None, "span": None}
 
     def _tap(batches):
         for eb in batches:
+            if job_ctx is not None and eb.shard != feed_state["shard"]:
+                if feed_state["span"] is not None:
+                    feed_state["span"].end()
+                feed_state["shard"] = eb.shard
+                feed_state["span"] = child_span(
+                    "feeder_shard", job_ctx,
+                    attrs={"shard": remaining[eb.shard].index},
+                )
             meta.append((eb.shard, eb.index, eb.n_lines, eb.source_bytes))
             yield eb
 
@@ -434,6 +462,8 @@ def run_job(
         import pyarrow as pa
 
         shard = remaining[pool_idx]
+        c_span = child_span("job_shard_commit", job_ctx,
+                            attrs={"shard": shard.index})
         data_table = (
             pa.concat_tables(acc.tables) if acc.tables else None
         )
@@ -441,6 +471,8 @@ def run_job(
             report.failed.append({"shard": shard.index, "error": str(e)})
             reg.increment("job_shards_failed_total",
                           labels={"reason": "write_io"})
+            if c_span is not None:
+                c_span.end(outcome="failed")
             LOG.error("job: shard %d failed durably: %s", shard.index, e)
 
         agg_state = None
@@ -482,6 +514,9 @@ def run_job(
         report.rejects += record.rejects
         report.payload_bytes += acc.payload_bytes
         reg.increment("job_shards_committed_total")
+        if c_span is not None:
+            c_span.end(outcome="committed", rows=record.rows,
+                       lines=acc.lines)
         # Reject accounting lands at COMMIT time: the counter equals
         # lines durably present in reject tables, exactly — a failed
         # shard's rejects never count, a replayed shard's count once.
@@ -577,6 +612,12 @@ def run_job(
             except Exception as e:  # noqa: BLE001 — teardown best-effort
                 log_warning_once(LOG, f"job: parser close failed: {e}")
         report.wall_s = time.perf_counter() - t_start
+        if feed_state["span"] is not None:
+            feed_state["span"].end()
+        if job_span is not None:
+            job_span.end(committed=report.committed,
+                         skipped=report.skipped,
+                         preempted=report.preempted)
     return report
 
 

@@ -51,7 +51,7 @@ import re
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 LOG = logging.getLogger(__name__)
 
@@ -481,6 +481,10 @@ class MetricsRegistry:
         names gain a ``_total`` suffix when missing (exposition
         convention); all names are sanitized into the metric-name
         grammar."""
+        # Every exposition identifies its producer's build: value-1 info
+        # gauge, refreshed per render so it survives reset() and a fleet
+        # merge shows each sidecar's version/jax in one scrape.
+        self.gauge_set("build_info", 1.0, labels=build_info())
         with self._lock:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
@@ -595,6 +599,21 @@ def stage_annotations_enabled() -> bool:
     return _ANNOTATE["enabled"]
 
 
+# Injected by logparser_tpu/tracing.py ONLY while a sampled batch scope
+# is active (tracing.batch_scope): turns completed stages into child
+# spans of the live shared-batch span.  A plain module-global read keeps
+# the disabled hot path at one load+compare — and observability never
+# imports tracing (the dependency points one way).
+_STAGE_SPAN_SINK: Optional[Callable[[str, float, int], None]] = None
+
+
+def set_stage_span_sink(
+    sink: Optional[Callable[[str, float, int], None]],
+) -> None:
+    global _STAGE_SPAN_SINK
+    _STAGE_SPAN_SINK = sink
+
+
 def observe_stage(name: str, seconds: float, items: int = 0) -> None:
     """Record one completed stage span: always into the metrics registry
     (stage_seconds histogram + stage_items_total counter), and into the
@@ -606,6 +625,9 @@ def observe_stage(name: str, seconds: float, items: int = 0) -> None:
         )
     if _GLOBAL_TRACER.enabled:
         _GLOBAL_TRACER._record(name, seconds, items)
+    sink = _STAGE_SPAN_SINK
+    if sink is not None:
+        sink(name, seconds, items)
 
 
 @contextlib.contextmanager
@@ -749,6 +771,22 @@ def reset_warning_once(message: Optional[str] = None) -> None:
 # ---------------------------------------------------------------------------
 
 _BANNER_LOGGED = False
+
+
+def build_info() -> Dict[str, str]:
+    """The banner's raw facts as exposition labels: package version and
+    the jax version IF some other component already imported it (same
+    no-TPU-acquisition discipline as :func:`version_banner`)."""
+    import sys
+
+    from . import __version__
+
+    jax_mod = sys.modules.get("jax")
+    return {
+        "version": str(__version__),
+        "jax": str(getattr(jax_mod, "__version__", "unimported"))
+        if jax_mod is not None else "unimported",
+    }
 
 
 def version_banner() -> str:

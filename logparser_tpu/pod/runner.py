@@ -213,11 +213,16 @@ def host_argv(spec: PodSpec, host_index: int,
     return argv
 
 
-def _launch_host(spec: PodSpec, host_index: int,
-                 policy: PodPolicy) -> subprocess.Popen:
+def _launch_host(spec: PodSpec, host_index: int, policy: PodPolicy,
+                 traceparent: Optional[str] = None) -> subprocess.Popen:
     env = dict(os.environ)
     if spec.host_env:
         env.update(spec.host_env)
+    if traceparent:
+        # The host's job_run root span parents under this pod's trace;
+        # the env var is the cross-process carrier (docs/OBSERVABILITY.md
+        # "Tracing").
+        env["LOGPARSER_TPU_TRACEPARENT"] = traceparent
     return subprocess.Popen(
         host_argv(spec, host_index, policy),
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
@@ -303,6 +308,14 @@ def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
     t0 = time.perf_counter()
     reg = metrics()
     reg.increment("pod_runs_total")
+    from ..tracing import child_span, root_span
+
+    pod_span = root_span(
+        "pod_run",
+        traceparent=os.environ.get("LOGPARSER_TPU_TRACEPARENT"),
+        attrs={"hosts": spec.n_hosts},
+    )
+    pod_ctx = pod_span.context if pod_span is not None else None
     plan = plan_shards(normalize_sources(spec.sources), spec.shard_bytes)
     report = PodReport(out_dir=spec.out_dir, n_hosts=spec.n_hosts,
                        shards_total=len(plan))
@@ -311,6 +324,8 @@ def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
 
     if policy.inline:
         for i in range(spec.n_hosts):
+            h_span = child_span("pod_host_launch", pod_ctx,
+                                attrs={"host": i, "inline": True})
             hr = _run_host_inline(spec, i, policy, parser)
             # Each failed LAUNCH counts once; a config refusal (rc 2)
             # never retries — resuming it would refuse identically.
@@ -322,6 +337,9 @@ def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
                 hr = retry
             if not hr.ok:
                 reg.increment("pod_host_failures_total")
+            if h_span is not None:
+                h_span.end(returncode=hr.returncode,
+                           launches=hr.launches)
             results[i] = hr
     else:
         if parser is not None:
@@ -330,10 +348,18 @@ def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
         attempt = 0
         while pending and attempt <= policy.host_retries:
             procs = {}
+            host_spans = {}
             for i in pending:
                 results[i].launches += 1
                 reg.increment("pod_hosts_launched_total")
-                procs[i] = _launch_host(spec, i, policy)
+                h_span = child_span(
+                    "pod_host_launch", pod_ctx,
+                    attrs={"host": i, "attempt": attempt})
+                host_spans[i] = h_span
+                procs[i] = _launch_host(
+                    spec, i, policy,
+                    traceparent=(h_span.traceparent
+                                 if h_span is not None else None))
                 after = preempt_plan.pop(i, None)
                 if after is not None:
                     threading.Thread(
@@ -356,6 +382,8 @@ def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
                     )
                 results[i].returncode = p.returncode
                 results[i].report = _host_report_from_stdout(out)
+                if host_spans.get(i) is not None:
+                    host_spans[i].end(returncode=p.returncode)
                 reg.gauge_set(
                     "pod_hosts_alive",
                     sum(1 for q in procs.values() if q.poll() is None),
@@ -410,4 +438,7 @@ def run_pod(spec: PodSpec, policy: Optional[PodPolicy] = None,
             report.merge_error = str(e)
             reg.increment("pod_merge_refusals_total")
     report.wall_s = time.perf_counter() - t0
+    if pod_span is not None:
+        pod_span.end(merged_shards=report.merged_shards,
+                     wall_s=round(report.wall_s, 3))
     return report

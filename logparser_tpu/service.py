@@ -112,6 +112,13 @@ from .observability import (
     note_teardown,
     suppressed_warning_counts,
 )
+from .tracing import (
+    child_span,
+    flight_event,
+    flightz_payload,
+    parse_traceparent,
+    tracez_payload,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -797,6 +804,15 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 from .analytics.spec import parse_aggregate_config
 
                 agg_spec = parse_aggregate_config(config["aggregate"])
+            # Distributed tracing context (PROTOCOL.md "traceparent"):
+            # wire-invisible when absent (byte-identical v1 session);
+            # a malformed value is silently DROPPED, never a config
+            # error — the W3C contract is that bad trace plumbing must
+            # not break the request.  Session behavior only — results
+            # are identical either way, so not part of the cache key.
+            trace_ctx = None
+            if isinstance(config, dict) and config.get("traceparent"):
+                trace_ctx = parse_traceparent(config.get("traceparent"))
             parser = self.server.parser_cache.get(config)
             if agg_spec is not None:
                 agg_spec.validate_for(parser)
@@ -812,7 +828,8 @@ class _SessionHandler(socketserver.BaseRequestHandler):
         state = {"feeder_workers": feeder_workers,
                  "parser_key": parser_key,
                  "coalesce_wait_s": coalesce_wait_s,
-                 "aggregate": agg_spec}
+                 "aggregate": agg_spec,
+                 "trace_ctx": trace_ctx}
         # Per-key session registry: the coalescer skips its straggler
         # window when this session is the key's only one.
         self.server.key_session_enter(parser_key)
@@ -854,6 +871,13 @@ class _SessionHandler(socketserver.BaseRequestHandler):
         / error).  Returns False only when the socket died."""
         reg = metrics()
         lim = self.server.limits
+        # Request span (docs/OBSERVABILITY.md "Tracing"): opened only
+        # for sampled sessions; its context rides state["request_ctx"]
+        # into the coalescer so the shared-batch span links back here.
+        req_span = child_span("service_request", state.get("trace_ctx"),
+                              attrs={"sid": self.sid})
+        if req_span is not None:
+            state["request_ctx"] = req_span.context
         # Every response write in this method (BUSY/DEADLINE/error/ARROW/
         # STATS) runs under the idle window, not the leftover read window.
         self._pre_write()
@@ -861,6 +885,9 @@ class _SessionHandler(socketserver.BaseRequestHandler):
         if shed_reason is not None:
             reg.increment("service_shed_total",
                           labels={"reason": shed_reason})
+            flight_event("service_shed", reason=shed_reason, sid=self.sid)
+            if req_span is not None:
+                req_span.end(outcome="shed", reason=shed_reason)
             LOG.info("sess=%d request shed (%s)", self.sid, shed_reason)
             try:
                 write_error(sock, busy_error_text(
@@ -879,6 +906,10 @@ class _SessionHandler(socketserver.BaseRequestHandler):
             # stuck parse keeps its slot, which IS the backpressure);
             # the session answers and moves on.
             reg.increment("service_deadline_expired_total")
+            flight_event("service_deadline_expired", sid=self.sid,
+                         deadline_s=lim.request_deadline_s or 0.0)
+            if req_span is not None:
+                req_span.end(outcome="deadline")
             LOG.warning("sess=%d request deadline (%.3fs) expired",
                         self.sid, lim.request_deadline_s or 0.0)
             try:
@@ -899,6 +930,10 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 # opaque parse error (docs/SERVICE.md).
                 reg.increment("service_shed_total",
                               labels={"reason": "coalesce_queue"})
+                flight_event("service_shed", reason="coalesce_queue",
+                             sid=self.sid)
+                if req_span is not None:
+                    req_span.end(outcome="shed", reason="coalesce_queue")
                 LOG.info("sess=%d request shed (coalesce_queue)", self.sid)
                 try:
                     write_error(sock, busy_error_text(
@@ -911,6 +946,12 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 # the same structured DEADLINE answer an expired solo
                 # parse gets, and the session survives.
                 reg.increment("service_deadline_expired_total")
+                flight_event("service_deadline_expired", sid=self.sid,
+                             where="coalesce_queue",
+                             deadline_s=lim.request_deadline_s or 0.0)
+                if req_span is not None:
+                    req_span.end(outcome="deadline",
+                                 where="coalesce_queue")
                 LOG.warning(
                     "sess=%d request deadline (%.3fs) expired in the "
                     "coalesce queue", self.sid,
@@ -932,6 +973,8 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 # client should split its payload).
                 reg.increment("service_rejected_frames_total",
                               labels={"reason": "device_budget"})
+                if req_span is not None:
+                    req_span.end(outcome="rejected", reason="device_budget")
                 LOG.warning("sess=%d request rejected (device_budget): "
                             "%s", self.sid, outcome)
                 try:
@@ -941,6 +984,9 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                 return True
             LOG.error("sess=%d parse failed", self.sid, exc_info=outcome)
             reg.increment("service_request_errors_total")
+            if req_span is not None:
+                req_span.end(outcome="error",
+                             error=f"{type(outcome).__name__}: {outcome}")
             try:
                 write_error(sock, f"parse failed: {outcome}")
             except OSError:
@@ -956,6 +1002,8 @@ class _SessionHandler(socketserver.BaseRequestHandler):
         reg.increment("service_requests_total")
         reg.increment("service_lines_total", count)
         reg.observe("service_request_seconds", dt)
+        if req_span is not None:
+            req_span.end(outcome="ok", lines=count)
         if send_stats:
             # STATS frame: per-request figures + the SAME
             # process-cumulative stage breakdown /metrics and
@@ -1127,6 +1175,7 @@ class _SessionHandler(socketserver.BaseRequestHandler):
                     state["parser_key"], parser, bytes(blob), count,
                     deadline_s=self.server.limits.request_deadline_s,
                     max_wait_s=state.get("coalesce_wait_s"),
+                    trace_ctx=state.get("request_ctx"),
                 )
             elif blob_shape:
                 # (an empty blob is one empty LINE per the
@@ -1199,6 +1248,8 @@ def _feeder_parse(parser, blob: bytes, count: int, workers: int):
 
 class _MetricsHandler(BaseHTTPRequestHandler):
     """GET /metrics -> Prometheus text exposition of the process registry;
+    GET /tracez -> recent completed trace spans (JSON);
+    GET /flightz -> the crash-safe flight recorder's event ring (JSON);
     GET /healthz -> liveness (200 while the process serves HTTP at all);
     GET /readyz -> readiness (200 ready, 503 once draining — the flip
     orchestrators key traffic removal on, docs/SERVICE.md)."""
@@ -1209,6 +1260,19 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             body = metrics().prometheus_text().encode("utf-8")
             self._respond(200, body,
                           "text/plain; version=0.0.4; charset=utf-8")
+            return
+        if path == "/tracez":
+            # Recent completed spans (docs/OBSERVABILITY.md "Tracing").
+            body = json.dumps(tracez_payload(),
+                              sort_keys=True).encode("utf-8")
+            self._respond(200, body, "application/json")
+            return
+        if path == "/flightz":
+            # The flight recorder's live ring (docs/OBSERVABILITY.md
+            # "Flight recorder") — same payload a crash dump writes.
+            body = json.dumps(flightz_payload(),
+                              sort_keys=True).encode("utf-8")
+            self._respond(200, body, "application/json")
             return
         if path in ("/healthz", "/readyz"):
             state_fn = getattr(self.server, "state_fn", None)
@@ -1678,6 +1742,7 @@ class ParseServiceClient:
         timeout: Optional[float] = None,
         tenant: Optional[str] = None,
         aggregate: Optional[Any] = None,
+        traceparent: Optional[str] = None,
     ):
         self._addr = (host, port)
         self._stats = bool(stats)
@@ -1709,6 +1774,11 @@ class ParseServiceClient:
             # Only stats sessions carry the key: a v1 server ignores it,
             # but omitting it keeps this client byte-exact v1 by default.
             config["stats"] = True
+        if traceparent:
+            # Distributed tracing head (PROTOCOL.md "traceparent"): the
+            # session's requests join this trace.  A v1 server ignores
+            # it; omitted, the CONFIG stays byte-exact v1.
+            config["traceparent"] = str(traceparent)
         self._agg_spec = None
         if aggregate is not None:
             # Analytics pushdown (PROTOCOL.md "aggregate"): the session's
@@ -1976,6 +2046,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
 
     def _on_sigterm(signum, frame):  # noqa: ARG001 — signal contract
+        # Flight dump FIRST: the drain may be escalated/killed, and the
+        # last 60 s of silently-absorbed trouble must survive the
+        # process (docs/OBSERVABILITY.md "Flight recorder").
+        from .tracing import dump_flight
+
+        flight_event("sigterm_drain",
+                     drain_deadline_s=args.drain_deadline)
+        dump_flight("sigterm")
         LOG.info("SIGTERM: draining (deadline %.1fs)", args.drain_deadline)
         threading.Thread(
             target=lambda: svc.shutdown(drain=True),
@@ -1983,6 +2061,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ).start()
 
     signal.signal(signal.SIGTERM, _on_sigterm)
+    # SIGUSR2 -> non-fatal flight dump; fatal faults dump via excepthook.
+    from .tracing import arm_flight_signals, install_flight_excepthook
+
+    arm_flight_signals()
+    install_flight_excepthook()
     LOG.info("parse service listening on %s:%d", svc.host, svc.port)
     if args.sidecar:
         # The adoption handshake (docs/SERVICE.md "Fleet"): exactly one

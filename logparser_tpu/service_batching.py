@@ -129,17 +129,24 @@ class _Entry:
     shutdown); CLAIMED entries always get ``result`` or ``error``."""
 
     __slots__ = ("blob", "count", "enq_t", "deadline_t", "max_wait_t",
-                 "event", "state", "result", "error", "abandoned")
+                 "event", "state", "result", "error", "abandoned",
+                 "trace_ctx")
 
     PENDING, CLAIMED, CANCELLED = range(3)
 
     def __init__(self, blob: bytes, count: int,
                  deadline_t: Optional[float],
-                 max_wait_t: Optional[float] = None):
+                 max_wait_t: Optional[float] = None,
+                 trace_ctx: Any = None):
         self.blob = blob
         self.count = count
         self.enq_t = time.monotonic()
         self.deadline_t = deadline_t
+        # The submitting request's TraceContext (or None): the formed
+        # batch's span LINKS to every member's context — the fan-in IS
+        # the signal (N sessions provably shared one device batch).
+        # Never part of batching/parsing decisions.
+        self.trace_ctx = trace_ctx
         # Client batching hint (PROTOCOL.md "coalesce_wait_ms"): the
         # absolute time by which a forming batch holding this entry must
         # stop waiting for stragglers — a latency-critical session caps
@@ -162,11 +169,15 @@ class _FormedBatch:
     Row offsets are the running line counts — entry k's result is rows
     ``[offset_k, offset_k + count_k)`` of the combined parse."""
 
-    __slots__ = ("entries", "total")
+    __slots__ = ("entries", "total", "span")
 
     def __init__(self, entries: List[_Entry]):
         self.entries = entries
         self.total = sum(e.count for e in entries)
+        # Live coalesce_batch trace span (or None): opened at formation,
+        # closed after scatter — the stream path's lifetime crosses the
+        # generator frame, so it rides the batch, not a with-block.
+        self.span = None
 
     def blob(self) -> bytes:
         return b"\n".join(e.blob for e in self.entries)
@@ -187,6 +198,41 @@ class _FormedBatch:
             shard=0, index=0, payload=blob, buf=buf, lengths=lengths,
             overflow=list(overflow), n_lines=self.total,
         )
+
+
+def _begin_batch_span(fb: _FormedBatch) -> Any:
+    """Open the ONE shared-batch span (docs/OBSERVABILITY.md "Tracing"):
+    parented on the first sampled member's context, span-LINKED to every
+    member — N sessions provably share this device batch.  Pushed as the
+    stage-attribution target so PIPELINE_STAGES become its children.
+    Returns None (and touches nothing) when no member is sampled."""
+    head = None
+    for e in fb.entries:
+        if e.trace_ctx is not None and getattr(e.trace_ctx, "sampled", False):
+            head = e.trace_ctx
+            break
+    if head is None:
+        return None
+    from .tracing import child_span, push_batch_span
+
+    span = child_span(
+        "coalesce_batch", head,
+        attrs={"sessions": len(fb.entries), "lines": fb.total},
+    )
+    for e in fb.entries:
+        if e.trace_ctx is not None:
+            span.add_link(e.trace_ctx)
+    push_batch_span(span)
+    return span
+
+
+def _end_batch_span(span: Any) -> None:
+    if span is None:
+        return
+    from .tracing import pop_batch_span
+
+    pop_batch_span(span)
+    span.end()
 
 
 class _KeyBatcher:
@@ -219,12 +265,14 @@ class _KeyBatcher:
 
     def submit(self, blob: bytes, count: int,
                deadline_s: Optional[float],
-               max_wait_s: Optional[float] = None) -> _Entry:
+               max_wait_s: Optional[float] = None,
+               trace_ctx: Any = None) -> _Entry:
         now = time.monotonic()
         entry = _Entry(blob, count,
                        now + deadline_s if deadline_s else None,
                        now + max_wait_s if max_wait_s is not None
-                       else None)
+                       else None,
+                       trace_ctx=trace_ctx)
         with self.lock:
             if self.stopped:
                 raise CoalesceShutdown("service is shutting down")
@@ -461,11 +509,14 @@ class _KeyBatcher:
                 and hasattr(parser, "parse_encoded")):
             fb = self._form(my_epoch)
             while fb is not None:
+                fb.span = _begin_batch_span(fb)
                 try:
                     self._scatter(fb, parser.parse_blob(
                         fb.blob(), emit_views=False))
                 except Exception as e:  # noqa: BLE001 — relayed per entry
                     self._fail(fb, e)
+                finally:
+                    _end_batch_span(fb.span)
                 fb = self._form(my_epoch)
             return
 
@@ -476,6 +527,7 @@ class _KeyBatcher:
                 fb = self._form(my_epoch)
                 if fb is None:
                     return
+                fb.span = _begin_batch_span(fb)
                 formed.append(fb)
                 yield fb.encoded()
 
@@ -494,13 +546,17 @@ class _KeyBatcher:
                     # hang its session thread and leak its in-flight
                     # slot forever.
                     self._fail(fb, e)
+                finally:
+                    _end_batch_span(fb.span)
         except Exception as e:  # noqa: BLE001 — relayed per entry
             # A mid-stream failure costs the formed-but-undelivered
             # batches their requests (each answered with the error
             # frame); entries still queued are untouched and retry on
             # the restarted lane.
             while formed:
-                self._fail(formed.popleft(), e)
+                fb = formed.popleft()
+                _end_batch_span(fb.span)
+                self._fail(fb, e)
 
     def _finish(self, entry: _Entry, result: Any = None,
                 error: Optional[BaseException] = None) -> None:
@@ -611,7 +667,8 @@ class BatchCoalescer:
 
     def parse(self, key: Any, parser: Any, blob: bytes, count: int,
               deadline_s: Optional[float] = None,
-              max_wait_s: Optional[float] = None):
+              max_wait_s: Optional[float] = None,
+              trace_ctx: Any = None):
         """Coalesce one request's payload into the key's shared batch
         stream; returns the session's own
         :class:`~logparser_tpu.tpu.batch.BatchResult` window (byte-
@@ -627,7 +684,7 @@ class BatchCoalescer:
             batcher = self._batcher(key, parser)
             try:
                 entry = batcher.submit(blob, count, deadline_s,
-                                       max_wait_s)
+                                       max_wait_s, trace_ctx=trace_ctx)
             except CoalesceShutdown:
                 if self._closed:
                     raise

@@ -83,6 +83,16 @@ class _ClientStats:
     tenant: Optional[str] = None
     busy_reasons: Dict[str, int] = field(default_factory=dict)
     latencies: List[float] = field(default_factory=list)
+    #: outcome class -> sampled trace ids (--trace; capped so a long
+    #: window cannot bloat the record — enough to grep /tracez with).
+    trace_ids: Dict[str, List[str]] = field(default_factory=dict)
+
+    def note_trace(self, outcome: str, trace_id: Optional[str]) -> None:
+        if not trace_id:
+            return
+        ids = self.trace_ids.setdefault(outcome, [])
+        if len(ids) < _TRACE_IDS_CAP and trace_id not in ids:
+            ids.append(trace_id)
 
     def merge(self, other: "_ClientStats") -> None:
         self.requests += other.requests
@@ -97,6 +107,13 @@ class _ClientStats:
         for k, v in other.busy_reasons.items():
             self.busy_reasons[k] = self.busy_reasons.get(k, 0) + v
         self.latencies.extend(other.latencies)
+        for k, ids in other.trace_ids.items():
+            for tid in ids:
+                self.note_trace(k, tid)
+
+
+#: Per outcome class, how many example trace ids --trace keeps.
+_TRACE_IDS_CAP = 8
 
 
 #: Prometheus families the coalesce occupancy report reads
@@ -222,16 +239,28 @@ def _percentile_ms(latencies: Sequence[float], q: float) -> Optional[float]:
 def _drive(host: str, port: int, cfg: Tuple[str, str, List[str]],
            lines: List[str], stop_at: float, interval_s: float, burst: int,
            timeout_s: float, rng: random.Random,
-           stats: _ClientStats) -> None:
+           stats: _ClientStats, trace: bool = False) -> None:
     _name, log_format, fields = cfg
     client: Optional[ParseServiceClient] = None
+    trace_id: Optional[str] = None
     next_t = time.monotonic() + rng.uniform(0.0, interval_s)
     while time.monotonic() < stop_at:
         if client is None:
+            traceparent = None
+            if trace:
+                # A fresh SAMPLED head per connection: the session's
+                # requests join one trace, and the record names its id
+                # under whichever outcome class the requests land in —
+                # /tracez lookups start from here (docs/OBSERVABILITY.md
+                # "Tracing").
+                from ..tracing import new_trace_context
+
+                ctx = new_trace_context(sampled=True)
+                traceparent, trace_id = ctx.traceparent(), ctx.trace_id
             try:
                 client = ParseServiceClient(
                     host, port, log_format, fields, timeout=timeout_s,
-                    tenant=stats.tenant,
+                    tenant=stats.tenant, traceparent=traceparent,
                 )
             except OSError:
                 stats.connect_errors += 1
@@ -246,6 +275,7 @@ def _drive(host: str, port: int, cfg: Tuple[str, str, List[str]],
                 table = client.parse(lines)
             except ServiceBusyError as e:
                 stats.busy += 1
+                stats.note_trace("busy", trace_id)
                 if not e.structured:
                     stats.busy_unstructured += 1
                 stats.busy_reasons[e.reason] = (
@@ -264,20 +294,25 @@ def _drive(host: str, port: int, cfg: Tuple[str, str, List[str]],
                 break
             except ServiceDeadlineError:
                 stats.deadline += 1
+                stats.note_trace("deadline", trace_id)
             except ServiceClosedError:
                 stats.resets += 1
+                stats.note_trace("resets", trace_id)
                 _quiet_close(client)
                 client = None
                 break
             except ParseServiceError:
                 stats.errors += 1
+                stats.note_trace("errors", trace_id)
             except OSError:
                 stats.resets += 1
+                stats.note_trace("resets", trace_id)
                 _quiet_close(client)
                 client = None
                 break
             else:
                 stats.ok += 1
+                stats.note_trace("ok", trace_id)
                 stats.lines_ok += table.num_rows
                 stats.latencies.append(time.monotonic() - t0)
         # Open-loop pacing: the NEXT burst is due on the clock, not after
@@ -309,6 +344,7 @@ def run_loadgen(host: str, port: int, *, clients: int = 8,
                 metrics_url: Optional[str] = None,
                 native: bool = False,
                 tenants: int = 0,
+                trace: bool = False,
                 mid_run_fn: Optional[Any] = None,
                 mid_run_at_s: Optional[float] = None) -> Dict[str, Any]:
     """Drive the service at ``host:port`` and return the SLO record:
@@ -384,7 +420,7 @@ def run_loadgen(host: str, port: int, *, clients: int = 8,
                 target=_drive,
                 args=(host, port, cfg, corpora[cfg[0]], stop_at, interval_s,
                       burst, timeout_s, random.Random(seed * 1000 + i),
-                      per_client[i]),
+                      per_client[i], trace),
                 name=f"loadgen-{i}", daemon=True,
             )
         t.start()
@@ -423,6 +459,13 @@ def run_loadgen(host: str, port: int, *, clients: int = 8,
             t["tenant_quota_sheds"] += s.busy_reasons.get(
                 "tenant_quota", 0)
         extra["tenants"] = {k: by_tenant[k] for k in sorted(by_tenant)}
+    if trace:
+        # Example trace ids per outcome class (capped): the operator's
+        # entry point into /tracez for exactly the requests that shed,
+        # expired, or reset.
+        extra["trace_ids"] = {
+            k: total.trace_ids[k] for k in sorted(total.trace_ids)
+        }
     if before is not None:
         extra["coalesce"] = coalesce_report(
             before, scrape_metrics(metrics_url))
@@ -495,6 +538,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
              "\"Fleet\")",
     )
     ap.add_argument(
+        "--trace", action="store_true",
+        help="stamp a fresh SAMPLED traceparent on every client "
+             "connection and report example trace ids per outcome "
+             "class — the /tracez entry point for shed/expired/reset "
+             "requests (Python driver only; docs/OBSERVABILITY.md "
+             "\"Tracing\")",
+    )
+    ap.add_argument(
         "--roll", action="store_true",
         help="mid-run rolling-restart trigger: POST /rollz on "
              "--metrics-port (a front tier's fleet endpoint) at half "
@@ -528,6 +579,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ),
         native=args.native,
         tenants=args.tenants,
+        trace=args.trace,
         mid_run_fn=mid_run_fn,
     )
     print(json.dumps(record, indent=1, sort_keys=True))

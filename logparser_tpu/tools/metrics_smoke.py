@@ -44,6 +44,15 @@ REQUIRED_SUBSTRINGS = (
     "logparser_tpu_device_escaped_quote_lines_total",
     "logparser_tpu_service_requests_total",
     "logparser_tpu_parse_lines_total",
+    # Analytics pushdown (docs/ANALYTICS.md): the aggregate session the
+    # smoke drives below must move the device-path batch counter, the
+    # D2H shrinkage ledger, and the fused aggregate stage timer.
+    'logparser_tpu_analytics_batches_total{path="device"}',
+    "logparser_tpu_analytics_d2h_bytes_saved_total",
+    'logparser_tpu_stage_seconds_bucket{stage="aggregate",le="+Inf"}',
+    # Build identity (docs/OBSERVABILITY.md): every exposition carries
+    # one build_info gauge labeling the package + jax versions.
+    "logparser_tpu_build_info{",
 )
 
 
@@ -143,6 +152,19 @@ def main() -> int:
         ) as client:
             table = client.parse(lines)
             assert table.num_rows == len(lines)
+        # One aggregate-mode session so the analytics_* families exist
+        # before the scrape asserts them (the row session above never
+        # touches the pushdown path).
+        with ParseServiceClient(
+            svc.host, svc.port, "combined",
+            ["IP:connection.client.host", "BYTES:response.body.bytes"],
+            aggregate=[{"op": "count"},
+                       {"op": "sum", "field": "BYTES:response.body.bytes"}],
+        ) as agg:
+            state = agg.parse(lines)
+            counts = [d["value"] for d in state.summary()
+                      if d.get("op") == "count"]
+            assert counts == [len(lines)], state.summary()
         url = f"http://{svc.host}:{svc.metrics_port}/metrics"
         with urllib.request.urlopen(url, timeout=10) as resp:
             assert resp.status == 200, resp.status

@@ -1,0 +1,290 @@
+"""CI trace smoke: one CONNECTED distributed trace across a real fleet
+plus a crash-safe flight dump naming an injected device fault
+(docs/OBSERVABILITY.md "Tracing" / "Flight recorder" acceptance drill).
+
+Boots a :class:`~logparser_tpu.front.FrontTier` over TWO real sidecar
+processes with head sampling forced on (``LOGPARSER_TPU_TRACE_SAMPLE=1``
+— sidecars inherit the env), a widened coalesce window, and
+``oom_batch`` device chaos armed, then asserts:
+
+1. **Connected cross-process trace** — two CONCURRENT sessions through
+   the front on the same parser key produce, in the merged front
+   ``/tracez`` payload: a ``front_session`` root span per session (the
+   front re-serializes CONFIG with ``traceparent`` ONLY for sampled
+   sessions), a ``service_request`` child span in the sidecar whose
+   ``parent_span_id`` is the front root's span id (the relay carried
+   the context across the process boundary), ONE shared
+   ``coalesce_batch`` span carrying span-LINKS to BOTH sessions'
+   request contexts (N-session fan-in is links, not a fake parent), and
+   at least one pipeline-stage child span under the batch span reusing
+   the ``PIPELINE_STAGES`` vocabulary.
+2. **Flight dump names the injected fault** — the ``oom_batch`` chaos
+   fired inside a sidecar and was absorbed silently
+   (``_absorb_device_fault``); ``SIGUSR2`` to that sidecar must produce
+   ``flight-<pid>.json`` in ``LOGPARSER_TPU_FLIGHT_DIR`` whose event
+   ring contains the ``device_fault`` event with ``fault="oom"`` — the
+   recovery left no trace on the wire, so the dump is the only
+   per-incident record.  The merged front ``/flightz`` must show the
+   same event live.
+
+Usage::
+
+    make trace-smoke
+    python -m logparser_tpu.tools.trace_smoke
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List
+
+DRILL_FIELDS = ["IP:connection.client.host", "STRING:request.status.last"]
+
+
+def _scrape_json(url: str) -> Dict[str, Any]:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _all_spans(tracez: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Front + every sidecar's spans from the merged /tracez payload."""
+    spans = list((tracez.get("front") or {}).get("spans") or [])
+    for payload in (tracez.get("sidecars") or {}).values():
+        if isinstance(payload, dict):
+            spans.extend(payload.get("spans") or [])
+    return spans
+
+
+def main() -> int:
+    # Observability smoke, not a perf run: never acquire a TPU, and make
+    # sure every spawned fleet member inherits the same platform AND the
+    # tracing/chaos env (ProcessSidecar children inherit os.environ).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flight_dir = tempfile.mkdtemp(prefix="logparser-flight-")
+    os.environ["LOGPARSER_TPU_TRACE_SAMPLE"] = "1"
+    os.environ["LOGPARSER_TPU_FLIGHT_DIR"] = flight_dir
+    # One absorbed device OOM per sidecar (fires on the first device
+    # execution, i.e. during warmup) — the flight recorder's feed.
+    os.environ["LOGPARSER_TPU_CHAOS"] = "oom_batch:count=1"
+
+    from logparser_tpu.front import FrontPolicy, FrontTier
+    from logparser_tpu.service import ParseServiceClient
+    from logparser_tpu.tools.loadgen import make_lines
+
+    problems: List[str] = []
+    t_all = time.monotonic()
+    lines = make_lines("combined", 64, seed=11)
+    policy = FrontPolicy(
+        heartbeat_interval_s=0.25,
+        heartbeat_deadline_s=15.0,
+        backoff_base_s=0.1,
+        busy_retry_after_s=0.05,
+        drain_timeout_s=8.0,
+    )
+
+    def warmup(handle: Any) -> None:
+        # Compiles the drill key AND consumes the one-shot oom chaos, so
+        # the traced sessions below run on a warm, fault-free parser.
+        with ParseServiceClient(handle.host, handle.port, "combined",
+                                DRILL_FIELDS, timeout=120.0) as warm:
+            warm.parse(lines)
+
+    with FrontTier(
+        n_sidecars=2,
+        metrics_port=0,
+        policy=policy,
+        sidecar_args=["--drain-deadline", "5", "--max-sessions", "32",
+                      # Widen the straggler window so two barrier-
+                      # synchronized sessions reliably share one batch.
+                      "--coalesce-window-ms", "150"],
+        warmup_fn=warmup,
+    ) as front:
+        print(f"trace-smoke: 2 sidecars up + warm "
+              f"({time.monotonic() - t_all:.0f}s)")
+        tracez_url = f"http://{front.host}:{front.metrics_port}/tracez"
+        flightz_url = f"http://{front.host}:{front.metrics_port}/flightz"
+
+        # 1) Two concurrent sessions, SAME key (affinity routes both to
+        # one sidecar), parse through the same coalesce window.
+        shared: List[Dict[str, Any]] = []
+        spans: List[Dict[str, Any]] = []
+        for attempt in range(5):
+            barrier = threading.Barrier(2)
+            errors: List[str] = []
+
+            def _session() -> None:
+                try:
+                    with ParseServiceClient(
+                        front.host, front.port, "combined", DRILL_FIELDS,
+                        timeout=120.0, busy_retries=4,
+                    ) as client:
+                        barrier.wait(timeout=30)
+                        client.parse(lines)
+                except Exception as e:  # noqa: BLE001 - smoke reporter
+                    errors.append(f"{type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=_session, daemon=True)
+                       for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            if errors:
+                problems.append(
+                    f"traced session failed (attempt {attempt}): {errors}")
+                break
+            # Spans land in the buffer at .end(); the front root ends on
+            # session exit — give the handler threads a beat.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not shared:
+                spans = _all_spans(_scrape_json(tracez_url))
+                shared = [
+                    s for s in spans
+                    if s["name"] == "coalesce_batch"
+                    and len(s.get("links") or []) >= 2
+                ]
+                if not shared:
+                    time.sleep(0.2)
+            if shared:
+                break
+        else:
+            problems.append(
+                "no coalesce_batch span with >=2 session links after 5 "
+                "attempts (shared-batch fan-in never traced)"
+            )
+
+        if shared:
+            batch = shared[0]
+            by_id = {s["span_id"]: s for s in spans}
+            linked_ids = {ln["span_id"] for ln in batch["links"]}
+            requests = [
+                s for s in spans
+                if s["name"] == "service_request"
+                and s["span_id"] in linked_ids
+            ]
+            if len(requests) < 2:
+                problems.append(
+                    f"batch links {sorted(linked_ids)} resolve to only "
+                    f"{len(requests)} service_request spans (need 2)"
+                )
+            roots = []
+            for req in requests:
+                parent = by_id.get(req.get("parent_span_id") or "")
+                if (parent is None or parent["name"] != "front_session"
+                        or parent["trace_id"] != req["trace_id"]):
+                    problems.append(
+                        f"service_request {req['span_id']} does not "
+                        "parent under a same-trace front_session root "
+                        "(the relay lost the context)"
+                    )
+                else:
+                    roots.append(parent)
+            if batch.get("parent_span_id") not in linked_ids:
+                problems.append(
+                    "coalesce_batch parent is not one of its linked "
+                    "request contexts (head session must parent the "
+                    "shared batch)"
+                )
+            if int(batch.get("attrs", {}).get("sessions", 0)) < 2:
+                problems.append(
+                    f"coalesce_batch attrs claim "
+                    f"{batch.get('attrs', {}).get('sessions')} sessions "
+                    "(need >=2)"
+                )
+            stages = [
+                s for s in spans
+                if s.get("parent_span_id") == batch["span_id"]
+                and s["trace_id"] == batch["trace_id"]
+            ]
+            if not stages:
+                problems.append(
+                    "no pipeline-stage child spans under the shared "
+                    "batch span (stage sink never fired)"
+                )
+            if not problems:
+                print(
+                    "trace-smoke: connected trace OK — "
+                    f"{len(roots)} front roots -> "
+                    f"{len(requests)} service requests -> 1 shared "
+                    f"batch ({batch['attrs']['sessions']} sessions, "
+                    f"{len(batch['links'])} links) -> "
+                    f"{len(stages)} stage spans "
+                    f"({sorted({s['name'] for s in stages})})"
+                )
+
+        # 2) Flight recorder: the warmup's absorbed oom must be in the
+        # live merged /flightz AND in the SIGUSR2 crash dump.
+        flightz = _scrape_json(flightz_url)
+        live_faults = [
+            e
+            for payload in (flightz.get("sidecars") or {}).values()
+            if isinstance(payload, dict)
+            for e in (payload.get("events") or [])
+            if e.get("kind") == "device_fault"
+        ]
+        if not live_faults:
+            problems.append(
+                "merged /flightz shows no device_fault event although "
+                "oom chaos was armed in every sidecar"
+            )
+        victim = front._slots[0]
+        victim_pid = victim.handle.pid
+        os.kill(victim_pid, signal.SIGUSR2)
+        dump_path = os.path.join(flight_dir, f"flight-{victim_pid}.json")
+        end = time.monotonic() + 10.0
+        dump = None
+        while time.monotonic() < end:
+            if os.path.exists(dump_path):
+                try:
+                    with open(dump_path, encoding="utf-8") as fh:
+                        dump = json.load(fh)
+                    break
+                except ValueError:
+                    pass  # racing the atomic replace; retry
+            time.sleep(0.1)
+        if dump is None:
+            problems.append(
+                f"SIGUSR2 produced no readable flight dump at {dump_path}")
+        else:
+            faults = [e for e in dump.get("events", [])
+                      if e.get("kind") == "device_fault"]
+            if not faults:
+                problems.append(
+                    "flight dump has no device_fault event "
+                    f"(kinds: {sorted({e.get('kind') for e in dump.get('events', [])})})"
+                )
+            elif faults[0].get("fault") != "oom":
+                problems.append(
+                    "flight dump device_fault does not name the "
+                    f"injected oom: {faults[0]}"
+                )
+            else:
+                print(
+                    "trace-smoke: flight dump OK — "
+                    f"{dump_path} names the absorbed device fault "
+                    f"(fault={faults[0]['fault']}, "
+                    f"{len(dump.get('events', []))} events, "
+                    f"reason={dump.get('dump_reason')})"
+                )
+
+    if problems:
+        print(f"trace-smoke: FAIL ({len(problems)} problems)")
+        for p in problems:
+            print(" -", p)
+        return 1
+    print(
+        "trace-smoke: OK — one connected trace across front, sidecar, "
+        "and shared device batch; SIGUSR2 flight dump names the "
+        f"injected device fault ({time.monotonic() - t_all:.0f}s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2589,9 +2589,12 @@ class TpuBatchParser:
         would fail identically), warn once, count — never raise out of
         the parse."""
         from ..observability import log_warning_once, metrics
+        from ..tracing import flight_event
 
         reg = metrics()
         reg.increment("device_compile_failures_total")
+        flight_event("device_compile_fault",
+                     error=f"{type(e).__name__}: {e}"[:200])
         if self._breaker.record_fault(permanent=True):
             reg.increment("device_demotions_total",
                           labels={"reason": "compile"})
@@ -2616,11 +2619,18 @@ class TpuBatchParser:
         referee).  Never raises: a device fault costs throughput, never
         the batch."""
         from ..observability import log_warning_once, metrics
+        from ..tracing import flight_event
         from .device_faults import DeviceFault, classify_device_error
 
         reg = metrics()
         kind = classify_device_error(e)
         reg.increment("device_faults_total", labels={"kind": kind})
+        # The flight recorder's primary feed: this absorption is
+        # deliberately silent on the request path, so the ring is the
+        # only per-incident record that survives the process
+        # (docs/OBSERVABILITY.md "Flight recorder").
+        flight_event("device_fault", fault=kind, batch_rows=B,
+                     error=f"{type(e).__name__}: {e}"[:200])
         if kind == "compile":
             self._absorb_compile_fault(e)
             return None

@@ -1,0 +1,267 @@
+"""Distributed tracing + flight recorder (round 20): W3C traceparent
+round-tripping, head sampling, span parenting/links, the batch-scope
+stage sink, the crash-safe flight ring + dump, the build_info gauge,
+and scrape safety under concurrent registry mutation.
+
+The span buffer and flight ring are process-global like the metrics
+registry, so every test pins its own state via ``reset_for_tests`` and
+restores the env-derived default on the way out.
+"""
+import json
+import os
+import threading
+
+import pytest
+
+from logparser_tpu import tracing
+from logparser_tpu.observability import build_info, metrics
+from logparser_tpu.tools.metrics_smoke import validate_exposition
+
+
+@pytest.fixture(autouse=True)
+def _pinned_tracing_state():
+    tracing.reset_for_tests(sample_rate_value=0.0)
+    yield
+    tracing.reset_for_tests()
+
+
+# -- traceparent ---------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    ctx = tracing.new_trace_context(sampled=True)
+    back = tracing.parse_traceparent(ctx.traceparent())
+    assert back == ctx
+    assert back.sampled
+    off = tracing.new_trace_context(sampled=False)
+    assert off.traceparent().endswith("-00")
+    assert not tracing.parse_traceparent(off.traceparent()).sampled
+
+
+@pytest.mark.parametrize("bad", [
+    None,
+    "",
+    42,
+    "00-abc-def-01",                                    # wrong lengths
+    "01-" + "a" * 32 + "-" + "b" * 16 + "-01",          # unknown version
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",          # non-hex trace
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",          # all-zero trace
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",          # all-zero span
+    "00-" + "a" * 32 + "-" + "b" * 16,                  # missing flags
+])
+def test_malformed_traceparent_drops_silently(bad):
+    assert tracing.parse_traceparent(bad) is None
+
+
+def test_child_keeps_trace_and_sampling():
+    ctx = tracing.new_trace_context(sampled=True)
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id
+    assert kid.span_id != ctx.span_id
+    assert kid.sampled
+
+
+# -- head sampling -------------------------------------------------------
+
+
+def test_head_context_rate_zero_is_none():
+    assert tracing.sample_rate() == 0.0
+    assert tracing.head_context() is None
+
+
+def test_head_context_rate_one_samples():
+    tracing.set_sample_rate(1.0)
+    ctx = tracing.head_context()
+    assert ctx is not None and ctx.sampled
+
+
+def test_incoming_context_respected_at_rate_zero():
+    # The head already decided: a sampled traceparent traces even in a
+    # process whose own sampling is off (that is how a front decision
+    # rides into the sidecars).
+    incoming = tracing.new_trace_context(sampled=True).traceparent()
+    ctx = tracing.head_context(incoming)
+    assert ctx is not None and ctx.sampled
+
+
+# -- spans ---------------------------------------------------------------
+
+
+def test_span_factories_return_none_when_unsampled():
+    assert tracing.root_span("s") is None
+    assert tracing.child_span("s", None) is None
+    unsampled = tracing.new_trace_context(sampled=False)
+    assert tracing.child_span("s", unsampled) is None
+
+
+def test_root_child_parenting_and_links():
+    tracing.set_sample_rate(1.0)
+    root = tracing.root_span("front_session")
+    req = tracing.child_span("service_request", root.context)
+    other = tracing.new_trace_context(sampled=True)
+    batch = tracing.child_span("coalesce_batch", req.context,
+                               links=[req.context, other])
+    batch.end(sessions=2)
+    req.end(outcome="ok")
+    root.end()
+    spans = {s["name"]: s for s in tracing.tracez_payload()["spans"]}
+    assert spans["service_request"]["trace_id"] == root.context.trace_id
+    assert (spans["service_request"]["parent_span_id"]
+            == root.context.span_id)
+    assert spans["coalesce_batch"]["parent_span_id"] == req.context.span_id
+    linked = {ln["span_id"] for ln in spans["coalesce_batch"]["links"]}
+    assert linked == {req.context.span_id, other.span_id}
+    assert spans["coalesce_batch"]["attrs"]["sessions"] == 2
+
+
+def test_span_end_is_idempotent():
+    tracing.set_sample_rate(1.0)
+    span = tracing.root_span("s")
+    span.end(outcome="shed")
+    span.end(outcome="late")  # the finally-path no-op
+    spans = tracing.tracez_payload()["spans"]
+    assert len(spans) == 1
+    assert spans[0]["attrs"]["outcome"] == "shed"
+
+
+def test_span_buffer_bounded_with_dropped_counter():
+    tracing.set_sample_rate(1.0)
+    buf = tracing.span_buffer()
+    for _ in range(buf.maxlen + 5):
+        tracing.root_span("s").end()
+    payload = tracing.tracez_payload()
+    assert len(payload["spans"]) == buf.maxlen
+    assert payload["dropped"] >= 5
+
+
+def test_batch_scope_installs_stage_sink_only_while_active():
+    from logparser_tpu.observability import observe_stage
+
+    tracing.set_sample_rate(1.0)
+    observe_stage("encode", 0.01, items=4)  # no scope: no span
+    batch = tracing.child_span(
+        "coalesce_batch", tracing.new_trace_context(sampled=True))
+    with tracing.batch_scope(batch):
+        observe_stage("device", 0.02, items=4)
+    batch.end()
+    observe_stage("fetch", 0.03, items=4)  # scope closed again: no span
+    names = [s["name"] for s in tracing.tracez_payload()["spans"]]
+    assert names.count("device") == 1
+    assert "encode" not in names and "fetch" not in names
+    stage = next(s for s in tracing.tracez_payload()["spans"]
+                 if s["name"] == "device")
+    assert stage["parent_span_id"] == batch.context.span_id
+    assert stage["trace_id"] == batch.context.trace_id
+
+
+# -- flight recorder -----------------------------------------------------
+
+
+def test_flight_ring_bounded_and_typed():
+    ring = tracing.flight_recorder()
+    for i in range(ring.maxlen + 3):
+        tracing.flight_event("device_fault", fault="oom", batch_rows=i,
+                             none_field=None, obj=ValueError("x"))
+    events = tracing.flightz_payload()["events"]
+    assert len(events) == ring.maxlen
+    assert tracing.flightz_payload()["events_total"] == ring.maxlen + 3
+    ev = events[-1]
+    assert ev["kind"] == "device_fault"
+    assert ev["fault"] == "oom"
+    assert "none_field" not in ev              # None fields dropped
+    assert ev["obj"] == "x"                    # non-scalars stringified
+
+
+def test_flight_event_payload_cannot_overwrite_envelope():
+    # A field named "kind" cannot even be passed (it collides with the
+    # positional parameter — call sites use fault=/reason= instead)...
+    with pytest.raises(TypeError):
+        tracing.flight_recorder().record("device_fault",
+                                         **{"kind": "oom"})
+    # ...and a field named "t" lands in **fields but must not clobber
+    # the event timestamp.
+    tracing.flight_event("device_fault", t=123, fault="oom")
+    ev = tracing.flightz_payload()["events"][-1]
+    assert ev["kind"] == "device_fault"
+    assert ev["t"] != 123
+
+
+def test_flight_dump_atomic_and_named(tmp_path, monkeypatch):
+    monkeypatch.setenv("LOGPARSER_TPU_FLIGHT_DIR", str(tmp_path))
+    tracing.flight_event("front_failover", sidecar="sc1", fault="died")
+    path = tracing.dump_flight("test_reason")
+    assert path == str(tmp_path / f"flight-{os.getpid()}.json")
+    with open(path, encoding="utf-8") as fh:
+        dump = json.load(fh)
+    assert dump["dump_reason"] == "test_reason"
+    assert dump["pid"] == os.getpid()
+    kinds = [e["kind"] for e in dump["events"]]
+    assert "front_failover" in kinds
+    assert not list(tmp_path.glob("*.tmp*"))   # tmp file replaced away
+
+
+# -- build_info satellite ------------------------------------------------
+
+
+def test_build_info_gauge_on_every_exposition():
+    info = build_info()
+    assert info["version"]
+    text = metrics().prometheus_text()
+    assert "logparser_tpu_build_info{" in text
+    assert f'version="{info["version"]}"' in text
+    # Survives a registry reset: re-stamped per render.
+    reg = metrics()
+    reg.reset()
+    assert "logparser_tpu_build_info{" in reg.prometheus_text()
+    assert validate_exposition(reg.prometheus_text()) == []
+
+
+# -- concurrent scrape safety --------------------------------------------
+
+
+def test_concurrent_mutation_never_corrupts_scrape():
+    """Two mutator threads hammer the registry (counters, labeled
+    counters, histograms) and the span/flight stores while a scraper
+    thread renders /metrics text and the tracez/flightz payloads: every
+    render must stay structurally valid mid-flight."""
+    tracing.set_sample_rate(1.0)
+    reg = metrics()
+    stop = threading.Event()
+    problems = []
+
+    def mutate(tid):
+        i = 0
+        while not stop.is_set():
+            reg.increment("trace_test_total", labels={"thread": str(tid)})
+            reg.observe("trace_test_seconds", 0.001 * (i % 7))
+            reg.gauge_set("trace_test_gauge", float(i))
+            span = tracing.root_span(f"mut{tid}")
+            if span is not None:
+                span.end(i=i)
+            tracing.flight_event("mut_event", thread=tid, i=i)
+            i += 1
+
+    def scrape():
+        while not stop.is_set():
+            errs = validate_exposition(reg.prometheus_text())
+            if errs:
+                problems.extend(errs)
+                return
+            for payload in (tracing.tracez_payload(),
+                            tracing.flightz_payload()):
+                json.dumps(payload)  # must never race mid-mutation
+
+    threads = [threading.Thread(target=mutate, args=(tid,))
+               for tid in range(2)]
+    threads.append(threading.Thread(target=scrape))
+    for t in threads:
+        t.start()
+    try:
+        import time
+
+        time.sleep(1.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert problems == [], problems[:5]
