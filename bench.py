@@ -78,6 +78,16 @@ RESCUE_EFFECTIVE_FLOOR = 5e6
 # must retain at least this fraction of the clean-corpus device rate
 # (pre-round-18: ~0.71 from the 29% rescue wall share).
 RESCUE_ESC_RETENTION_GATE = 0.9
+# URI-fields gates (round 20, ROADMAP direction 5): the flagship
+# dashboard field set (HTTP.PATH + three realistic query keys) on the
+# realistic corpus must route ZERO lines to the oracle (the URI
+# sub-dissector chain lives on device — in-run hard gate,
+# container-valid) and the parse must retain at least this fraction of
+# the same parse WITHOUT the URI fields (wall-clock A/B, interleaved
+# best-of — recorded-floor lane: hardware-fingerprinted like the other
+# throughput floors).  Pre-round-20 every such line carried
+# reason=host_fields, i.e. retention collapsed to the host-oracle rate.
+URI_RETENTION_GATE = 0.9
 FEEDER_CORPUS_REPEATS = 2
 FEEDER_SHARD_BYTES = 4 << 20
 # Ring A/B (round 10): drain passes per transport (best-of, absorbs
@@ -2146,6 +2156,103 @@ def bench_rescue_config():
     return cfg, (parser, lines, buf, lengths, frac, oracle_lps)
 
 
+URI_DASHBOARD_FIELDS = [
+    "HTTP.PATH:request.firstline.uri.path",
+    "STRING:request.firstline.uri.query.q",
+    "STRING:request.firstline.uri.query.utm_source",
+    "STRING:request.firstline.uri.query.id",
+]
+
+
+def bench_uri_fields():
+    """Round-20 gated section (ROADMAP direction 5): the flagship
+    dashboard field set — ``HTTP.PATH`` plus three realistic query keys
+    — on the realistic corpus, against the same parse WITHOUT the URI
+    fields.
+
+    Pre-round-20 every URI sub-dissector field carried
+    ``reason=host_fields`` oracle routing, so requesting them dropped
+    the whole stream to the host-oracle rate.  With the device URI
+    chain (span sub-slicing + per-key query explosion + vectorized
+    percent-decode) the section hard-gates ``oracle_fraction == 0.0``,
+    asserts the host dissector chain referees byte-identically on a
+    sample, and gates wall-clock retention >= URI_RETENTION_GATE
+    (recorded-floor lane; interleaved best-of-N per side, the ring-A/B
+    pattern)."""
+    from logparser_tpu.tools.demolog import generate_combined_lines
+    from logparser_tpu.tpu.batch import TpuBatchParser, _CollectingRecord
+
+    lines = generate_combined_lines(CONFIG_BATCH, seed=53)
+    base_parser = TpuBatchParser("combined", HEADLINE_FIELDS)
+    uri_parser = TpuBatchParser(
+        "combined", HEADLINE_FIELDS + URI_DASHBOARD_FIELDS
+    )
+    base_parser.parse_batch(lines)          # warm (compile + caches)
+    uri_result = uri_parser.parse_batch(lines)
+
+    # The zero-oracle contract, with the per-reason census on record —
+    # a nonzero fraction must name its class.
+    oracle_fraction = uri_result.oracle_rows / len(lines)
+
+    # Host-chain referee: byte identity on a stratified sample (the
+    # full-corpus differential lives in tests/test_fuzz_differential.py;
+    # here ~512 rows keep the section under a second while still
+    # touching every corpus shape).
+    referee_rows = 0
+    referee_mismatches = []
+    step = max(1, len(lines) // 512)
+    cols = {f: uri_result.to_pylist(f) for f in URI_DASHBOARD_FIELDS}
+    valid = list(uri_result.valid)
+    oracle = uri_parser.oracle
+    for i in range(0, len(lines), step):
+        try:
+            expected = oracle.parse(lines[i], _CollectingRecord()).values
+            ok = True
+        except Exception:  # noqa: BLE001 — referee verdict, any failure
+            expected, ok = {}, False
+        if bool(valid[i]) != ok:
+            referee_mismatches.append(
+                f"line {i}: device valid={bool(valid[i])} oracle ok={ok}"
+            )
+            continue
+        if not ok:
+            continue
+        referee_rows += 1
+        for f in URI_DASHBOARD_FIELDS:
+            if cols[f][i] != expected.get(f):
+                referee_mismatches.append(
+                    f"line {i} field {f}: "
+                    f"{cols[f][i]!r} != {expected.get(f)!r}"
+                )
+
+    # Wall-clock A/B: interleaved best-of-N per side (host-load drift
+    # over the section biases neither parser).
+    def one_pass(p):
+        t0 = time.perf_counter()
+        p.parse_batch(lines)
+        return len(lines) / (time.perf_counter() - t0)
+
+    base_rate = uri_rate = 0.0
+    for _ in range(3):
+        base_rate = max(base_rate, one_pass(base_parser))
+        uri_rate = max(uri_rate, one_pass(uri_parser))
+    retention = uri_rate / base_rate if base_rate else 0.0
+
+    base_parser.close()
+    uri_parser.close()
+    return {
+        "fields": HEADLINE_FIELDS + URI_DASHBOARD_FIELDS,
+        "batch": len(lines),
+        "oracle_fraction": round(oracle_fraction, 5),
+        "oracle_reasons": dict(uri_result.rescue_reasons),
+        "referee_rows": referee_rows,
+        "referee_mismatches": referee_mismatches[:8],
+        "base_lines_per_sec": round(base_rate, 1),
+        "uri_lines_per_sec": round(uri_rate, 1),
+        "effective_retention": round(retention, 4),
+    }
+
+
 def _unescape_microbench(parser, base, runs=3):
     """Best-of-N lines/s of the device unescape/compaction pass over the
     5%-escaped corpus's user-agent spans (one jitted call per run; the
@@ -2512,6 +2619,13 @@ def main():
         )
     except Exception as e:  # noqa: BLE001
         configs["combined_rescue"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # URI-fields A/B (round 20): the dashboard field set vs the same
+    # parse without it — zero-oracle + referee + retention gates below.
+    try:
+        uri_section = bench_uri_fields()
+    except Exception as e:  # noqa: BLE001 — the section must not kill the run
+        uri_section = {"error": f"{type(e).__name__}: {e}"}
 
     # Gated-floor pre-check, still INSIDE the clean phase (before any
     # tensorflow import): host wall-clock on this 1-core box swings ±20%
@@ -3106,6 +3220,38 @@ def main():
                 f"(above {TRACING_SAMPLED_GATE}x)"
             )
 
+    # (i) URI-fields gates (round 20, ROADMAP direction 5): the
+    #     dashboard field set must route zero lines to the oracle and
+    #     the host-chain referee must agree byte-for-byte — both in-run
+    #     hard gates, container-valid.  Retention vs the no-URI-fields
+    #     parse is a throughput floor -> recorded-floor lane.
+    if "error" in uri_section:
+        gate_failures.append(f"uri_fields: {uri_section['error']}")
+    else:
+        if uri_section.get("oracle_fraction", 1.0) != 0.0:
+            gate_failures.append(
+                f"uri_fields: dashboard field set routed "
+                f"oracle_fraction={uri_section.get('oracle_fraction')} "
+                f"(reasons {uri_section.get('oracle_reasons')}) — must "
+                "be 0.0, the URI chain lives on device"
+            )
+        if uri_section.get("referee_mismatches"):
+            gate_failures.append(
+                f"uri_fields: host-chain referee disagreed: "
+                f"{uri_section['referee_mismatches'][:2]}"
+            )
+        if not uri_section.get("referee_rows"):
+            gate_failures.append(
+                "uri_fields: referee checked zero rows — the byte-parity "
+                "contract is no longer being exercised"
+            )
+        uri_retention = uri_section.get("effective_retention", 0.0)
+        if uri_retention < URI_RETENTION_GATE:
+            floor_gates.append(
+                f"uri_fields: retention {uri_retention:.3f} vs the "
+                f"no-URI-fields parse (below {URI_RETENTION_GATE})"
+            )
+
     # Recorded-floor resolution (see floor_gates above): hard gates only
     # on the hardware that recorded the baselines; informational
     # cross-hardware deltas otherwise.
@@ -3222,6 +3368,10 @@ def main():
         # ratios vs the untraced base, paired windows
         # (docs/OBSERVABILITY.md "Tracing").
         "tracing": tracing_section,
+        # The URI-fields A/B (round 20): dashboard field set at device
+        # rate — zero-oracle, host-chain referee, retention vs the
+        # no-URI-fields parse (BASELINE.md "Round 20").
+        "uri_fields": uri_section,
         # This round's hardware + the recorded-floor baseline's: floor
         # comparisons hard-gate only on matching hardware; otherwise
         # they land in cross_hardware_deltas (informational, per the
@@ -3458,6 +3608,15 @@ def main():
                     "esc10_retention": leg10.get("effective_retention"),
                     "esc10_speedup": leg10.get("device_vs_oracle_speedup")}
                    if leg10 else {}),
+            }
+        ),
+        # URI-fields drill (round 20): the compact proof the dashboard
+        # field set runs at device rate — retention vs the no-URI parse
+        # and the zero-oracle verdict.
+        "uri": (
+            {"error": True} if "error" in uri_section else {
+                "retention": uri_section["effective_retention"],
+                "oracle_frac": uri_section["oracle_fraction"],
             }
         ),
         "oracle_fraction_max": full["oracle_fraction_max"],

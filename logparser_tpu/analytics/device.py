@@ -43,6 +43,7 @@ from ..tpu.pipeline import (
     ESC_QUOTE_BIT,
     _SPAN_BITS,
     compute_units_rows,
+    csr_group_key,
     ts_group_key,
 )
 from .spec import AggregateSpec
@@ -57,6 +58,11 @@ SUM_TILE = 4096              # 4096 * 0xFFFF < 2^31: the 16-bit-split bound
 # 1902..2037 stay within int32 (1901-12-13..2038-01-19 are the exact
 # bounds; whole years keep the guard trivially safe on both sides).
 _TS_YEAR_MIN, _TS_YEAR_MAX = 1902, 2037
+
+# Longest query key matched on device: the per-slot name compare gathers
+# this many bytes per row per slot, so keep it bounded (longer keys fold
+# — exact, just unaccelerated).
+_QS_KEY_MAX = 64
 
 
 def _limbs_of(value: int) -> Tuple[int, int, int]:
@@ -76,6 +82,33 @@ class _OpPlan:
     def __init__(self, op, units_desc: List[Optional[dict]]):
         self.op = op
         self.units_desc = units_desc
+
+
+def _qscsr_desc(u, plan) -> Optional[dict]:
+    """Device descriptor for count_by/top_k over one concrete query key
+    (``STRING:...uri.query.img``), or None when rows won by this unit
+    must fold.  The device matches the requested key against every
+    emitted segment name (ASCII case fold, last match wins) and groups
+    the matched value spans; rows whose match or value the raw bytes
+    cannot prove — a %-repairable or non-ASCII segment name anywhere in
+    the row, or a matched value flagged for url-decode — fold
+    dynamically in the lane.  Cookies and set-cookies keep the host path
+    (edge-trim semantics), as do wildcard/attr deliveries and non-ASCII
+    or oversized keys."""
+    if plan.kind != "qscsr" or not plan.comp or plan.comp == "*":
+        return None
+    if getattr(plan, "attr", ""):
+        return None
+    if (plan.meta or "query") != "query":
+        return None
+    key_b = plan.comp.encode("utf-8")
+    if not 0 < len(key_b) <= _QS_KEY_MAX or any(b >= 0x80 for b in key_b):
+        return None
+    gkey = csr_group_key(plan)
+    if "s0_nhigh" not in (u.layout.slots.get(gkey) or {}):
+        # Layout predating the name-high bit (pickled config): fold.
+        return None
+    return {"plan": plan, "qs_group": gkey, "qs_key": key_b}
 
 
 def plan_aggregate(parser, spec: AggregateSpec) -> List[_OpPlan]:
@@ -98,7 +131,10 @@ def plan_aggregate(parser, spec: AggregateSpec) -> List[_OpPlan]:
                 continue
             plan = u.plan_for(op.field)
             if op.op in ("count_by", "top_k"):
-                descs.append({"plan": plan} if plan.kind == "span" else None)
+                if plan.kind == "span":
+                    descs.append({"plan": plan})
+                else:
+                    descs.append(_qscsr_desc(u, plan))
             elif op.op in ("sum", "histogram"):
                 descs.append(
                     {"plan": plan}
@@ -126,6 +162,50 @@ def _slot(rows: Sequence[jnp.ndarray], unit, fid: str, comp: str):
     if bits == 0:
         return col
     return (col >> shift) & ((1 << bits) - 1)
+
+
+def _qs_key_lane(rows, unit, desc, buf, L):
+    """Concrete query-key extraction from the packed CSR segment table:
+    ASCII-case-folded byte match of the requested key against every
+    emitted segment name, last match winning (the host overwrite
+    order).  Returns ``(ok, null, vstart, vlen, fold)``; ``fold`` marks
+    rows the raw value span cannot prove byte-identical to the host
+    delivery — any emitted segment whose name needs %-repair or holds a
+    non-ASCII byte (the device compares raw bytes; host names repair
+    then lower), or a matched value flagged for url-decode."""
+    gkey = desc["qs_group"]
+    target = jnp.asarray(
+        np.frombuffer(desc["qs_key"], dtype=np.uint8).astype(np.int32)
+    )
+    klen = int(target.shape[0])
+    B = buf.shape[0]
+    zero = jnp.zeros(B, dtype=jnp.int32)
+    false = jnp.zeros(B, dtype=bool)
+    g_ok = _slot(rows, unit, gkey, "ok") != 0
+    matched, bad = false, false
+    m_vs, m_vl, m_dec = zero, zero, false
+    pos = jnp.arange(klen, dtype=jnp.int32)[None, :]
+    for k in range(unit.layout.csr_slots):
+        st = _slot(rows, unit, gkey, f"s{k}_start")
+        nl = _slot(rows, unit, gkey, f"s{k}_nlen")
+        dc = _slot(rows, unit, gkey, f"s{k}_dec") != 0
+        nd = _slot(rows, unit, gkey, f"s{k}_ndec") != 0
+        nh = _slot(rows, unit, gkey, f"s{k}_nhigh") != 0
+        vs = _slot(rows, unit, gkey, f"s{k}_vstart")
+        vl = _slot(rows, unit, gkey, f"s{k}_vlen")
+        emitted = nl > 0
+        bad = bad | (emitted & (nd | nh))
+        is_m = emitted & (nl == klen)
+        idx = jnp.clip(st[:, None] + pos, 0, L - 1)
+        g = jnp.take_along_axis(buf, idx, axis=1).astype(jnp.int32)
+        upper = (g >= 0x41) & (g <= 0x5A)
+        folded = jnp.where(upper, g | 0x20, g)
+        is_m = is_m & jnp.all(folded == target[None, :], axis=1)
+        matched = matched | is_m
+        m_vs = jnp.where(is_m, vs, m_vs)
+        m_vl = jnp.where(is_m, vl, m_vl)
+        m_dec = jnp.where(is_m, dc, m_dec)
+    return g_ok, ~matched, m_vs, m_vl, bad | (matched & m_dec)
 
 
 def _prev(a: jnp.ndarray) -> jnp.ndarray:
@@ -363,6 +443,19 @@ def build_aggregate_fn(parser, spec: AggregateSpec):
                     if d is None:
                         continue
                     selu = winner == ui
+                    if d["plan"].kind == "qscsr":
+                        # Query-key lane: match + value span from the
+                        # packed CSR segment table; the lane's fold
+                        # verdict rides the ampfix carrier.
+                        q_ok, q_nul, q_vs, q_vl, q_fold = _qs_key_lane(
+                            rows, u, d, buf, L
+                        )
+                        s = jnp.where(selu, q_vs, s)
+                        ln = jnp.where(selu, q_vl, ln)
+                        ok = jnp.where(selu, q_ok, ok)
+                        nul = jnp.where(selu, q_nul, nul)
+                        ampfix = jnp.where(selu, q_fold, ampfix)
+                        continue
                     w = rows[
                         u.row_offset + u.layout.slots[p.op.field]["start"][0]
                     ]

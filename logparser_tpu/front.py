@@ -1779,8 +1779,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         stop.set()
 
     signal.signal(signal.SIGTERM, _on_sigterm)
-    from .tracing import arm_flight_signals, install_flight_excepthook
+    from .tracing import (
+        arm_flight_signals,
+        install_flight_excepthook,
+        sweep_flight_dumps,
+    )
 
+    sweep_flight_dumps()
     arm_flight_signals()
     install_flight_excepthook()
     front.start()

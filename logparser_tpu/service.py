@@ -2062,8 +2062,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     signal.signal(signal.SIGTERM, _on_sigterm)
     # SIGUSR2 -> non-fatal flight dump; fatal faults dump via excepthook.
-    from .tracing import arm_flight_signals, install_flight_excepthook
+    from .tracing import (
+        arm_flight_signals,
+        install_flight_excepthook,
+        sweep_flight_dumps,
+    )
 
+    sweep_flight_dumps()
     arm_flight_signals()
     install_flight_excepthook()
     LOG.info("parse service listening on %s:%d", svc.host, svc.port)

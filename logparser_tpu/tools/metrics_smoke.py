@@ -41,6 +41,11 @@ REQUIRED_SUBSTRINGS = (
     'logparser_tpu_stage_seconds_bucket{stage="assembly",le="+Inf"}',
     'logparser_tpu_stage_seconds_bucket{stage="ipc",le="+Inf"}',
     "logparser_tpu_oracle_routed_lines_total",
+    # Round-20 residual census: the per-field ledger of host_fields
+    # routing (which requested fields still force whole-line oracle
+    # routing) — driven below by requesting a host-only field.
+    'logparser_tpu_host_field_lines_total{'
+    'field="HTTP.PROTOCOL:request.firstline.protocol"}',
     "logparser_tpu_device_escaped_quote_lines_total",
     "logparser_tpu_service_requests_total",
     "logparser_tpu_parse_lines_total",
@@ -148,7 +153,11 @@ def main() -> int:
             svc.host, svc.port, "combined",
             # BYTES requested so the 20-digit line exercises the oracle
             # rescue route (device limb decode fails, host Long succeeds).
-            ["IP:connection.client.host", "BYTES:response.body.bytes"],
+            # HTTP.PROTOCOL is a host-only field (round-20 residual): it
+            # routes the valid lines with reason=host_fields and makes
+            # the per-field host_field_lines_total census move.
+            ["IP:connection.client.host", "BYTES:response.body.bytes",
+             "HTTP.PROTOCOL:request.firstline.protocol"],
         ) as client:
             table = client.parse(lines)
             assert table.num_rows == len(lines)

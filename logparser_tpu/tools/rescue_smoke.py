@@ -18,6 +18,13 @@ new boundary:
   byte parity vs the per-line oracle, count every forced line in
   ``device_escaped_quote_lines_total``, and expose that counter on
   ``/metrics``.
+- leg 3 (round 20, URI fields on device): with ``HTTP.PATH`` + a query
+  key requested, a 5% forced repair-needing-URI corpus (fragment +
+  ``;`` — repair stages the device cannot reproduce) must route EXACTLY
+  those rows (reason ``device_reject``, zero ``host_fields`` — the
+  covered URI set no longer forces whole-line oracle routing), move
+  ``oracle_routed_lines_total`` by exactly that count, and deliver both
+  URI fields byte-identically on the rescued AND the device-parsed rows.
 
 Usage::
 
@@ -80,6 +87,29 @@ def build_escaped_corpus():
     for i in range(0, len(base), 20):
         base[i] = re.sub(r'"([^"]*)"$', r'"esc \\" quote \1"', base[i],
                          count=1)
+        forced.append(i)
+    return base, forced
+
+
+URI_FIELDS = FIELDS + ["HTTP.PATH:request.firstline.uri.path",
+                       "STRING:request.firstline.uri.query.q"]
+
+
+def build_uri_corpus():
+    """Leg-3 corpus: 5% repair-needing URIs — a fragment plus a ``;``
+    (HTML-entity unescape + fragment-artifact rewrites the device cannot
+    reproduce) — the rest clean demolog traffic whose path + query keys
+    dissect fully on device."""
+    from logparser_tpu.tools.demolog import generate_combined_lines
+
+    base = generate_combined_lines(N_LINES, seed=92)
+    forced = []
+    for i in range(0, len(base), 20):
+        base[i] = re.sub(
+            r'"(\S+) \S+ HTTP',
+            r'"\1 /account;v=2/search?q=caf%C3%A9+x#top HTTP',
+            base[i], count=1,
+        )
         forced.append(i)
     return base, forced
 
@@ -194,6 +224,49 @@ def main() -> int:
             )
             break
 
+    # ---- leg 3: URI fields on device, repair-needing tail rescued ----
+    uri_lines, uri_forced = build_uri_corpus()
+    uri_parser = TpuBatchParser("combined", URI_FIELDS)
+    uri_parser.parse_batch(uri_lines)  # warm
+    uri_before = _routed_total()
+    uri_result = uri_parser.parse_batch(uri_lines)
+    uri_after = _routed_total()
+    uri_reasons = uri_result.rescue_reasons
+    if (uri_result.oracle_rows != len(uri_forced)
+            or uri_reasons.get("host_fields", 0)
+            or uri_reasons.get("device_reject", 0) != len(uri_forced)):
+        errors.append(
+            "URI leg routing off: "
+            f"rows={uri_result.oracle_rows} reasons={uri_reasons} "
+            f"(expected exactly the {len(uri_forced)} repair-needing "
+            "URIs as device_reject, zero host_fields)"
+        )
+    if uri_after - uri_before != len(uri_forced):
+        errors.append(
+            f"oracle_routed_lines_total moved {uri_before} -> {uri_after} "
+            f"(expected +{len(uri_forced)} for the forced URI rows)"
+        )
+    uri_cols = {f: uri_result.to_pylist(f) for f in URI_FIELDS[-2:]}
+    # Byte parity on both sides of the boundary: rescued rows AND the
+    # device-dissected neighbours.
+    for i in uri_forced[: 6] + [j + 1 for j in uri_forced[: 6]]:
+        try:
+            rec = uri_parser.oracle.parse(uri_lines[i], _CollectingRecord())
+        except DissectionFailure:
+            errors.append(f"URI line {i} not host-parseable")
+            break
+        for fid, col in uri_cols.items():
+            want = rec.values.get(fid)
+            if not uri_result.valid[i] or col[i] != want:
+                errors.append(
+                    f"URI row {i} field {fid} not byte-identical: "
+                    f"{col[i]!r} != {want!r}"
+                )
+                break
+        else:
+            continue
+        break
+
     # (d) /metrics exposes the per-reason rescue counters AND the new
     # escaped-quote counter (live scrape, strict exposition grammar).
     from logparser_tpu.service import ParseService, ParseServiceClient
@@ -231,7 +304,9 @@ def main() -> int:
         f"rescue {rescue_rate:.0f} lines/s, "
         f"effective {effective:.0f} lines/s; "
         f"escaped-quote leg: 0 routed, "
-        f"{esc_result.escaped_quote_rows} device-decoded, "
+        f"{esc_result.escaped_quote_rows} device-decoded; "
+        f"URI leg: {uri_result.oracle_rows}/{len(uri_forced)} "
+        "repair-needing rescued, 0 host_fields; "
         "/metrics well-formed"
     )
     return 0

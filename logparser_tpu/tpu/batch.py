@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 import os
+import threading
 
 from ..core.casts import Cast
 from ..core.exceptions import DissectionFailure, OracleEngineError
@@ -104,6 +105,120 @@ def _fix_uri_part(value: str, mode: str) -> str:
     if mode in ("path", "userinfo"):
         value = _percent_decode(value)
     return value
+
+
+# Hex digit -> value (255 = not a hex digit), for the vectorized CSR
+# value decode below.
+_HEX_VAL = np.full(256, 255, dtype=np.uint8)
+for _c in b"0123456789":
+    _HEX_VAL[_c] = _c - ord("0")
+for _c in b"abcdef":
+    _HEX_VAL[_c] = _c - ord("a") + 10
+for _c in b"ABCDEF":
+    _HEX_VAL[_c] = _c - ord("A") + 10
+del _c
+
+# Label-bounded field names for host_field_lines_total{field}: the first
+# _MAX_FIELD_LABELS distinct requested fields keep their own label, the
+# tail collapses to "overflow" (same discipline as the front's key/tenant
+# labels) so a hostile field list can't explode the registry.
+_MAX_FIELD_LABELS = 64
+_FIELD_LABEL_POOL: set = set()
+_FIELD_LABEL_LOCK = threading.Lock()
+
+
+def _bounded_field_label(fid: str) -> str:
+    with _FIELD_LABEL_LOCK:
+        if fid in _FIELD_LABEL_POOL:
+            return fid
+        if len(_FIELD_LABEL_POOL) < _MAX_FIELD_LABELS:
+            _FIELD_LABEL_POOL.add(fid)
+            return fid
+        return "overflow"
+
+
+def _qs_value_decode(bts, off):
+    """Vectorized '+'/percent decode of concatenated value segments.
+
+    ``bts`` is the raw bytes of n segments back to back; ``off`` the
+    [n+1] int64 segment offsets.  Per byte: '+' -> 0x20, '%' followed by
+    two same-segment hex digits -> the decoded byte (the two digits are
+    consumed), anything else verbatim — the left-to-right rule of
+    repair-then-URLDecode on a query value ('%' is not a hex digit, so
+    escape starts can never overlap and the sequential scan vectorizes
+    exactly).  Returns ``(decoded bytes, decoded offsets, bad)`` where
+    ``bad[k]`` marks segments the rule does NOT cover for DIRECT token
+    captures: a '%' without two in-segment hex digits (the un-repaired
+    host decoder may chop it, raise ValueError, or read a %uXXXX UTF-16
+    escape) or a raw byte >= 0x80 (URI-chain segments are clean ASCII by
+    the split discipline; direct captures are not)."""
+    n = len(off) - 1
+    total = int(off[-1])
+    if total == 0:
+        return (np.zeros(0, dtype=np.uint8), np.zeros(n + 1, dtype=np.int64),
+                np.zeros(n, dtype=bool))
+    lens = np.diff(off)
+    seg_id = np.repeat(np.arange(n, dtype=np.int64), lens)
+    seg_end = np.repeat(off[1:], lens)
+    pos = np.arange(total, dtype=np.int64)
+    hexv = _HEX_VAL[bts]
+    is_hex = hexv < 16
+    is_pct = bts == 0x25
+    i1 = np.minimum(pos + 1, total - 1)
+    i2 = np.minimum(pos + 2, total - 1)
+    start = is_pct & (pos + 2 < seg_end) & is_hex[i1] & is_hex[i2]
+    consumed = np.zeros(total, dtype=bool)
+    consumed[1:] |= start[:-1]
+    consumed[2:] |= start[:-2]
+    out = np.where(bts == 0x2B, np.uint8(0x20), bts)
+    out = np.where(
+        start, (hexv[i1].astype(np.uint8) << 4) | hexv[i2], out
+    ).astype(np.uint8)
+    bad_b = (is_pct & ~start) | (bts >= 0x80)
+    bad = np.zeros(n, dtype=bool)
+    if bad_b.any():
+        bad = np.bincount(seg_id[bad_b], minlength=n) > 0
+    keep = ~consumed
+    new_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.bincount(seg_id[keep], minlength=n), out=new_off[1:])
+    return out[keep], new_off, bad
+
+
+def _latin1_to_utf8(bts, off):
+    """Transcode decoded (latin-1 semantics) segment bytes to UTF-8 so
+    they can ride the wildcard flat value buffer (whose consumers decode
+    UTF-8): each byte < 0x80 passes through, each byte >= 0x80 expands
+    to the two-byte UTF-8 form of U+0080..U+00FF."""
+    hi = bts >= 0x80
+    if not hi.any():
+        return bts, off
+    n = len(off) - 1
+    lens = np.diff(off)
+    seg_id = np.repeat(np.arange(n, dtype=np.int64), lens)
+    width = 1 + hi.astype(np.int64)
+    dst = np.cumsum(width) - width
+    out = np.empty(int(dst[-1] + width[-1]) if len(dst) else 0,
+                   dtype=np.uint8)
+    out[dst] = np.where(hi, 0xC0 | (bts >> 6), bts)
+    out[dst[hi] + 1] = 0x80 | (bts[hi] & 0x3F)
+    extra = np.bincount(seg_id[hi], minlength=n)
+    new_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens + extra, out=new_off[1:])
+    return out, new_off
+
+
+def _seg_scatter(dst, dst_off, src, src_off, lens):
+    """Copy n variable-length segments src[src_off[k]:+lens[k]] ->
+    dst[dst_off[k]:+lens[k]] with one gather/scatter pair."""
+    total = int(lens.sum())
+    if total == 0:
+        return
+    cum = np.zeros(len(lens) + 1, dtype=np.int64)
+    np.cumsum(lens, out=cum[1:])
+    ar = np.arange(total, dtype=np.int64)
+    dst[np.repeat(dst_off - cum[:-1], lens) + ar] = (
+        src[np.repeat(src_off - cum[:-1], lens) + ar]
+    )
 
 
 class _CollectingRecord:
@@ -3081,6 +3196,28 @@ class TpuBatchParser:
                 if n:
                     reg.increment("oracle_routed_lines_total", n,
                                   labels={"reason": reason})
+            # Per-field census of the host_fields residual: which requested
+            # fields are still forcing whole-line oracle routing.  A row on
+            # the host_fields path charges every oracle field of its winning
+            # unit — the set the next device lane must cover to free it.
+            if rescue_reasons["host_fields"]:
+                hf = np.fromiter(
+                    (need_oracle - invalid_rows - overflow_rows),
+                    dtype=np.int64,
+                )
+                hf_win = winner[hf]
+                cnt = np.bincount(
+                    hf_win[hf_win >= 0], minlength=len(self.units)
+                )
+                for ui, flds in enumerate(self._unit_oracle_fields):
+                    n_unit = int(cnt[ui]) if ui < cnt.shape[0] else 0
+                    if not n_unit or not flds:
+                        continue
+                    for fid in flds:
+                        reg.increment(
+                            "host_field_lines_total", n_unit,
+                            labels={"field": _bounded_field_label(fid)},
+                        )
         t_oracle = time.perf_counter()
         oracle_rows_sorted = sorted(need_oracle)
         results_by_row = dict(zip(rescue_rows, collect_rescue()))
@@ -3336,14 +3473,42 @@ class TpuBatchParser:
                     e |= (last <= 0x20) | (last >= 0x80)
                     return has & e
 
+                def direct_hard(fl):
+                    # Direct-capture rows whose flagged values the
+                    # vectorized left-to-right decode cannot prove: a
+                    # '%' without two in-segment hex digits (the
+                    # un-repaired host decoder may chop it, raise, or
+                    # read %uXXXX as UTF-16) or a raw byte >= 0x80.
+                    hard = np.zeros(fl.shape[1], dtype=bool)
+                    fk, fj = np.nonzero(fl)
+                    if fk.size == 0:
+                        return hard
+                    v_l = np.where(HE[fk, fj], VL[fk, fj], 0).astype(
+                        np.int64
+                    )
+                    f_off = np.zeros(fk.size + 1, dtype=np.int64)
+                    np.cumsum(v_l, out=f_off[1:])
+                    gidx = np.repeat(
+                        (rows[fj] * L + VS[fk, fj]).astype(np.int64)
+                        - f_off[:-1], v_l,
+                    ) + np.arange(int(f_off[-1]), dtype=np.int64)
+                    _, _, bad = _qs_value_decode(buf_flat[gidx], f_off)
+                    hard[fj[bad]] = True
+                    return hard
+
                 if setcookie:
                     flag = edge(SS, NL)
                 elif cookie:
                     flag = DC | edge(SS, NL) | edge(VS, VL)
                 elif uri_chain:
-                    flag = DC | ND
+                    # Names needing %-repair keep the per-row loop;
+                    # flagged VALUES decode in the vectorized lane below
+                    # (device-valid uri-chain segments are clean ASCII
+                    # by the split discipline, so the left-to-right
+                    # rule is exact).
+                    flag = ND
                 else:
-                    flag = DC
+                    flag = DC & direct_hard(DC & emit)[None, :]
                 flag &= emit
                 row_flag = flag.any(axis=0)
                 vrows = rows[~row_flag]
@@ -3392,6 +3557,50 @@ class TpuBatchParser:
                     s_row = s_ss = s_nl = s_vs = s_vl = np.empty(
                         0, dtype=np.int64
                     )
+
+                # ---- vectorized value decode: flagged (%/+/encode-set)
+                # values of query chains decode here with compact
+                # gathers — the exact fix+resilientUrlDecode result for
+                # the segment classes proven above; only name repair,
+                # cookie edge trims, and hard direct escapes still pay
+                # the per-row loop.
+                dec_pos = np.full(n_seg, -1, dtype=np.int64)
+                darr = np.zeros(0, dtype=np.uint8)
+                d_off = np.zeros(1, dtype=np.int64)
+                if n_seg and not (cookie or setcookie):
+                    s_dc = DC[:, ~row_flag][sub]
+                    dec_idx = np.nonzero(s_dc)[0]
+                    if dec_idx.size:
+                        dec_pos[dec_idx] = np.arange(dec_idx.size)
+                        fl_l = s_vl[dec_idx].astype(np.int64)
+                        f_off = np.zeros(dec_idx.size + 1, dtype=np.int64)
+                        np.cumsum(fl_l, out=f_off[1:])
+                        gidx = np.repeat(
+                            (s_row[dec_idx] * L + s_vs[dec_idx]).astype(
+                                np.int64
+                            ) - f_off[:-1], fl_l,
+                        ) + np.arange(int(f_off[-1]), dtype=np.int64)
+                        darr, d_off, _ = _qs_value_decode(
+                            buf_flat[gidx], f_off
+                        )
+                        if need_dicts:
+                            # Splice the decoded (UTF-8-transcoded)
+                            # bytes into the flat wildcard value buffer
+                            # in place of the raw spans.
+                            uarr, u_off = _latin1_to_utf8(darr, d_off)
+                            vb_np = np.frombuffer(vb, dtype=np.uint8)
+                            lens = np.diff(nov)
+                            lens2 = lens.copy()
+                            lens2[dec_idx] = np.diff(u_off)
+                            nov2 = np.zeros_like(nov)
+                            np.cumsum(lens2, out=nov2[1:])
+                            new_vb = np.empty(int(nov2[-1]), dtype=np.uint8)
+                            keep_i = np.nonzero(~s_dc)[0]
+                            _seg_scatter(new_vb, nov2[keep_i], vb_np,
+                                         nov[keep_i], lens[keep_i])
+                            _seg_scatter(new_vb, nov2[dec_idx], uarr,
+                                         u_off[:-1], lens2[dec_idx])
+                            vb, nov = new_vb.tobytes(), nov2
 
                 def match_comp(comp: str) -> np.ndarray:
                     # Byte-wise name match with ASCII case fold; Python
@@ -3442,7 +3651,8 @@ class TpuBatchParser:
                             # Remapped screen-resolution param: split the
                             # matched segment's value host-side.
                             self._deliver_sres_attr(
-                                fid, p, m, s_row, s_vs, s_vl, buf, overrides
+                                fid, p, m, s_row, s_vs, s_vl, buf, overrides,
+                                decoded=(dec_pos, darr, d_off),
                             )
                             continue
                         # Per-cookie attribute: parse the matched cookie's
@@ -3464,6 +3674,17 @@ class TpuBatchParser:
                         col["starts"][mr] = s_vs[m]
                         col["ends"][mr] = s_vs[m] + s_vl[m]
                         col["null"][mr] = False
+                        # Rows whose LAST matched segment was decoded
+                        # deliver the decoded value via override — span
+                        # columns can only point at raw buffer bytes.
+                        last = np.ones(m.size, dtype=bool)
+                        if m.size > 1:
+                            last[:-1] = mr[:-1] != mr[1:]
+                        for j in m[last & (dec_pos[m] >= 0)].tolist():
+                            jj = int(dec_pos[j])
+                            overrides[fid][int(s_row[j])] = bytes(
+                                darr[d_off[jj]:d_off[jj + 1]]
+                            ).decode("latin-1")
 
                 # ---- per-row fallback: decode/repair/trim segments ------
                 if py_rows.size:
@@ -3513,26 +3734,37 @@ class TpuBatchParser:
         return None
 
     @staticmethod
-    def _last_matched_texts(m, s_row, s_vs, s_vl, buf):
+    def _last_matched_texts(m, s_row, s_vs, s_vl, buf, decoded=None):
         """Yield (row, segment text) for the LAST matched segment per row
         — the host cache-overwrite rule shared by every qscsr attr
-        delivery (duplicate same-name segments dissect only the last)."""
+        delivery (duplicate same-name segments dissect only the last).
+        ``decoded`` = (dec_pos, darr, d_off) supplies the vector-decoded
+        value for segments the flat lane already url-decoded."""
         last: Dict[int, int] = {}
         for j in m.tolist():
             last[int(s_row[j])] = j
         for row, j in last.items():
+            if decoded is not None and decoded[0][j] >= 0:
+                dec_pos, darr, d_off = decoded
+                jj = int(dec_pos[j])
+                yield row, bytes(darr[d_off[jj]:d_off[jj + 1]]).decode(
+                    "latin-1"
+                )
+                continue
             v0 = int(s_vs[j])
             yield row, bytes(buf[row, v0 : v0 + int(s_vl[j])]).decode(
                 "utf-8", "replace"
             )
 
     def _deliver_sres_attr(
-        self, fid, p, m, s_row, s_vs, s_vl, buf, overrides
+        self, fid, p, m, s_row, s_vs, s_vl, buf, overrides, decoded=None
     ) -> None:
         """Deliver a remapped screen-resolution width/height for matched
         segments."""
         tgt = overrides[fid]
-        for row, value in self._last_matched_texts(m, s_row, s_vs, s_vl, buf):
+        for row, value in self._last_matched_texts(
+            m, s_row, s_vs, s_vl, buf, decoded
+        ):
             out = self._sres_value(p.attr, value)
             if out is not None:
                 tgt[row] = self._coerce_casts(fid, out)

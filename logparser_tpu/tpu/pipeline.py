@@ -643,6 +643,23 @@ def ts_group_key(plan: FieldPlan) -> str:
 CSR_SLOTS = 16
 CSR_SLOTS_MAX = 128
 
+# CSR scan-window budget, in span bytes per segment slot.  split_csr runs
+# its scans over a compact [B, slots * CSR_WINDOW_PER_SLOT] gather of the
+# span instead of the full padded line — spans (query strings, cookie
+# headers) are tiny next to L, and the scans are the kernel's dominant
+# cost.  A span longer than the window raises the same CSR_OVERFLOW_BIT
+# as running out of slots, and the same adaptive response (double the
+# slots, window scales along) resolves it; at CSR_SLOTS_MAX the window
+# covers 1024 bytes and longer spans stay oracle-bound, exactly like
+# slot exhaustion.
+CSR_WINDOW_PER_SLOT = 8
+
+# Scan-window budget for the URI fast split (path + query + authority in
+# one span, so roomier than a lone query string): 12 bytes/slot puts the
+# default window at 192 — 2.6x the realistic corpus's longest URI — and
+# the CSR_SLOTS_MAX regrow at 1536, past any padded line bucket.
+URI_WINDOW_PER_SLOT = 12
+
 # row 0 bit assignments (see compute_rows): bit 0 = line validity, bit 1 =
 # plausibility (multi-format winner protocol), bit 2 = CSR slot overflow,
 # bit 3 = the valid line's quoted-field split consumed a backslash-escaped
@@ -774,6 +791,7 @@ class PackedLayout:
                         slots[f"s{k}_eq"] = (rn, 2 * _SPAN_BITS, 1)
                         slots[f"s{k}_dec"] = (rn, 2 * _SPAN_BITS + 1, 1)
                         slots[f"s{k}_ndec"] = (rn, 2 * _SPAN_BITS + 2, 1)
+                        slots[f"s{k}_nhigh"] = (rn, 2 * _SPAN_BITS + 3, 1)
                         slots[f"s{k}_vstart"] = (rv, 0, _SPAN_BITS)
                         slots[f"s{k}_vlen"] = (rv, _SPAN_BITS, _SPAN_BITS)
                     layout.slots[key] = slots
@@ -967,11 +985,19 @@ def compute_rows(
                 uri = postproc.split_uri_fast(
                     b32, s, e, extract=extract, dash=dash,
                     need_authority=need_authority,
+                    window=URI_WINDOW_PER_SLOT * layout.csr_slots,
                 )
                 uri_cache[cache_key] = uri
                 # Repair-needing URIs fail the line (unless the chain
                 # already produced nothing to repair).
                 line_constraints.append(uri["ok"] | ~ok)
+                # Span longer than the scan window: the same capacity
+                # defer as CSR slot exhaustion — raise the overflow bit
+                # (adaptive slot growth scales the window along) and
+                # fail the line so it rides the batched rescue.
+                uri_over = uri["overflow"] & ok
+                csr_overflow_rows.append(uri_over)
+                line_constraints.append(~uri_over)
             step_ok = ok & uri["ok"]
             if part == "path":
                 return (
@@ -1193,6 +1219,7 @@ def compute_rows(
                 # bytes flag the per-row path.  Direct token captures
                 # (nginx $args) and cookies are raw header text: no.
                 uri_encoded=bool(plan.steps) and plan.steps[-1][0] == "uri",
+                window=CSR_WINDOW_PER_SLOT * layout.csr_slots,
             )
             if not plan.steps:
                 # Direct token capture of the query string: CLF null ->
@@ -1212,6 +1239,7 @@ def compute_rows(
                 put(key, f"s{k}_eq", jnp.where(has_eq, 1, 0))
                 put(key, f"s{k}_dec", jnp.where(csr["decode"][k], 1, 0))
                 put(key, f"s{k}_ndec", jnp.where(csr["name_pct"][k], 1, 0))
+                put(key, f"s{k}_nhigh", jnp.where(csr["name_high"][k], 1, 0))
                 put(key, f"s{k}_vstart", jnp.where(has_eq, vstart, 0))
                 put(key, f"s{k}_vlen", vlen)
             put(key, "ok", jnp.where(chain_ok, 1, 0))

@@ -16,7 +16,9 @@ output math happens host-side in ``tpu/timefields.py``.
 """
 from __future__ import annotations
 
-from typing import Dict, Tuple
+import functools
+
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -212,6 +214,7 @@ def split_uri_fast(
     extract=None,
     dash=None,
     need_authority: bool = True,
+    window: Optional[int] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Fast-path URI split: repair-free URIs -> sub-spans on device.
 
@@ -271,9 +274,41 @@ def split_uri_fast(
     delivers nothing).  The query span keeps its leading separator byte;
     when that byte is ``?`` the host delivers it as ``&`` (the ?&
     normalization) — the ``amp`` flag tells the materializer to swap it.
+
+    ``window`` bounds the scan domain exactly as in :func:`split_csr`: the
+    URI span is gathered into a compact [B, window] buffer, the split runs
+    there, and every positional output is rebased by the span start.  Rows
+    whose span exceeds the window raise ``overflow`` (with ``ok`` held
+    True so they route as a capacity defer, not a device reject); the
+    caller folds that into the adaptive CSR response — doubled slots scale
+    the window along, so long-URI corpora pay bounded recompiles, and at
+    the slot cap the rows stay oracle-bound.  Outputs are bit-identical to
+    the unwindowed split for every row that fits.
     """
-    extract = extract or gather_span_bytes
     B, L = buf.shape
+    if window is not None and int(window) < L:
+        W = int(window)
+        span = end - start
+        widx = jnp.clip(
+            start[:, None] + jax.lax.broadcasted_iota(jnp.int32, (1, W), 1),
+            0, L - 1,
+        )
+        wbuf = jnp.take_along_axis(buf, jnp.broadcast_to(widx, (B, W)), axis=1)
+        res = split_uri_fast(
+            wbuf,
+            jnp.zeros_like(start),
+            jnp.minimum(span, W),
+            dash=dash,
+            need_authority=need_authority,
+        )
+        for name, v in list(res.items()):
+            if name.endswith("_start") or name.endswith("_end"):
+                res[name] = v + start
+        over = span > W
+        res["ok"] = res["ok"] | over
+        res["overflow"] = over
+        return res
+    extract = extract or gather_span_bytes
     pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
     in_span = (pos >= start[:, None]) & (pos < end[:, None])
     width = end - start
@@ -493,6 +528,7 @@ def split_uri_fast(
     port_s, port_e = span(port_show, port_start, auth_end)
     return {
         "ok": ok,
+        "overflow": jnp.zeros(B, dtype=bool),
         "all_null": all_null,
         "path_start": jnp.where(all_null, zero_span, path_begin),
         "path_end": jnp.where(all_null, zero_span, jnp.maximum(first_sep, path_begin)),
@@ -577,6 +613,30 @@ def parse_ipv4_spans(
     return value, ok, has_colon
 
 
+@functools.lru_cache(maxsize=None)
+def _csr_class_table(
+    sep_byte: Optional[int], kv: int, uri_encoded: bool
+) -> np.ndarray:
+    """256-entry byte-class table for split_csr: bit 0 = value-decode
+    trigger (%/+ and, uri_encoded, the printable encode set), bit 1 =
+    name-escape trigger (% / encode set), bit 2 = high byte, bit 3 = the
+    kv byte, bit 4 = a single-byte separator.  One gather through this
+    table replaces ~20 per-byte compare/or passes over the span."""
+    t = np.zeros(256, dtype=np.uint8)
+    t[ord("%")] |= 1 | 2
+    t[ord("+")] |= 1
+    t[0x80:] |= 4
+    if uri_encoded:
+        from ..dissectors.uri import ENCODE_PRINTABLE
+
+        for ch in ENCODE_PRINTABLE:
+            t[ch] |= 1 | 2
+    t[kv] |= 8
+    if sep_byte is not None:
+        t[sep_byte] |= 16
+    return t
+
+
 def split_csr(
     buf: jnp.ndarray,
     start: jnp.ndarray,
@@ -585,6 +645,7 @@ def split_csr(
     sep: bytes = b"&",
     kv: int = ord("="),
     uri_encoded: bool = False,
+    window: Optional[int] = None,
 ) -> Dict[str, object]:
     """CSR segment split of spans on device: the vectorized core of the
     wildcard dissectors (QueryStringFieldDissector.java:76-108 splits on
@@ -601,66 +662,166 @@ def split_csr(
     Empty segments keep their slot (the host skips them at materialization);
     compaction on a SIMD machine would cost a sort, skipping on host costs
     nothing.
+
+    ``window`` bounds the scan domain: the span bytes are gathered into a
+    compact [B, window] buffer and every [.,L]-wide plane above shrinks to
+    [., window] — the scans are the kernel cost, and spans (query strings,
+    cookie headers) are tiny next to the padded line length.  Rows whose
+    span exceeds the window raise ``overflow`` — the same exact capacity
+    defer as running out of slots, and the caller's adaptive response
+    (double the slots, which callers scale the window by) resolves both.
+    Windowed outputs are bit-identical to the unwindowed split for every
+    row that fits: the core sees the same span bytes at a rebased origin.
     """
     B, L = buf.shape
+    if window is not None and int(window) < L:
+        W = int(window)
+        span = end - start
+        widx = jnp.clip(
+            start[:, None] + jax.lax.broadcasted_iota(jnp.int32, (1, W), 1),
+            0, L - 1,
+        )
+        wbuf = jnp.take_along_axis(buf, jnp.broadcast_to(widx, (B, W)), axis=1)
+        res = split_csr(
+            wbuf,
+            jnp.zeros_like(start),
+            jnp.minimum(span, W),
+            max_segments,
+            sep=sep,
+            kv=kv,
+            uri_encoded=uri_encoded,
+        )
+        for name in ("seg_start", "seg_end", "eq_pos"):
+            res[name] = [v + start for v in res[name]]
+        res["overflow"] = res["overflow"] | (span > W)
+        return res
     n_sep = len(sep)
     shift = shift_zero
     pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
     in_span = (pos >= start[:, None]) & (pos < end[:, None])
-    is_sep = None
-    for k, byte in enumerate(sep):
-        part = shift(buf, k) == np.uint8(byte) if k else (buf == np.uint8(byte))
-        is_sep = part if is_sep is None else (is_sep & part)
+    # Byte classes via ONE table gather (uri_encoded folds the printable
+    # encode set into the dec/pct planes: query strings reach the host
+    # dissector AFTER the URI encode step, so segments holding encode-set
+    # bytes differ from the raw device span — names stay
+    # %-escaped-and-lowercased, values escape then resilient-decode —
+    # and take the per-row path alongside %/+).
+    cls = jnp.asarray(
+        _csr_class_table(sep[0] if n_sep == 1 else None, kv, uri_encoded)
+    )[buf]
+    if n_sep == 1:
+        is_sep = (cls & 16) != 0
+    else:
+        is_sep = None
+        for k, byte in enumerate(sep):
+            part = (
+                shift(buf, k) == np.uint8(byte) if k else (buf == np.uint8(byte))
+            )
+            is_sep = part if is_sep is None else (is_sep & part)
     is_sep = is_sep & in_span & (pos + n_sep <= end[:, None])
-    is_kv = (buf == np.uint8(kv)) & in_span
-    is_dec = (
-        (buf == np.uint8(ord("%"))) | (buf == np.uint8(ord("+")))
-    ) & in_span
+    is_kv = ((cls & 8) != 0) & in_span
+    is_dec = ((cls & 1) != 0) & in_span
+    is_pct = ((cls & 2) != 0) & in_span
+    is_high = ((cls & 4) != 0) & in_span
 
-    is_pct = (buf == np.uint8(ord("%"))) & in_span
-    if uri_encoded:
-        # Query strings reach the host dissector AFTER the URI encode
-        # step, so segments holding printable encode-set bytes differ
-        # from the raw device span (names stay %-escaped-and-lowercased,
-        # values escape then resilient-decode) — flag them for the
-        # per-row path alongside %/+.
-        from ..dissectors.uri import ENCODE_PRINTABLE
+    # Slot-invariant precomputation (the round-20 restructure): the
+    # original per-slot scans rebuilt ~16 [B, L] masks + reductions per
+    # slot (256 full-array passes at 16 slots — the dominant kernel cost
+    # once concrete query keys made CSR groups routine).  Every per-slot
+    # quantity is a "first occurrence at/after cursor" or a "count in a
+    # sub-range", so ONE suffix-min per occurrence plane and ONE
+    # exclusive prefix-count per flag plane replace them; each slot then
+    # costs a handful of [B]-sized gathers.  Outputs are bit-identical
+    # to the sequential scan by construction.
+    masked_sep = jnp.where(is_sep, pos, L)
+    suffix_sep = jax.lax.cummin(masked_sep, axis=1, reverse=True)
+    masked_kv = jnp.where(is_kv, pos, L)
+    suffix_kv = jax.lax.cummin(masked_kv, axis=1, reverse=True)
 
-        for ch in ENCODE_PRINTABLE:
-            m = (buf == np.uint8(ch)) & in_span
-            is_dec = is_dec | m
-            is_pct = is_pct | m
+    def _excount(m):
+        # c[:, i] = occurrences in [0, i) — exclusive prefix count.
+        c = jnp.cumsum(m.astype(jnp.int32), axis=1)
+        return jnp.pad(c, ((0, 0), (1, 0)))
+
+    # The three flag planes pack into ONE scan when per-plane counts fit
+    # 10 bits (always true under a window): every field of the packed
+    # exclusive count is non-decreasing, so field-wise differences cannot
+    # borrow across fields — one cumsum + four gathers replace three + six.
+    packed = None
+    if L < 1024:
+        packed = _excount(
+            is_dec.astype(jnp.int32)
+            | (is_pct.astype(jnp.int32) << 10)
+            | (is_high.astype(jnp.int32) << 20)
+        )
+    else:
+        cum_dec = _excount(is_dec)
+        cum_pct = _excount(is_pct)
+        cum_high = _excount(is_high)
+
+    def _gat(mat, idx, fill, width):
+        v = jnp.take_along_axis(
+            mat, jnp.clip(idx, 0, width - 1)[:, None], axis=1
+        )[:, 0]
+        return jnp.where(idx >= width, fill, v)
 
     seg_start: list = []
     seg_end: list = []
     eq_pos: list = []
     decode: list = []
     name_pct: list = []
+    name_high: list = []
     cursor = start
     for _ in range(max_segments):
-        usable = is_sep & (pos >= cursor[:, None])
-        nxt = jnp.min(jnp.where(usable, pos, L), axis=1).astype(jnp.int32)
+        # First separator at/after cursor; first kv byte at/after cursor
+        # clamped into the segment (kv bytes of earlier segments are all
+        # below cursor — it advances past each terminator).
+        nxt = _gat(suffix_sep, cursor, L, L)
         s_end = jnp.minimum(nxt, end)
-        eq_usable = is_kv & (pos >= cursor[:, None]) & (pos < s_end[:, None])
-        eq = jnp.min(jnp.where(eq_usable, pos, L), axis=1).astype(jnp.int32)
-        eq = jnp.minimum(eq, s_end)
-        dec_usable = is_dec & (pos > eq[:, None]) & (pos < s_end[:, None])
-        np_usable = is_pct & (pos >= cursor[:, None]) & (pos < eq[:, None])
+        eq = jnp.minimum(_gat(suffix_kv, cursor, L, L), s_end)
+        # decode: any %/+ in the value range (eq, s_end); name flags:
+        # any %-ish / high byte in the name range [cursor, eq).  Range
+        # bounds are clamped so empty/trailing slots count zero.
+        if packed is not None:
+            val_d = (
+                _gat(packed, s_end, 0, L + 1)
+                - _gat(packed, jnp.minimum(eq + 1, s_end), 0, L + 1)
+            )
+            nam_d = (
+                _gat(packed, eq, 0, L + 1)
+                - _gat(packed, jnp.minimum(cursor, eq), 0, L + 1)
+            )
+            dec_cnt = val_d & 0x3FF
+            np_cnt = (nam_d >> 10) & 0x3FF
+            nh_cnt = nam_d >> 20
+        else:
+            dec_cnt = (
+                _gat(cum_dec, s_end, 0, L + 1)
+                - _gat(cum_dec, jnp.minimum(eq + 1, s_end), 0, L + 1)
+            )
+            np_cnt = (
+                _gat(cum_pct, eq, 0, L + 1)
+                - _gat(cum_pct, jnp.minimum(cursor, eq), 0, L + 1)
+            )
+            nh_cnt = (
+                _gat(cum_high, eq, 0, L + 1)
+                - _gat(cum_high, jnp.minimum(cursor, eq), 0, L + 1)
+            )
         seg_start.append(cursor)
         seg_end.append(s_end)
         eq_pos.append(eq)
-        decode.append(jnp.any(dec_usable, axis=1))
-        name_pct.append(jnp.any(np_usable, axis=1))
+        decode.append(dec_cnt > 0)
+        name_pct.append(np_cnt > 0)
+        name_high.append(nh_cnt > 0)
         cursor = s_end + n_sep
     # One more separator past the last slot = segments we cannot ship.
-    usable = is_sep & (pos >= cursor[:, None])
-    has_more = jnp.any(usable, axis=1) | (cursor < end)
+    has_more = (_gat(suffix_sep, cursor, L, L) < L) | (cursor < end)
     return {
         "seg_start": seg_start,
         "seg_end": seg_end,
         "eq_pos": eq_pos,
         "decode": decode,
         "name_pct": name_pct,
+        "name_high": name_high,
         "overflow": has_more,
     }
 

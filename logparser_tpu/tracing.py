@@ -34,8 +34,10 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import re
 import secrets
 import sys
+import tempfile
 import threading
 import time
 from collections import deque
@@ -64,6 +66,7 @@ __all__ = [
     "flightz_payload",
     "dump_flight",
     "flight_dump_path",
+    "sweep_flight_dumps",
     "arm_flight_signals",
     "install_flight_excepthook",
     "reset_for_tests",
@@ -463,11 +466,19 @@ def flightz_payload(reason: Optional[str] = None) -> Dict[str, Any]:
     return payload
 
 
+def flight_dir() -> str:
+    """The dump directory: ``$LOGPARSER_TPU_FLIGHT_DIR``, defaulting to
+    a per-machine run directory under the system temp root (dumps used
+    to land in cwd, which litters whatever directory a CLI happened to
+    start in)."""
+    base = os.environ.get("LOGPARSER_TPU_FLIGHT_DIR", "").strip()
+    return base or os.path.join(tempfile.gettempdir(), "logparser_tpu-flight")
+
+
 def flight_dump_path(pid: Optional[int] = None) -> str:
     """Where a dump for ``pid`` (default: this process) lands:
-    ``$LOGPARSER_TPU_FLIGHT_DIR/flight-<pid>.json`` (cwd fallback)."""
-    base = os.environ.get("LOGPARSER_TPU_FLIGHT_DIR", "").strip() or "."
-    return os.path.join(base, f"flight-{pid or os.getpid()}.json")
+    :func:`flight_dir` ``/flight-<pid>.json``."""
+    return os.path.join(flight_dir(), f"flight-{pid or os.getpid()}.json")
 
 
 def dump_flight(reason: str) -> Optional[str]:
@@ -477,6 +488,7 @@ def dump_flight(reason: str) -> Optional[str]:
     path = flight_dump_path()
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump(flightz_payload(reason), fh, sort_keys=True)
         os.replace(tmp, path)
@@ -485,6 +497,60 @@ def dump_flight(reason: str) -> Optional[str]:
         with contextlib.suppress(OSError):
             os.unlink(tmp)
         return None
+
+
+_FLIGHT_NAME_RE = re.compile(r"^flight-(\d+)\.json$")
+
+#: Dead-writer dumps retained after a sweep (most-recent first) —
+#: post-mortem material for the runs that just crashed, without letting
+#: a crash-looping fleet grow the directory without bound.
+FLIGHT_KEEP_DEFAULT = 8
+
+
+def sweep_flight_dumps(directory: Optional[str] = None,
+                       keep: Optional[int] = None) -> List[str]:
+    """Startup hygiene for the dump directory: unlink ``flight-<pid>.json``
+    files whose writer pid is dead (the jobs-tier ``sweepable_temp_files``
+    dead-pid rule — a live pid is a concurrent local process, an
+    unkillable/unknowable one is left alone), keeping the ``keep``
+    most-recently-modified dead dumps (``LOGPARSER_TPU_FLIGHT_KEEP``,
+    default :data:`FLIGHT_KEEP_DEFAULT`).  Returns the removed paths."""
+    base = directory if directory is not None else flight_dir()
+    if keep is None:
+        keep = _env_int("LOGPARSER_TPU_FLIGHT_KEEP", FLIGHT_KEEP_DEFAULT)
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return []
+    dead: List[tuple] = []
+    for name in names:
+        m = _FLIGHT_NAME_RE.match(name)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            pass            # dead writer: sweepable crash debris
+        except OSError:
+            continue        # unknowable (e.g. other uid): leave it
+        else:
+            continue        # alive: a concurrent local process
+        path = os.path.join(base, name)
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            continue
+        dead.append((mtime, path))
+    dead.sort(reverse=True)
+    removed = []
+    for _, path in dead[max(0, keep):]:
+        with contextlib.suppress(OSError):
+            os.unlink(path)
+            removed.append(path)
+    return removed
 
 
 def arm_flight_signals() -> None:

@@ -754,3 +754,118 @@ def test_unescape_compact_matches_reference_decoder():
             got = bytes(out[i, : out_len[i]].astype(np.uint8))
             ref = decode_apache_httpd_log_value(c.decode("latin-1"))
             assert got == ref.encode("latin-1"), (c, got, ref)
+
+
+# --------------------------------------------------------------------------
+# URI & query-string matrix (round 20): the device URI sub-dissector chain
+# (path span + per-key query explosion + vectorized percent-decode) vs the
+# host dissector chain, byte for byte, across the adversarial URI classes —
+# and defer decisions that stay deterministic across repeated parses.
+# --------------------------------------------------------------------------
+
+URI_FIELDS = [
+    "HTTP.PATH:request.firstline.uri.path",
+    "STRING:request.firstline.uri.query.q",
+    "STRING:request.firstline.uri.query.img",
+    "STRING:request.firstline.uri.query.*",
+]
+
+URI_MATRIX = [
+    # percent-encoding: valid, truncated, bad hex, doubled, UTF-16, high byte
+    "/p%20ath?q=a%20b&img=x",
+    "/x?q=trail%",
+    "/x?q=%2",
+    "/x?q=%ZZ&img=%zz1",
+    "/x?q=%%41",
+    "/x?q=%4%41",
+    "/x?q=%u0041",
+    "/x?q=caf%C3%A9",
+    "/x?q=caf%e9",
+    # '+' in path vs query (literal in path, space in query values)
+    "/a+b/c?q=a+b",
+    # repeated keys, empty values, bare names, bare '?', empty names
+    "/x?q=1&q=2&q=3",
+    "/x?q=&img=",
+    "/x?q&img",
+    "/x?",
+    "/x?&&&",
+    "/x?=v&q=ok",
+    # case-folded key names, encoded '=' and '&' in names/values
+    "/x?Q=upper&IMG=shout",
+    "/x?a%3Db=1&q=ok",
+    "/x?q=a%26b&img=c%3Dd",
+    # fragments
+    "/x?q=1#frag",
+    "/x#frag",
+    # userinfo, IPv6 hosts, proxied absolute URIs
+    "http://user:pw@example.com/x?q=1",
+    "http://[2001:db8::1]:8080/x?q=1",
+    "https://example.com:443/deep/path?img=1&q=2",
+    # relative, protocol-relative and '*' request targets
+    "*",
+    "relative/path?q=1",
+    "//proto-relative/p?q=1",
+    # encode-set bytes the host chain repairs before parsing
+    '/x?q="quoted"',
+    "/x?q=<tag>",
+    "/x?q={curly}|pipe",
+    # plain dashboard shape
+    "/index.html?img=x.png&q=search+term",
+]
+
+
+def _combined_uri_line(uri):
+    return (
+        f'1.2.3.4 - - [01/Jan/2026:10:00:00 +0000] "GET {uri} HTTP/1.1" '
+        f'200 5 "-" "ua"'
+    )
+
+
+def test_uri_query_matrix_device_matches_oracle():
+    lines = [_combined_uri_line(u) for u in URI_MATRIX]
+    lines.insert(7, "total garbage ! matches nothing ::")
+    assert_device_matches_oracle("combined", URI_FIELDS, lines, "uri-matrix")
+
+
+def test_uri_query_matrix_defer_determinism():
+    """Rows the device cannot prove byte-identical defer to the host
+    referee — and that decision is a pure function of the line: a second
+    parse reproduces the same validity, the same reject ledger (stable
+    vocabulary), and the same delivered bytes."""
+    parser = TpuBatchParser("combined", URI_FIELDS)
+    lines = [_combined_uri_line(u) for u in URI_MATRIX]
+    r1 = parser.parse_batch(lines)
+    r2 = parser.parse_batch(lines)
+    assert list(r1.valid) == list(r2.valid)
+    assert r1.reject_reasons == r2.reject_reasons
+    for reason in r1.reject_reasons.values():
+        assert reason in REJECT_REASONS
+    for f in URI_FIELDS:
+        assert r1.to_pylist(f) == r2.to_pylist(f)
+    parser.close()
+
+
+def _rand_uri(rng):
+    scheme = rng.choice(["", "", "", "http://user@h.example", 
+                         "http://[2001:db8::2]", "https://ex.com:8443"])
+    path = rng.choice(["/", "/a/b", "/p%20q", "/a+b", "*", "rel/x"])
+    if path == "*" and scheme:
+        path = "/"
+    parts = []
+    for _ in range(rng.randint(0, 4)):
+        k = rng.choice(["q", "Q", "img", "a%3Db", "k-1", ""])
+        v = rng.choice(["", "1", "a+b", "x%20y", "caf%C3%A9", "%e9",
+                        "tr%", "%ZZ", "%%41", "a%26b", "%u0041"])
+        parts.append(k if rng.random() < 0.2 else f"{k}={v}")
+    query = "?" + "&".join(parts) if parts or rng.random() < 0.1 else ""
+    frag = "#f" if rng.random() < 0.15 else ""
+    return scheme + path + query + frag
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_uri_query_fuzz_device_matches_oracle(seed):
+    rng = random.Random(12000 + seed)
+    lines = [_combined_uri_line(_rand_uri(rng)) for _ in range(40)]
+    assert_device_matches_oracle(
+        "combined", URI_FIELDS, lines, f"uri-fuzz seed={seed}"
+    )
