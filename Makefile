@@ -9,7 +9,7 @@ PY ?= python
 SMOKE_TIMEOUT ?= 600
 SMOKE = timeout -k 10 $(SMOKE_TIMEOUT)
 
-.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke pod-smoke device-smoke agg-smoke trace-smoke bench native clean
+.PHONY: test test-fast metrics-smoke feeder-smoke chaos-smoke rescue-smoke service-smoke coalesce-smoke fleet-smoke job-smoke pod-smoke device-smoke warm-smoke agg-smoke trace-smoke bench native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -125,6 +125,18 @@ pod-smoke:
 # this after pod-smoke.
 device-smoke:
 	$(SMOKE) $(PY) -m logparser_tpu.tools.device_chaos_smoke
+
+# Warm-boot smoke: the persistent compile cache's acceptance drill
+# (docs/COMPILE.md) — a real sidecar cold-boots against an empty cache
+# (first request compiles, the background prewarmer lands every bucket
+# ladder rung incl. the coalesced-batch shape on disk), then a FRESH
+# sidecar warm-boots against the same cache and must compile NOTHING:
+# parser_compile_total{phase=lower|compile} == 0 (deserialize only,
+# counter-asserted over /metrics), prewarm all cache-served, ARROW
+# payload byte-identical to the cold boot's, exposition valid.  CI
+# runs this after device-smoke.
+warm-smoke:
+	$(SMOKE) $(PY) -m logparser_tpu.tools.warm_smoke
 
 # Analytics smoke: the on-device aggregation pushdown's exactness
 # contract (docs/ANALYTICS.md) — a LIVE service session configured with

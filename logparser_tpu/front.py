@@ -1742,12 +1742,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--tenant-max-inflight-lines", type=int, default=0)
     ap.add_argument("--spill-occupancy", type=float, default=0.5)
     ap.add_argument("--heartbeat-deadline", type=float, default=5.0)
+    ap.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent compile-cache directory "
+                         "(docs/COMPILE.md) — exported as "
+                         "LOGPARSER_TPU_COMPILE_CACHE to every spawned "
+                         "sidecar, so respawns and rolling restarts warm "
+                         "up by DESERIALIZING cached executables instead "
+                         "of recompiling")
     ap.add_argument("--log-level", default=os.environ.get(
         "LOGPARSER_TPU_LOG_LEVEL", "INFO"))
     ap.add_argument("sidecar_args", nargs="*",
                     help="extra args passed through to every sidecar "
                          "(e.g. -- --request-deadline 5)")
     args = ap.parse_args(argv)
+    if args.compile_cache:
+        # Spawned sidecars inherit the front's environment (ProcessSidecar
+        # copies os.environ), so one export here covers the whole fleet —
+        # including every future respawn and rolling-restart replacement.
+        from .tpu.compile_cache import ENV_CACHE_DIR
+
+        os.environ[ENV_CACHE_DIR] = args.compile_cache
     logging.basicConfig(
         level=getattr(logging, str(args.log_level).upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",

@@ -90,6 +90,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                          "aggregate sidecars instead of data tables and "
                          "the completed job prints the merged aggregate "
                          "summary")
+    ap.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent compile-cache directory "
+                         "(docs/COMPILE.md): resumed/repeated jobs and "
+                         "pod hosts deserialize cached executables "
+                         "instead of recompiling "
+                         "(= LOGPARSER_TPU_COMPILE_CACHE)")
     ap.add_argument("--stop-after-shards", type=int, default=None,
                     help=argparse.SUPPRESS)  # crash-drill hook (smoke)
     return ap
@@ -97,6 +103,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
+    if args.compile_cache:
+        import os
+
+        from ..tpu.compile_cache import ENV_CACHE_DIR
+
+        os.environ[ENV_CACHE_DIR] = args.compile_cache
     # SIGTERM = the cloud-TPU preemption notice: finish/commit the
     # current shard boundary, exit EXIT_PREEMPTED (resumable — cheaper
     # than the SIGKILL path by exactly one replayed shard).  An

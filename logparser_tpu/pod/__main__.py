@@ -53,6 +53,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     default=None)
     ap.add_argument("--data-parallel", type=int, default=None,
                     help="chips per host for the device mesh")
+    ap.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent compile-cache directory "
+                         "(docs/COMPILE.md), passed through to every "
+                         "host job — on a shared filesystem the first "
+                         "host to compile a bucket saves every other "
+                         "host that compile")
     ap.add_argument("--host-retries", type=int, default=1)
     ap.add_argument("--host-timeout", type=float, default=3600.0)
     ap.add_argument("--print-host-commands", action="store_true",
@@ -75,6 +81,7 @@ def main(argv=None) -> int:
         workers=args.workers,
         transport=args.transport,
         data_parallel=args.data_parallel,
+        compile_cache=args.compile_cache,
     )
     policy = PodPolicy(host_retries=args.host_retries,
                        host_timeout_s=args.host_timeout)

@@ -81,6 +81,9 @@ class PodSpec:
     transport: Optional[str] = None
     data_parallel: Optional[int] = None    # chips per host (mesh DP)
     host_env: Optional[Dict[str, str]] = None  # extra env per subprocess
+    # Persistent compile cache (docs/COMPILE.md) — execution-only: cached
+    # executables change when work starts, never what it produces.
+    compile_cache: Optional[str] = None
 
     def host_job_spec(self, host_index: int) -> JobSpec:
         return JobSpec(
@@ -203,6 +206,8 @@ def host_argv(spec: PodSpec, host_index: int,
         argv += ["--transport", spec.transport]
     if spec.data_parallel:
         argv += ["--data-parallel", str(spec.data_parallel)]
+    if spec.compile_cache:
+        argv += ["--compile-cache", spec.compile_cache]
     if spec.aggregate is not None:
         # Canonical JSON on the wire: every host must fingerprint the
         # IDENTICAL spec string or the merge would refuse its manifests.

@@ -94,6 +94,8 @@ from __future__ import annotations
 import itertools
 import json
 import logging
+import os
+import queue
 import random
 import socket
 import socketserver
@@ -434,11 +436,18 @@ class _ParserCache:
     """LRU-bounded: each entry pins a compiled parser + XLA executables, so
     a long-lived sidecar serving many distinct configs must evict."""
 
-    def __init__(self, max_entries: int = 32) -> None:
+    def __init__(self, max_entries: int = 32,
+                 on_insert: Optional[Callable[[Any], None]] = None) -> None:
         self._lock = threading.Lock()
         self._max_entries = max_entries
         self._parsers: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._building: Dict[Tuple, threading.Lock] = {}
+        # Called once per freshly BUILT parser (cache hits skip it): the
+        # serving tier hooks the background shape-bucket prewarmer here so
+        # larger buckets — and the coalesced-batch shape — compile (or
+        # load from the persistent compile cache, docs/COMPILE.md) off the
+        # request path.
+        self._on_insert = on_insert
 
     @staticmethod
     def key_of(config: Dict[str, Any]) -> Tuple:
@@ -496,6 +505,16 @@ class _ParserCache:
                         self._parsers[key] = parser
                         while len(self._parsers) > self._max_entries:
                             self._parsers.popitem(last=False)
+                    if self._on_insert is not None:
+                        # Outside the cache lock: the hook only ENQUEUES
+                        # (the prewarm itself runs on the worker thread),
+                        # and a hook failure must never fail the build
+                        # that already succeeded.
+                        try:
+                            self._on_insert(parser)
+                        except Exception:  # noqa: BLE001
+                            LOG.warning("parser prewarm enqueue failed",
+                                        exc_info=True)
                 finally:
                     # Failed builds must also drop the per-key build lock:
                     # the parser LRU is bounded but _building is not, and a
@@ -504,6 +523,106 @@ class _ParserCache:
                     with self._lock:
                         self._building.pop(key, None)
             return parser
+
+
+class _PrewarmWorker:
+    """Background shape-bucket prewarm (docs/COMPILE.md "Fleet prewarm").
+
+    Every freshly built parser is walked up the bucket ladder — including
+    the coalesced-batch shape when continuous batching is on — on ONE
+    daemon thread, so no request ever waits on a compile for a bucket it
+    did not itself need first.  With ``LOGPARSER_TPU_COMPILE_CACHE`` set,
+    each rung is a disk deserialize (or an in-memory no-op) instead of an
+    XLA compile; the per-rung source lands in
+    ``parser_prewarm_shapes_total{source=memory|disk|compiled}``.
+
+    Env knobs:
+
+    - ``LOGPARSER_TPU_PREWARM=0``       disable entirely
+    - ``LOGPARSER_TPU_PREWARM_BUCKETS`` comma-separated batch sizes
+      (default: the compile cache's ``DEFAULT_BUCKET_LADDER``)
+    - ``LOGPARSER_TPU_PREWARM_LINE_LEN`` line-length to warm at
+      (bucketed; default 256 — the common access-log ballpark)
+    """
+
+    _STOP = object()
+
+    @staticmethod
+    def enabled() -> bool:
+        return os.environ.get(
+            "LOGPARSER_TPU_PREWARM", "1"
+        ).strip().lower() not in ("0", "false", "no")
+
+    def __init__(self, limits: ServiceLimits) -> None:
+        self._limits = limits
+        self._queue: "queue.Queue[Any]" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name="logparser-tpu-prewarm", daemon=True
+        )
+        self._thread.start()
+
+    def ladder(self) -> Tuple[int, ...]:
+        raw = os.environ.get("LOGPARSER_TPU_PREWARM_BUCKETS", "").strip()
+        if raw:
+            buckets = [int(t) for t in raw.split(",") if t.strip()]
+        else:
+            from .tpu.compile_cache import DEFAULT_BUCKET_LADDER
+
+            buckets = list(DEFAULT_BUCKET_LADDER)
+        if self._limits.coalesce:
+            # The coalescer dispatches full windows at coalesce_max_lines:
+            # that shape is the steady-state hot path under load and must
+            # never compile on a request's clock.
+            buckets.append(self._limits.coalesce_max_lines)
+        return tuple(sorted({int(b) for b in buckets if int(b) > 0}))
+
+    @staticmethod
+    def line_len() -> int:
+        try:
+            return max(1, int(os.environ.get(
+                "LOGPARSER_TPU_PREWARM_LINE_LEN", "256")))
+        except ValueError:
+            return 256
+
+    def enqueue(self, parser: Any) -> None:
+        self._queue.put(parser)
+
+    def shutdown(self, timeout_s: float = 2.0) -> None:
+        """Best-effort stop: the thread is a daemon, so this only bounds
+        how long a graceful shutdown waits for an in-flight warm rung."""
+        self._queue.put(self._STOP)
+        self._thread.join(timeout=timeout_s)
+
+    def _run(self) -> None:
+        while True:
+            parser = self._queue.get()
+            if parser is self._STOP:
+                return
+            try:
+                t0 = time.perf_counter()
+                sources = parser.prewarm(
+                    batch_sizes=self.ladder(), max_line_len=self.line_len()
+                )
+                reg = metrics()
+                for source in sources.values():
+                    reg.increment("parser_prewarm_shapes_total", 1,
+                                  labels={"source": source})
+                reg.increment("parser_prewarm_seconds_total",
+                              time.perf_counter() - t0)
+                # One tick per completed parser walk: pollable by smokes
+                # and the bench ("is the ladder warm yet?") where the
+                # seconds/shapes counters alone cannot distinguish one
+                # finished walk from one still in flight.
+                reg.increment("parser_prewarm_runs_total", 1)
+                LOG.info("prewarm: %d shapes ready in %.2fs (%s)",
+                         len(sources), time.perf_counter() - t0,
+                         ", ".join(f"{k}={v}"
+                                   for k, v in sorted(sources.items())))
+            except Exception:  # noqa: BLE001 — prewarm is an optimization;
+                # a failure means first requests pay the compile, nothing
+                # worse, and the error class is visible in the counter.
+                metrics().increment("parser_prewarm_errors_total", 1)
+                LOG.warning("background prewarm failed", exc_info=True)
 
 
 class _ServiceServer(socketserver.ThreadingTCPServer):
@@ -518,7 +637,15 @@ class _ServiceServer(socketserver.ThreadingTCPServer):
     def __init__(self, addr, handler, limits: ServiceLimits):
         super().__init__(addr, handler)
         self.limits = limits
-        self.parser_cache = _ParserCache()
+        # Background shape-bucket prewarmer (docs/COMPILE.md): freshly
+        # built parsers walk the bucket ladder off the request path.
+        self.prewarmer: Optional[_PrewarmWorker] = (
+            _PrewarmWorker(limits) if _PrewarmWorker.enabled() else None
+        )
+        self.parser_cache = _ParserCache(
+            on_insert=(self.prewarmer.enqueue
+                       if self.prewarmer is not None else None)
+        )
         self.session_seq = itertools.count(1)
         self.session_slots = threading.BoundedSemaphore(limits.max_sessions)
         self.inflight_slots = threading.BoundedSemaphore(limits.inflight)
@@ -1439,8 +1566,6 @@ class ParseService:
             # Env kill switch (docs/SERVICE.md): continuous batching is
             # ON by default — it is byte-transparent on the wire — but
             # an operator can hard-disable it without a code change.
-            import os
-
             coalesce = os.environ.get(
                 "LOGPARSER_TPU_COALESCE", "1"
             ).strip().lower() not in ("0", "false", "no")
@@ -1670,6 +1795,8 @@ class ParseService:
         # were force-closed past the drain deadline.
         if self._server.coalescer is not None:
             self._server.coalescer.shutdown()
+        if self._server.prewarmer is not None:
+            self._server.prewarmer.shutdown()
         if drain:
             # The drain is over (documented: "1 WHILE a graceful drain is
             # in progress") — a later service in this process must not

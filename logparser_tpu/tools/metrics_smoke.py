@@ -43,9 +43,13 @@ REQUIRED_SUBSTRINGS = (
     "logparser_tpu_oracle_routed_lines_total",
     # Round-20 residual census: the per-field ledger of host_fields
     # routing (which requested fields still force whole-line oracle
-    # routing) — driven below by requesting a host-only field.
+    # routing).  On `combined` the census is now EMPTY — the protocol
+    # split and the timezone string table moved the last residuals to
+    # device (see FORBIDDEN_SUBSTRINGS) — so the ledger is driven below
+    # by a custom format whose space-padded strftime day (`%e`) the
+    # device time-layout compiler rejects: a genuinely host-only field.
     'logparser_tpu_host_field_lines_total{'
-    'field="HTTP.PROTOCOL:request.firstline.protocol"}',
+    'field="TIME.EPOCH:request.receive.time.begin.epoch"}',
     "logparser_tpu_device_escaped_quote_lines_total",
     "logparser_tpu_service_requests_total",
     "logparser_tpu_parse_lines_total",
@@ -58,6 +62,17 @@ REQUIRED_SUBSTRINGS = (
     # Build identity (docs/OBSERVABILITY.md): every exposition carries
     # one build_info gauge labeling the package + jax versions.
     "logparser_tpu_build_info{",
+)
+
+# Label blocks that must NOT appear in the exposition: the combined
+# session below requests HTTP.PROTOCOL[.VERSION] and TIME.ZONE — once
+# the last host-only residuals on `combined`, both device-native since
+# the protocol span split (tpu/postproc.py) and the timezone string
+# table (tpu/timefields.py).  If either ever re-enters the census, the
+# device lane regressed to whole-line oracle routing.
+FORBIDDEN_SUBSTRINGS = (
+    'logparser_tpu_host_field_lines_total{field="HTTP.PROTOCOL',
+    'logparser_tpu_host_field_lines_total{field="TIME.ZONE',
 )
 
 
@@ -153,14 +168,33 @@ def main() -> int:
             svc.host, svc.port, "combined",
             # BYTES requested so the 20-digit line exercises the oracle
             # rescue route (device limb decode fails, host Long succeeds).
-            # HTTP.PROTOCOL is a host-only field (round-20 residual): it
-            # routes the valid lines with reason=host_fields and makes
-            # the per-field host_field_lines_total census move.
+            # HTTP.PROTOCOL[.VERSION] and TIME.ZONE — the round-20
+            # host-only residuals — are requested ON PURPOSE: both are
+            # device-native now, so neither may surface in the
+            # host_field_lines_total census (FORBIDDEN_SUBSTRINGS).
             ["IP:connection.client.host", "BYTES:response.body.bytes",
-             "HTTP.PROTOCOL:request.firstline.protocol"],
+             "HTTP.PROTOCOL:request.firstline.protocol",
+             "HTTP.PROTOCOL.VERSION:request.firstline.protocol.version",
+             "TIME.ZONE:request.receive.time.timezone"],
         ) as client:
             table = client.parse(lines)
             assert table.num_rows == len(lines)
+        # Census drill: `combined` no longer has any host-only field, so
+        # the per-field ledger is exercised with a custom format whose
+        # space-padded strftime day (%e) the device time-layout compiler
+        # rejects — TIME.EPOCH under it is genuinely host-only and must
+        # route with reason=host_fields.
+        with ParseServiceClient(
+            svc.host, svc.port,
+            "%h %l %u %{begin:%Y-%m-%e %H:%M:%S}t \"%r\" %>s %b",
+            ["IP:connection.client.host",
+             "TIME.EPOCH:request.receive.time.begin.epoch"],
+        ) as census:
+            table = census.parse([
+                '1.2.3.4 - - 2012-03- 7 23:49:40 '
+                '"GET /i.html HTTP/1.1" 200 512',
+            ])
+            assert table.num_rows == 1
         # One aggregate-mode session so the analytics_* families exist
         # before the scrape asserts them (the row session above never
         # touches the pushdown path).
@@ -183,6 +217,10 @@ def main() -> int:
     for needle in REQUIRED_SUBSTRINGS:
         if needle not in text:
             errors.append(f"required metric absent: {needle}")
+    for needle in FORBIDDEN_SUBSTRINGS:
+        if needle in text:
+            errors.append(
+                f"device-native field re-entered the host census: {needle}")
     if errors:
         print(f"metrics smoke FAILED ({len(errors)} problems):")
         for e in errors:

@@ -1281,8 +1281,45 @@ class TpuBatchParser:
             p.kind != "host" for u in self.units for p in u.plans
         )
         if self.units and any_device_field:
-            return build_units_jnp_fn(self.units, mesh=self._mesh)
+            return self._aot_wrap(
+                build_units_jnp_fn(self.units, mesh=self._mesh), "plain"
+            )
         return None
+
+    def _aot_wrap(self, jit_fn, tag: str, specs=None):
+        """Wrap a fresh jit executor in the AOT compile-cache layer (see
+        tpu/compile_cache.py + docs/COMPILE.md).  Mesh-sharded executors
+        stay in-memory only: their serialized form binds this process's
+        device set."""
+        from .compile_cache import AotExecutor
+
+        return AotExecutor(
+            jit_fn,
+            self.executor_fingerprint(tag, specs),
+            serializable=self._mesh is None,
+        )
+
+    def executor_fingerprint(self, tag: str, specs=None) -> str:
+        """Content hash of everything that shapes the compiled executor:
+        the device programs + field plans (which fold in format strings,
+        requested fields, remappings, extra dissectors, geo tables), the
+        CSR slot count (adaptive growth = new fingerprint), the mesh
+        width, the executor variant (plain/views + its specs), and the
+        pipeline code version.  Any drift is a cache MISS — a stale
+        kernel can never load."""
+        from .compile_cache import code_fingerprint, stable_hash
+
+        return stable_hash((
+            code_fingerprint(),
+            tag,
+            list(specs) if specs else [],
+            self.csr_slots,
+            self.mesh_devices,
+            [
+                (u.plausibility_only, u.row_offset, u.program, u.plans)
+                for u in self.units
+            ],
+        ))
 
     def assembly_pool(self):
         """The shared delivery-path worker pool (lazily built; see
@@ -1329,8 +1366,9 @@ class TpuBatchParser:
                 self._jitted_views = self._jitted
                 self._views_fields = []
             else:
-                self._jitted_views = build_units_jnp_fn(
-                    self.units, specs, mesh=self._mesh
+                self._jitted_views = self._aot_wrap(
+                    build_units_jnp_fn(self.units, specs, mesh=self._mesh),
+                    "views", specs,
                 )
                 self._views_fields = [fid for fid, _ in specs]
         return self._jitted_views
@@ -1342,6 +1380,51 @@ class TpuBatchParser:
         this pipeline measured ~4.5x slower on v5e and Mosaic cannot
         lower the chained stages — see the ADR in COMPONENTS.md."""
         return self._jitted
+
+    def prewarm(
+        self,
+        batch_sizes: Optional[Sequence[int]] = None,
+        max_line_len: int = 256,
+        emit_views: Optional[bool] = None,
+    ) -> Dict[str, str]:
+        """Make the shape-bucket ladder executable OFF the request path:
+        for each batch size, resolve the (padded-B, L-bucket) executable —
+        in-memory map, then the persistent compile cache
+        (``LOGPARSER_TPU_COMPILE_CACHE``), then an explicit lower+compile
+        written back to the cache.  ``max_line_len`` picks the line-length
+        bucket to warm (the same ``runtime.bucket_length`` the encoder
+        applies).  Returns ``{"BxL": "memory"|"disk"|"compiled"}`` per
+        warmed shape; a no-device-field parser returns ``{}``.
+
+        Sidecar boot and front-tier respawn warmup call this from a
+        background thread (docs/SERVICE.md): a cache-warm fleet boots
+        with zero compiles on the serving path."""
+        from .compile_cache import DEFAULT_BUCKET_LADDER
+        from .runtime import bucket_length
+
+        executors = []
+        if emit_views is None or emit_views:
+            fn = self.device_views_fn()
+            if fn is not None:
+                executors.append(fn)
+        if emit_views is None or not emit_views:
+            fn = self.device_fn()
+            if fn is not None and fn not in executors:
+                executors.append(fn)
+        if not executors:
+            return {}
+        line_len = bucket_length(max(1, max_line_len))
+        out: Dict[str, str] = {}
+        for b in batch_sizes or DEFAULT_BUCKET_LADDER:
+            padded = self._bucket(int(b))
+            for fn in executors:
+                src = fn.warm(padded, line_len)
+                shape = f"{padded}x{line_len}"
+                # Report the coldest source across the executor variants.
+                rank = {"memory": 0, "disk": 1, "compiled": 2}
+                if rank[src] >= rank.get(out.get(shape, "memory"), 0):
+                    out[shape] = src
+        return out
 
     def _grow_csr_slots(self) -> bool:
         """Adaptive CSR: double the wildcard segment-slot count (bounded by
@@ -1459,7 +1542,10 @@ class TpuBatchParser:
         `oname`.  Returns (kind, new_vctx, new_steps, new_device_ok, comp,
         meta) where kind is "value" (value-level), "span" (span transform)
         or "ts" (terminal timestamp component)."""
-        from ..dissectors.firstline import HttpFirstLineDissector
+        from ..dissectors.firstline import (
+            HttpFirstLineDissector,
+            HttpFirstLineProtocolDissector,
+        )
         from ..dissectors.strftime_stamp import StrfTimeStampDissector
         from ..dissectors.timestamp import TimeStampDissector
         from ..dissectors.uri import HttpUriDissector
@@ -1487,6 +1573,15 @@ class TpuBatchParser:
             )
             if part is not None:
                 return ("span", vctx, steps + (("fl", part),), device_ok)
+        if isinstance(d, HttpFirstLineProtocolDissector) and parse == "":
+            # "HTTP/1.1" -> protocol ("" output name: keeps the input path)
+            # + version.  A span split at the first '/', device-exact.
+            if oname in ("", "version"):
+                return (
+                    "span", vctx,
+                    steps + (("pv", "version" if oname else "protocol"),),
+                    device_ok,
+                )
         if isinstance(d, HttpUriDissector) and parse == "":
             if oname == "port":
                 # Port is numeric on the host (uri.port int, STRING_OR_LONG
@@ -4089,6 +4184,11 @@ class TpuBatchParser:
     # ------------------------------------------------------------------
 
     _ARTIFACT_MAGIC = b"LPTPU-PROGRAM-v1\n"
+    # v2 wraps the v1 parser pickle with serialized AOT executables for
+    # the shapes this process compiled (docs/COMPILE.md "Artifact
+    # layout"): a fresh host loading the artifact executes its first
+    # batch without lowering anything.  v1 artifacts stay loadable.
+    _ARTIFACT_MAGIC_V2 = b"LPTPU-PROGRAM-v2\n"
 
     def __getstate__(self) -> Dict[str, Any]:
         state = self.__dict__.copy()
@@ -4173,12 +4273,75 @@ class TpuBatchParser:
         self._jitted = self._build_jitted()
         self._jitted_views = None
 
-    def to_bytes(self) -> bytes:
+    def to_bytes(self, embed_executables: bool = True) -> bytes:
         """The compiled parser as a versioned artifact blob (a pickle — see
-        the SECURITY note above: treat artifacts as executable)."""
+        the SECURITY note above: treat artifacts as executable).
+
+        ``embed_executables`` (default) also ships the serialized AOT
+        executables for every shape bucket this process has compiled or
+        loaded — warm the ladder first (:meth:`prewarm`) to mint an
+        artifact whose loading host never lowers anything.  A parser with
+        nothing compiled yet (or a mesh-sharded executor, whose
+        executables bind this process's device set) emits a plain v1
+        blob."""
         import pickle
 
-        return self._ARTIFACT_MAGIC + pickle.dumps(self)
+        execs = self._export_executables() if embed_executables else []
+        if not execs:
+            return self._ARTIFACT_MAGIC + pickle.dumps(self)
+        from .compile_cache import backend_fingerprint
+
+        return self._ARTIFACT_MAGIC_V2 + pickle.dumps({
+            "parser": self,
+            "backend": backend_fingerprint(),
+            "execs": execs,
+        })
+
+    def _export_executables(self) -> List[Dict[str, Any]]:
+        from .compile_cache import AotExecutor
+
+        out: List[Dict[str, Any]] = []
+        seen = set()
+        for tag, fn in (("plain", self._jitted),
+                        ("views", self._jitted_views)):
+            if (not isinstance(fn, AotExecutor) or not fn.serializable
+                    or id(fn) in seen):
+                continue
+            seen.add(id(fn))
+            for (b, l), payload in fn.export_payloads().items():
+                out.append({
+                    "tag": tag, "b": b, "l": l, "payload": payload,
+                    "fingerprint": fn.fingerprint,
+                })
+        return out
+
+    def _preload_executables(self, execs: List[Dict[str, Any]],
+                             backend: Optional[str]) -> int:
+        """Install artifact-embedded executables into the rebuilt AOT
+        executors.  Fingerprint or backend drift refuses the entry (the
+        shape compiles fresh on first use — never a wrong kernel);
+        returns how many shapes went live."""
+        from ..observability import log_warning_once, metrics
+        from .compile_cache import AotExecutor
+
+        loaded = 0
+        for e in execs:
+            fn = (self._jitted if e.get("tag") == "plain"
+                  else self.device_views_fn())
+            if not isinstance(fn, AotExecutor):
+                continue
+            if e.get("fingerprint") != fn.fingerprint:
+                metrics().increment("compile_cache_errors_total",
+                                    labels={"kind": "fingerprint"})
+                log_warning_once(
+                    _LOG,
+                    "artifact executable refused (fingerprint drift); "
+                    "recompiling fresh",
+                )
+                continue
+            if fn.preload(int(e["b"]), int(e["l"]), e["payload"], backend):
+                loaded += 1
+        return loaded
 
     @classmethod
     def from_bytes(cls, blob: bytes) -> "TpuBatchParser":
@@ -4186,6 +4349,15 @@ class TpuBatchParser:
         ONLY — the payload is a pickle and loading executes code."""
         import pickle
 
+        if blob.startswith(cls._ARTIFACT_MAGIC_V2):
+            d = pickle.loads(blob[len(cls._ARTIFACT_MAGIC_V2):])
+            parser = d.get("parser") if isinstance(d, dict) else None
+            if not isinstance(parser, cls):
+                raise ValueError("artifact does not contain a TpuBatchParser")
+            parser._preload_executables(
+                d.get("execs") or [], d.get("backend")
+            )
+            return parser
         if not blob.startswith(cls._ARTIFACT_MAGIC):
             raise ValueError("not a logparser_tpu program artifact")
         parser = pickle.loads(blob[len(cls._ARTIFACT_MAGIC):])
@@ -4193,9 +4365,9 @@ class TpuBatchParser:
             raise ValueError("artifact does not contain a TpuBatchParser")
         return parser
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, embed_executables: bool = True) -> None:
         with open(path, "wb") as f:
-            f.write(self.to_bytes())
+            f.write(self.to_bytes(embed_executables))
 
     @classmethod
     def load(cls, path: str) -> "TpuBatchParser":

@@ -939,6 +939,7 @@ def compute_rows(
     # which re-applies the exact repair semantics).
     fl_cache: Dict[tuple, Dict[str, jnp.ndarray]] = {}
     uri_cache: Dict[tuple, Dict[str, jnp.ndarray]] = {}
+    pv_cache: Dict[tuple, Dict[str, jnp.ndarray]] = {}
     chain_cache: Dict[tuple, tuple] = {}
     line_constraints: List[jnp.ndarray] = []
     csr_overflow_rows: List[jnp.ndarray] = []
@@ -974,6 +975,21 @@ def compute_rows(
             return (
                 fl[f"{part}_start"], fl[f"{part}_end"], ok & fl["ok"],
                 false_b, false_b, false_b,
+            )
+        if name == "pv":
+            pv = pv_cache.get(cache_key)
+            if pv is None:
+                # Direct token input: CLF '-' is null (the dissector's
+                # early return).  Sub-spans (firstline protocol) cannot be
+                # a lone dash — the fl split already requires "HTTP/".
+                dash = clf_dash(s, e) if len(cache_key) == 1 else None
+                pv = postproc.split_protocol_version(b32, s, e, dash=dash)
+                pv_cache[cache_key] = pv
+            if part == "protocol":
+                return (s, pv["proto_end"], ok, pv["null"], false_b, false_b)
+            return (
+                pv["ver_start"], pv["ver_end"], ok, pv["null"],
+                false_b, false_b,
             )
         if name == "uri":
             uri = uri_cache.get(cache_key)

@@ -1095,6 +1095,39 @@ def split_firstline(
     }
 
 
+def split_protocol_version(
+    buf: jnp.ndarray,
+    start: jnp.ndarray,
+    end: jnp.ndarray,
+    dash: Optional[jnp.ndarray] = None,
+) -> Dict[str, jnp.ndarray]:
+    """HTTP.PROTOCOL_VERSION value span ("HTTP/1.1") -> protocol + version.
+
+    Mirrors HttpFirstLineProtocolDissector exactly: a ``None``/``""``/``"-"``
+    input delivers nothing (``dash`` carries the direct-token CLF null;
+    sub-span chains never produce a lone dash); a value without ``/``
+    delivers explicit nulls for both outputs; otherwise protocol is
+    everything before the FIRST ``/`` (``value.split("/", 1)``) and version
+    everything after it — either side may be the empty string.
+    """
+    B, L = buf.shape
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, L), 1)
+    in_span = (pos >= start[:, None]) & (pos < end[:, None])
+    slash = jnp.min(
+        jnp.where((buf == np.uint8(ord("/"))) & in_span, pos, L), axis=1
+    )
+    absent = start >= end
+    if dash is not None:
+        absent = absent | dash
+    return {
+        "proto_start": start,
+        "proto_end": jnp.minimum(slash, end),
+        "ver_start": jnp.minimum(slash + 1, end),
+        "ver_end": end,
+        "null": absent | (slash >= L),
+    }
+
+
 def unescape_compact_spans(
     buf: jnp.ndarray,
     start: jnp.ndarray,

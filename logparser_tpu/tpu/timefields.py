@@ -194,15 +194,24 @@ def derive(
                 _zfill(comp["second"], 2),
             ),
         )
+    if name == "timezone":
+        # The TIME.ZONE/TIME.TIMEZONE quirk, modeled on device: the
+        # reference declares ``TIME.ZONE:timezone`` but dissect emits the
+        # value under type TIME.TIMEZONE (TestTimeStampDissector.java:258),
+        # so a requested timezone field is None on EVERY valid line.  The
+        # zone-name string table (timelayout.zone_display_name) feeds only
+        # the never-requestable TIME.TIMEZONE emission.  Validity still
+        # rides the shared ts bundle: an unparseable timestamp fails the
+        # whole line, exactly like every other timestamp output.
+        return np.full(comp["year"].shape, None, dtype=object)
     raise KeyError(name)
 
 
 # Output names the device+host pipeline can deliver, with whether the
 # delivered value is numeric (int64 column) or a string column.  The
-# TIME.ZONE ``timezone`` output is deliberately absent: the reference
-# declares it but never delivers it (the TIME.ZONE/TIME.TIMEZONE quirk,
-# TestTimeStampDissector.java:258), so it must stay on the (non-)delivering
-# host path.
+# TIME.ZONE ``timezone`` output is the declared-but-never-delivered quirk
+# (see derive): the device models it as an always-None obj column gated on
+# the bundle's parse validity.
 _NUMERIC = {
     "epoch", "year", "month", "day", "hour", "minute", "second",
     "millisecond", "microsecond", "nanosecond", "weekyear", "weekofweekyear",
@@ -213,6 +222,7 @@ DEVICE_COMPONENTS = (
     _NUMERIC | _STRING
     | {f"{n}_utc" for n in _NUMERIC if n != "epoch"}
     | {f"{n}_utc" for n in _STRING}
+    | {"timezone"}
 )
 
 

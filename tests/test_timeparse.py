@@ -192,9 +192,15 @@ def test_derived_outputs_match_host_engine():
                 "monthname": ts.monthname(),
                 "date": ts.date_str(),
                 "time": ts.time_str(),
+                # The TIME.ZONE quirk (timefields.derive): the reference
+                # declares the field but emits under TIME.TIMEZONE, so
+                # the delivered value is None on every valid line.
+                "timezone": None,
             }[base]
             value = got[i]
-            if isinstance(expected, int):
+            if expected is None:
+                assert value is None, (name, s)
+            elif isinstance(expected, int):
                 assert int(value) == expected, (name, s)
             else:
                 assert str(value) == expected, (name, s)
